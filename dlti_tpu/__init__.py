@@ -1,0 +1,35 @@
+"""dlti_tpu — TPU-native distributed LLM training + inference framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+``rokulkarni15/distributed-llm-training-inference`` (the reference repo):
+
+* LoRA fine-tuning of Llama-family models (reference:
+  ``training/train_baseline.py``, ``train_deepspeed_zero{1,2,3}.py``)
+* ZeRO-1/2/3-equivalent distributed training, expressed as
+  ``jax.sharding.NamedSharding`` presets over a device mesh instead of the
+  reference's DeepSpeed/NCCL engine (reference: ``configs/ds_config_zero*.json``)
+* Dataset preparation with the Llama-2 chat format contract (reference:
+  ``scripts/prepare_dataset.py``)
+* Metrics/analysis with the reference CSV schema (reference:
+  ``training/utils.py``, ``scripts/compare_training.py``)
+* The serving + load-test leg the reference README claims (vLLM/Locust,
+  ``README.md:10-17``) but never implements: a TPU-native engine with a
+  paged KV cache, continuous batching, and an OpenAI-compatible server.
+
+The package name abbreviates the reference repo name
+(``distributed-llm-training-inference`` → ``dlti``) with a ``_tpu`` suffix,
+since hyphens are not importable in Python.
+"""
+
+__version__ = "0.1.0"
+
+from dlti_tpu.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    LoRAConfig,
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    TrainConfig,
+    ZeROStage,
+)

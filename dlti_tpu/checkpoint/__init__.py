@@ -1,0 +1,21 @@
+"""Checkpoint / resume / export (SURVEY.md §5.4).
+
+Orbax-backed async sharded checkpointing with rotation (the reference's
+``save_steps=100, save_total_limit=3`` contract,
+``train_deepspeed_zero1.py:243-245``), scan-latest resume
+(``train_deepspeed_zero1.py:267-279``), and consolidated merged-LoRA export
+(the ``stage3_gather_16bit_weights_on_model_save`` + PEFT-merge capability,
+``configs/ds_config_zero3.json:36``).
+"""
+
+from dlti_tpu.checkpoint.orbax_io import (  # noqa: F401
+    latest_step,
+    list_checkpoint_steps,
+    restore_train_state,
+    save_train_state,
+    wait_for_saves,
+)
+from dlti_tpu.checkpoint.export import (  # noqa: F401
+    export_merged_model,
+    load_exported_model,
+)

@@ -1,0 +1,58 @@
+"""Consolidated model export: sharded training state → portable artifact.
+
+The capability chain the reference assembles from DeepSpeed + PEFT:
+gather sharded weights on save (``stage3_gather_16bit_weights_on_model_save``,
+``configs/ds_config_zero3.json:36``) then merge LoRA into the base model for
+serving (vLLM leg, ``README.md:10``). Here: fold LoRA factors into base
+kernels (:func:`~dlti_tpu.models.lora.merge_lora_params`), gather to host,
+and write a single Orbax checkpoint + config JSON that the serving engine
+loads directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dlti_tpu.config import Config, ModelConfig
+from dlti_tpu.models.lora import merge_lora_params
+
+
+def export_merged_model(directory: str, params, cfg: Config,
+                        merge_lora: bool = True) -> str:
+    """Write ``directory/model`` (orbax pytree) + ``directory/config.json``.
+
+    ``params`` may be sharded; leaves are gathered to host first (the
+    16-bit-gather-on-save analog). Returns the export directory.
+    """
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    host_params = jax.device_get(params)
+    if merge_lora and cfg.lora.enabled:
+        host_params = merge_lora_params(host_params, alpha=cfg.lora.alpha)
+
+    ckptr = ocp.StandardCheckpointer()
+    model_dir = os.path.join(directory, "model")
+    ckptr.save(model_dir, host_params, force=True)
+    ckptr.wait_until_finished()
+
+    meta = cfg.to_dict()
+    meta["lora"]["enabled"] = False if merge_lora else meta["lora"]["enabled"]
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    return directory
+
+
+def load_exported_model(directory: str) -> Tuple[dict, Config]:
+    """Load a consolidated export → (params, config). Used by serving."""
+    directory = os.path.abspath(directory)
+    with open(os.path.join(directory, "config.json")) as f:
+        cfg = Config.from_dict(json.load(f))
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(directory, "model"))
+    return params, cfg

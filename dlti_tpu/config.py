@@ -1,0 +1,346 @@
+"""Single typed config tree for the whole framework.
+
+Replaces the reference's two stitched-together config systems — per-script
+argparse with drifting defaults (``training/train_baseline.py:27-89``,
+``train_deepspeed_zero2.py:37-120``) and DeepSpeed JSON files with ``"auto"``
+placeholders (``configs/ds_config_zero1.json``) — with one dataclass tree plus
+per-strategy presets (see :func:`preset`).
+
+Defaults mirror the reference where the reference has them:
+
+* LoRA r=16, alpha=2*r, dropout=0.05, on q/k/v/o, bias none
+  (``training/train_baseline.py:131-140``)
+* AdamW betas (0.9, 0.999), eps 1e-8, weight decay 0
+  (``configs/ds_config_zero1.json:6-14``)
+* WarmupLR 0 -> lr over warmup steps (``configs/ds_config_zero1.json:16-23``)
+* grad clip 1.0 (``configs/ds_config_zero1.json:44``)
+* max_seq_len 512 truncation (``training/train_baseline.py:155``)
+* lr 2e-4, grad-accum 16, micro-batch 1 (``training/train_baseline.py:60-75``)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class ZeROStage(enum.IntEnum):
+    """ZeRO stage, kept as a first-class concept for reference parity.
+
+    On TPU these are sharding presets over the mesh, not an engine:
+
+    * ``NONE``  — pure replicated data parallelism (reference baseline).
+    * ``ZERO1`` — optimizer state sharded over the data axis
+      (``configs/ds_config_zero1.json:35``).
+    * ``ZERO2`` — + gradients reduce-scattered to shards
+      (``configs/ds_config_zero2.json:27``).
+    * ``ZERO3`` — + parameters sharded (FSDP) with optional host offload
+      (``configs/ds_config_zero3.json:17-27``).
+    """
+
+    NONE = 0
+    ZERO1 = 1
+    ZERO2 = 2
+    ZERO3 = 3
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family architecture hyperparameters."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32  # < num_heads => GQA
+    head_dim: Optional[int] = None  # default hidden_size // num_heads
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"  # compute dtype (MXU-friendly)
+    param_dtype: str = "bfloat16"  # storage dtype of (frozen) base params
+    remat: bool = True  # jax.checkpoint each block (grad-ckpt parity)
+    remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    attention_impl: str = "auto"  # "auto" | "reference" | "flash"
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    def num_params(self, include_lm_head: bool = True) -> int:
+        """Analytic parameter count (for MFU and reporting)."""
+        h, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        hd = self.resolved_head_dim
+        q = h * self.num_heads * hd
+        kv = 2 * h * self.num_kv_heads * hd
+        o = self.num_heads * hd * h
+        attn = q + kv + o
+        mlp = 3 * h * m
+        norms = 2 * h
+        per_layer = attn + mlp + norms
+        total = v * h + self.num_layers * per_layer + h  # embed + layers + final norm
+        if include_lm_head and not self.tie_embeddings:
+            total += h * v
+        return total
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    """LoRA adapter config.
+
+    Matches the reference graft: r=16, alpha=32, dropout 0.05, q/k/v/o
+    projections, no bias (``training/train_baseline.py:131-140``).
+    """
+
+    enabled: bool = True
+    r: int = 16
+    alpha: int = 32
+    dropout: float = 0.05
+    target_modules: tuple = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.r
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """AdamW + WarmupLR, mirroring ``configs/ds_config_zero1.json:6-23,44``."""
+
+    learning_rate: float = 2e-4
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    warmup_steps: int = 100
+    grad_clip: float = 1.0
+    schedule: str = "warmup_constant"  # or "warmup_cosine"
+    total_steps: int = 0  # used by cosine schedule; 0 = constant after warmup
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh shape + strategy.
+
+    Axes: ``data`` (DP / ZeRO), ``fsdp`` (param sharding, ZeRO-3), ``tensor``
+    (TP over ICI, for serving and large models), ``sequence`` (context /
+    ring-attention parallelism for long sequences).
+    """
+
+    zero_stage: ZeROStage = ZeROStage.NONE
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    # ZeRO-3 host offload parity (configs/ds_config_zero3.json:19-27).
+    # offload_optimizer places optimizer state in pinned host memory (wired
+    # in opt_state_shardings); offload_params is reserved for param paging
+    # (not yet wired — setting it raises in build_mesh-consuming paths).
+    offload_optimizer: bool = False
+    offload_params: bool = False
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.sequence
+
+    @property
+    def dp_like_size(self) -> int:
+        """Total batch-sharding degree (data * fsdp axes both carry batch)."""
+        return self.data * self.fsdp
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline config (reference: ``scripts/prepare_dataset.py``)."""
+
+    dataset_path: str = "./data/glaive_code_full"
+    dataset_name: str = "glaiveai/glaive-code-assistant"
+    tokenizer: str = "meta-llama/Llama-2-7b-hf"
+    max_seq_len: int = 512  # reference truncation (train_baseline.py:155)
+    pack_sequences: bool = False  # reference does not pack; packing is a perf option
+    num_samples: Optional[int] = None
+    shuffle_seed: int = 0
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Checkpoint / resume policy.
+
+    Reference policies: baseline per-epoch keep-2 (``train_baseline.py:188-189``),
+    ZeRO-1/2 per-100-steps keep-3 (``train_deepspeed_zero1.py:243-245``),
+    ZeRO-3 per-epoch keep-2 (``train_deepspeed_zero3.py:234-236``).
+    """
+
+    output_dir: str = "./checkpoints/run"
+    save_strategy: str = "steps"  # "steps" | "epoch" | "no"
+    save_steps: int = 100
+    save_total_limit: int = 3
+    resume: bool = True  # scan-latest-and-resume (train_deepspeed_zero1.py:267-279)
+    async_save: bool = True
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training loop knobs (reference: ``TrainingArguments`` uses across scripts)."""
+
+    num_epochs: int = 1
+    max_steps: int = 0  # 0 = derive from epochs * steps_per_epoch
+    # GLOBAL microbatch per forward/backward (summed over all data-parallel
+    # devices and hosts; must be divisible by data*fsdp mesh extent). The
+    # reference's per-device bs=1 on N GPUs corresponds to micro_batch_size=N
+    # here (train_baseline.py:64-68).
+    micro_batch_size: int = 1
+    grad_accum_steps: int = 16  # train_baseline.py:69-75
+    logging_steps: int = 10  # train_baseline.py:184
+    seed: int = 42
+    eval_steps: int = 0  # 0 = no eval
+
+
+@dataclass(frozen=True)
+class Config:
+    """Root config."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    lora: LoRAConfig = field(default_factory=LoRAConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    experiment_name: str = ""
+
+    def replace(self, **kwargs: Any) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+    # ------------------------------------------------------------------
+    # Serialization (round-trips through JSON for checkpoint metadata)
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict:
+        def _convert(obj: Any) -> Any:
+            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+                return {k: _convert(v) for k, v in dataclasses.asdict(obj).items()}
+            if isinstance(obj, enum.Enum):
+                return obj.value
+            if isinstance(obj, tuple):
+                return list(obj)
+            return obj
+
+        return _convert(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        def _build(dc_cls, sub: dict):
+            fields = {f.name: f for f in dataclasses.fields(dc_cls)}
+            kwargs = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    continue
+                f = fields[k]
+                if dataclasses.is_dataclass(f.type) or f.name in (
+                    "model", "lora", "optimizer", "parallel", "data", "checkpoint", "train",
+                ):
+                    sub_cls = {
+                        "model": ModelConfig, "lora": LoRAConfig,
+                        "optimizer": OptimizerConfig, "parallel": ParallelConfig,
+                        "data": DataConfig, "checkpoint": CheckpointConfig,
+                        "train": TrainConfig,
+                    }.get(f.name)
+                    if sub_cls is not None and isinstance(v, dict):
+                        kwargs[k] = _build(sub_cls, v)
+                        continue
+                if f.name == "zero_stage":
+                    kwargs[k] = ZeROStage(v)
+                elif isinstance(v, list):
+                    kwargs[k] = tuple(v)
+                else:
+                    kwargs[k] = v
+            return dc_cls(**kwargs)
+
+        return _build(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))
+
+
+# ----------------------------------------------------------------------
+# Model size presets
+# ----------------------------------------------------------------------
+
+MODEL_PRESETS: dict = {
+    # Test-scale model: tiny but structurally identical (GQA, SwiGLU, RoPE).
+    "llama_tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128, remat=False,
+        dtype="float32", param_dtype="float32",
+    ),
+    # Small debug model (fits anywhere, exercises remat + bf16).
+    "llama_debug": ModelConfig(
+        vocab_size=4096, hidden_size=256, intermediate_size=512, num_layers=4,
+        num_heads=8, num_kv_heads=4, max_seq_len=512,
+    ),
+    # ~1.1B TinyLlama-shaped config for single-chip benchmarking.
+    "llama_1b": ModelConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=32, num_kv_heads=4, max_seq_len=2048,
+    ),
+    # Llama-2-7B (the reference's model: meta-llama/Llama-2-7b-hf).
+    "llama2_7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096,
+    ),
+    # Llama-2-13B (BASELINE.json config #4: full fine-tune, ZeRO-3 multi-host).
+    "llama2_13b": ModelConfig(
+        vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+        num_layers=40, num_heads=40, num_kv_heads=40, max_seq_len=4096,
+    ),
+    # Llama-3-8B-shaped (GQA + large vocab), for generality.
+    "llama3_8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        rope_theta=500000.0,
+    ),
+}
+
+
+def preset(name: str, **overrides: Any) -> Config:
+    """Build a :class:`Config` from a strategy preset name.
+
+    Presets mirror the reference experiment matrix
+    (``training/train.ipynb``): ``baseline`` and ``zero{1,2,3}_{N}dev``.
+
+    >>> preset("baseline").parallel.zero_stage
+    <ZeROStage.NONE: 0>
+    >>> preset("zero3_8dev").parallel.fsdp
+    8
+    """
+    model = overrides.pop("model", MODEL_PRESETS["llama2_7b"])
+    if isinstance(model, str):
+        model = MODEL_PRESETS[model]
+
+    if name == "baseline":
+        par = ParallelConfig(zero_stage=ZeROStage.NONE)
+    else:
+        import re
+
+        m = re.fullmatch(r"zero([123])(?:_(\d+)dev)?", name)
+        if not m:
+            raise ValueError(
+                f"unknown preset {name!r}; expected 'baseline' or 'zero{{1,2,3}}[_Ndev]'"
+            )
+        stage = ZeROStage(int(m.group(1)))
+        n = int(m.group(2) or 1)
+        if stage == ZeROStage.ZERO3:
+            par = ParallelConfig(zero_stage=stage, fsdp=n)
+        else:
+            par = ParallelConfig(zero_stage=stage, data=n)
+    return Config(model=model, parallel=par, experiment_name=name, **overrides)

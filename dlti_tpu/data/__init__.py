@@ -1,0 +1,13 @@
+"""Data pipeline: chat formatting, tokenization, batching.
+
+Reference layer L1 (``scripts/prepare_dataset.py``) rebuilt with a per-host
+sharded, packing-capable input pipeline designed to never starve the chips.
+"""
+
+from dlti_tpu.data.formats import format_conversation_for_llama2  # noqa: F401
+from dlti_tpu.data.tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401
+from dlti_tpu.data.pipeline import (  # noqa: F401
+    TokenBatchDataset,
+    make_batches,
+    tokenize_and_truncate,
+)

@@ -1,0 +1,26 @@
+"""Chat formatting contracts.
+
+:func:`format_conversation_for_llama2` reproduces the reference's exact
+Llama-2 format contract (``scripts/prepare_dataset.py:12-25``):
+
+    {"question": q, "answer": a} -> {"text": "<s>[INST] q [/INST] a</s>"}
+
+The golden tests pin these strings byte-for-byte — a checkpoint fine-tuned
+here sees the same token stream the reference model saw.
+"""
+
+from __future__ import annotations
+
+
+def format_conversation_for_llama2(example: dict) -> dict:
+    """Map one {question, answer} record to Llama-2 chat text."""
+    question = example["question"].strip()
+    answer = example["answer"].strip()
+    return {"text": f"<s>[INST] {question} [/INST] {answer}</s>"}
+
+
+def format_llama2_system(question: str, answer: str, system: str | None = None) -> str:
+    """Extended form with an optional system prompt (Llama-2 spec)."""
+    if system:
+        return f"<s>[INST] <<SYS>>\n{system}\n<</SYS>>\n\n{question} [/INST] {answer}</s>"
+    return f"<s>[INST] {question} [/INST] {answer}</s>"

@@ -1,0 +1,245 @@
+"""Input pipeline: tokenize → truncate/pack → per-host sharded batches.
+
+Reference contract: tokenize with truncation to ``max_length=512``, no
+padding at map time (``train_baseline.py:152-165``), dynamic padding in the
+collator with labels = input_ids (``train_baseline.py:195-198``). Here the
+collator is replaced by static-shape batches (XLA needs static shapes):
+right-padding to ``max_seq_len`` with a loss mask, or optional sequence
+*packing* (multiple documents per row + segment ids) which the reference
+lacks and which removes pad waste — the single biggest input-side perf lever
+on TPU.
+
+Multi-host: each host materializes only its shard (``shard_by_host``),
+indexed by ``jax.process_index()`` — the analog of the per-rank
+``DistributedSampler`` HF Trainer gives the reference implicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dlti_tpu.data.tokenizer import Tokenizer
+
+
+def tokenize_and_truncate(
+    texts: Sequence[str],
+    tokenizer: Tokenizer,
+    max_seq_len: int = 512,
+    add_eos: bool = True,
+) -> List[List[int]]:
+    """Tokenize each text, truncating to ``max_seq_len`` (reference:
+    ``truncation=True, max_length=512`` — ``train_baseline.py:155``)."""
+    out = []
+    for t in texts:
+        ids = tokenizer.encode(t, add_bos=True, add_eos=add_eos)
+        out.append(ids[:max_seq_len])
+    return out
+
+
+def pad_to_batch(
+    seqs: List[List[int]], seq_len: int, pad_id: int
+) -> tuple:
+    """Right-pad to (len(seqs), seq_len); loss_mask 1 on real tokens."""
+    n = len(seqs)
+    ids = np.full((n, seq_len), pad_id, dtype=np.int32)
+    mask = np.zeros((n, seq_len), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        L = min(len(s), seq_len)
+        ids[i, :L] = s[:L]
+        mask[i, :L] = 1
+    return ids, mask
+
+
+def pack_sequences(
+    seqs: List[List[int]], seq_len: int, pad_id: int, open_rows: int = 64
+) -> tuple:
+    """Greedy windowed first-fit packing: (ids, loss_mask, segment_ids).
+
+    segment_ids are 1-based per document, 0 on padding — consumed by the
+    attention segment mask so packed documents cannot attend across
+    boundaries.
+
+    Only the last ``open_rows`` rows are candidates for placement, keeping
+    packing O(docs * open_rows) instead of O(docs * rows) — at corpus scale
+    (the reference dataset is 136k docs, train.ipynb:50) unbounded first-fit
+    is billions of Python iterations.
+    """
+    rows: List[List[int]] = []
+    row_segs: List[List[int]] = []
+    open_idx: List[int] = []  # indices of still-open rows, oldest first
+    for s in seqs:
+        s = s[:seq_len]
+        placed = False
+        for oi, i in enumerate(open_idx):
+            if len(rows[i]) + len(s) <= seq_len:
+                seg_id = row_segs[i][-1] + 1
+                rows[i].extend(s)
+                row_segs[i].extend([seg_id] * len(s))
+                if len(rows[i]) == seq_len:
+                    open_idx.pop(oi)
+                placed = True
+                break
+        if not placed:
+            rows.append(list(s))
+            row_segs.append([1] * len(s))
+            open_idx.append(len(rows) - 1)
+            if len(open_idx) > open_rows:
+                open_idx.pop(0)
+    n = len(rows)
+    ids = np.full((n, seq_len), pad_id, dtype=np.int32)
+    segs = np.zeros((n, seq_len), dtype=np.int32)
+    for i, (row, seg) in enumerate(zip(rows, row_segs)):
+        ids[i, : len(row)] = row
+        segs[i, : len(seg)] = seg
+    mask = (segs > 0).astype(np.int32)
+    return ids, mask, segs
+
+
+def packed_loss_mask(segment_ids: np.ndarray) -> np.ndarray:
+    """Loss mask for packed rows: target position p is valid iff it belongs
+    to a document (seg > 0) and its predicting position p-1 is in the *same*
+    document — the boundary token of doc k must not be trained to predict
+    doc k+1's first token."""
+    mask = np.zeros_like(segment_ids)
+    mask[:, 1:] = (segment_ids[:, 1:] > 0) & (
+        segment_ids[:, 1:] == segment_ids[:, :-1]
+    )
+    return mask.astype(np.int32)
+
+
+def packed_positions(segment_ids: np.ndarray) -> np.ndarray:
+    """Per-document positions (RoPE restarts at 0 for each packed doc).
+
+    Vectorized: position = index - index_of_current_doc_start.
+    """
+    n, L = segment_ids.shape
+    idx = np.broadcast_to(np.arange(L, dtype=np.int32), (n, L))
+    is_start = np.ones((n, L), dtype=bool)
+    is_start[:, 1:] = (segment_ids[:, 1:] != segment_ids[:, :-1]) | (
+        segment_ids[:, 1:] == 0
+    )
+    start_idx = np.where(is_start, idx, 0)
+    start_idx = np.maximum.accumulate(start_idx, axis=1)
+    return (idx - start_idx).astype(np.int32)
+
+
+@dataclasses.dataclass
+class TokenBatchDataset:
+    """In-memory tokenized dataset yielding train-step-shaped batches.
+
+    Yields dicts with ``input_ids`` / ``loss_mask`` (and, when packing,
+    ``segment_ids`` / ``positions``) shaped (accum, micro_bs, seq_len) —
+    exactly what :func:`dlti_tpu.training.make_train_step` consumes.
+
+    ``micro_batch_size`` is the *global* (all-hosts, all-devices) microbatch;
+    each host materializes 1/process_count of it when ``shard_by_host``.
+    """
+
+    sequences: List[List[int]]
+    seq_len: int
+    pad_id: int
+    micro_batch_size: int
+    grad_accum_steps: int = 1
+    shuffle_seed: Optional[int] = 0
+    shard_by_host: bool = True
+    drop_remainder: bool = True
+    pack: bool = False
+
+    def __post_init__(self) -> None:
+        import jax
+
+        self._procs = jax.process_count() if self.shard_by_host else 1
+        self._proc_id = jax.process_index() if self.shard_by_host else 0
+        if self.micro_batch_size % self._procs != 0:
+            raise ValueError(
+                f"global micro_batch_size {self.micro_batch_size} must be "
+                f"divisible by process_count {self._procs}"
+            )
+        rows: List[List[int]]
+        if self.pack:
+            # Pack once over the (seed-shuffled) corpus; epochs reshuffle rows.
+            order = np.arange(len(self.sequences))
+            if self.shuffle_seed is not None:
+                np.random.default_rng(self.shuffle_seed).shuffle(order)
+            ids, mask, segs = pack_sequences(
+                [self.sequences[j] for j in order], self.seq_len, self.pad_id
+            )
+            self._packed = (ids, packed_loss_mask(segs), segs, packed_positions(segs))
+            n_rows = ids.shape[0]
+        else:
+            self._packed = None
+            n_rows = len(self.sequences)
+        # Equal per-host shard (every host must agree on steps_per_epoch:
+        # a ragged split would deadlock collectives on the last step).
+        per_host = n_rows // self._procs
+        self._row_range = (self._proc_id * per_host, (self._proc_id + 1) * per_host)
+
+    @property
+    def samples_per_step(self) -> int:
+        """Global samples consumed per optimizer step."""
+        return self.micro_batch_size * self.grad_accum_steps
+
+    @property
+    def _host_samples_per_step(self) -> int:
+        return self.samples_per_step // self._procs
+
+    def steps_per_epoch(self) -> int:
+        lo, hi = self._row_range
+        return (hi - lo) // self._host_samples_per_step
+
+    def _row(self, j: int) -> tuple:
+        if self._packed is not None:
+            ids, mask, segs, pos = self._packed
+            return ids[j], mask[j], segs[j], pos[j]
+        s = self.sequences[j]
+        ids, mask = pad_to_batch([s], self.seq_len, self.pad_id)
+        return ids[0], mask[0], None, None
+
+    def epoch(self, epoch_idx: int = 0, skip_steps: int = 0) -> Iterator[dict]:
+        lo, hi = self._row_range
+        order = np.arange(lo, hi)
+        if self.shuffle_seed is not None:
+            # Same permutation on every host of the *local* range.
+            rng = np.random.default_rng(self.shuffle_seed + epoch_idx)
+            rng.shuffle(order)
+        chunk = self._host_samples_per_step
+        bs_local = self.micro_batch_size // self._procs
+        shape = (self.grad_accum_steps, bs_local, self.seq_len)
+        for step_i, start in enumerate(range(0, len(order) - chunk + 1, chunk)):
+            if step_i < skip_steps:
+                continue
+            rows = [self._row(j) for j in order[start : start + chunk]]
+            batch = {
+                "input_ids": np.stack([r[0] for r in rows]).reshape(shape),
+                "loss_mask": np.stack([r[1] for r in rows]).reshape(shape),
+            }
+            if self._packed is not None:
+                batch["segment_ids"] = np.stack([r[2] for r in rows]).reshape(shape)
+                batch["positions"] = np.stack([r[3] for r in rows]).reshape(shape)
+            yield batch
+
+
+def make_batches(
+    texts: Sequence[str],
+    tokenizer: Tokenizer,
+    seq_len: int = 512,
+    micro_batch_size: int = 1,
+    grad_accum_steps: int = 1,
+    shuffle_seed: Optional[int] = 0,
+    shard_by_host: bool = True,
+    pack: bool = False,
+) -> TokenBatchDataset:
+    seqs = tokenize_and_truncate(texts, tokenizer, seq_len)
+    return TokenBatchDataset(
+        sequences=seqs,
+        seq_len=seq_len,
+        pad_id=tokenizer.pad_id,
+        micro_batch_size=micro_batch_size,
+        grad_accum_steps=grad_accum_steps,
+        shuffle_seed=shuffle_seed,
+        shard_by_host=shard_by_host,
+        pack=pack,
+    )

@@ -1,0 +1,83 @@
+"""Tokenizer layer.
+
+The reference uses HF ``AutoTokenizer`` (Rust ``tokenizers`` backend,
+``train_baseline.py:115-117``) with pad=eos fallback. We wrap the same
+data-plane (tokenization is host-side on GPU and TPU alike) and add a
+hermetic :class:`ByteTokenizer` so tests and offline environments never need
+the HF hub.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    eos_id: int
+    bos_id: int
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]: ...
+    def decode(self, ids: List[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer with BOS/EOS/PAD specials — hermetic, vocab 259.
+
+    id 0 = pad, 1 = bos, 2 = eos, byte b -> b + 3.
+    """
+
+    def __init__(self) -> None:
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self.vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - 3 for i in ids if i >= 3)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Adapter over a HF fast tokenizer (pad=eos fallback like
+    ``train_baseline.py:116-117``)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        if self._tok.pad_token is None:
+            self._tok.pad_token = self._tok.eos_token
+        self.vocab_size = len(self._tok)
+        self.pad_id = self._tok.pad_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.bos_id = (
+            self._tok.bos_token_id if self._tok.bos_token_id is not None else self.eos_id
+        )
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def get_tokenizer(name: str) -> Tokenizer:
+    """"byte" -> hermetic ByteTokenizer; anything else -> HF hub/path."""
+    if name == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(name)

@@ -1,0 +1,9 @@
+"""Model zoo: Llama-family transformer in Flax + LoRA grafting."""
+
+from dlti_tpu.models.llama import LlamaForCausalLM, LlamaModel  # noqa: F401
+from dlti_tpu.models.lora import (  # noqa: F401
+    LoRADense,
+    lora_param_mask,
+    merge_lora_params,
+    count_params,
+)

@@ -1,0 +1,4 @@
+"""TPU-native compute ops: RoPE, attention (XLA reference + Pallas flash)."""
+
+from dlti_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401
+from dlti_tpu.ops.attention import multi_head_attention  # noqa: F401

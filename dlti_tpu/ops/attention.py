@@ -1,0 +1,133 @@
+"""Attention ops.
+
+The reference delegates attention to HF ``LlamaAttention`` CUDA paths; here
+we provide:
+
+* :func:`multi_head_attention` — XLA reference implementation (einsum-based,
+  GQA-capable, causal + padding masks). XLA fuses this well on TPU and it is
+  the numerically-trusted baseline for kernel tests.
+* A Pallas flash-attention path (``dlti_tpu.ops.pallas.flash_attention``)
+  selected via ``ModelConfig.attention_impl`` — blockwise, never materializes
+  the (seq, seq) score matrix, keeps the MXU fed at long sequence lengths.
+
+Dispatch policy ("auto"): flash on TPU when shapes are tile-aligned,
+reference otherwise (CPU tests, tiny shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(b, s, kv_heads, d) -> (b, s, kv_heads * n_rep, d) for GQA."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def make_causal_mask(q_len: int, kv_len: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Additive causal mask of shape (1, 1, q_len, kv_len).
+
+    Supports q_len < kv_len (decode with cache): query i attends to
+    kv positions <= (kv_len - q_len + i).
+    """
+    q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    kv_pos = jnp.arange(kv_len)[None, :]
+    allowed = kv_pos <= q_pos
+    return jnp.where(allowed, 0.0, jnp.finfo(dtype).min)[None, None, :, :].astype(dtype)
+
+
+def reference_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: jnp.ndarray | None = None,
+    kv_segment_ids: jnp.ndarray | None = None,
+    q_positions: jnp.ndarray | None = None,
+    kv_positions: jnp.ndarray | None = None,
+    softmax_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Plain XLA attention. q: (b, sq, h, d); k/v: (b, skv, h_kv, d).
+
+    Softmax is computed in float32 (TPU-friendly: bf16 matmuls on the MXU,
+    fp32 VPU reductions). ``segment_ids`` enables packed-sequence masking:
+    tokens attend only within their own segment; id 0 = padding.
+    ``q_positions``/``kv_positions`` (b, s) give explicit token positions for
+    causal masking — required for KV-cached decode where the cache capacity
+    exceeds the written region (slot index == position by construction).
+    """
+    b, sq, num_heads, head_dim = q.shape
+    num_kv = k.shape[2]
+    k = _repeat_kv(k, num_heads // num_kv)
+    v = _repeat_kv(v, num_heads // num_kv)
+
+    scale = head_dim ** -0.5
+    # (b, h, sq, skv) scores on the MXU in compute dtype, accumulated fp32.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=softmax_dtype)
+    scores = scores.astype(softmax_dtype) * scale
+
+    skv = k.shape[1]
+    if causal:
+        if q_positions is not None:
+            kv_pos = (kv_positions if kv_positions is not None
+                      else jnp.broadcast_to(jnp.arange(skv)[None, :], (b, skv)))
+            allowed = kv_pos[:, None, :] <= q_positions[:, :, None]
+            scores = scores + jnp.where(
+                allowed, 0.0, jnp.finfo(softmax_dtype).min
+            )[:, None, :, :].astype(softmax_dtype)
+        else:
+            scores = scores + make_causal_mask(sq, skv, softmax_dtype)
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        same = (segment_ids[:, :, None] == kv_seg[:, None, :]) & (kv_seg[:, None, :] != 0)
+        scores = jnp.where(same[:, None, :, :], scores, jnp.finfo(softmax_dtype).min)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=softmax_dtype)
+    return out.astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv")
+)
+def multi_head_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    segment_ids: jnp.ndarray | None = None,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jnp.ndarray:
+    """Dispatching attention entry point used by the model.
+
+    impl: "reference" | "flash" | "auto". "auto" picks flash on TPU for
+    tile-aligned self-attention shapes without packing, else reference.
+    """
+    use_flash = False
+    if impl == "flash":
+        use_flash = True
+    elif impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        sq, skv, hd = q.shape[1], k.shape[1], q.shape[3]
+        aligned = sq % 128 == 0 and skv % 128 == 0 and hd % 128 == 0 and sq == skv
+        use_flash = on_tpu and aligned and causal and segment_ids is None
+
+    if use_flash:
+        from dlti_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            block_q=block_q, block_kv=block_kv,
+        )
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels: flash attention (training), decode attention (serving)."""
+
+from dlti_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401

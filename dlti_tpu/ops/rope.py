@@ -1,0 +1,40 @@
+"""Rotary position embeddings (RoPE), Llama-style.
+
+The reference gets RoPE implicitly through HF ``LlamaModel``
+(``training/train_baseline.py:122-126`` loads ``meta-llama/Llama-2-7b-hf``);
+here it is implemented directly. Uses the split-half rotation convention
+(matching HF Llama), computed in float32 for numerical parity and cast back
+to the compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0) -> tuple:
+    """Precompute cos/sin tables of shape ``(max_seq_len, head_dim // 2)``."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (seq, head_dim//2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` of shape (batch, seq, heads, head_dim) by position.
+
+    ``positions`` is (batch, seq) int32 — explicit so the same op serves
+    packed sequences and KV-cached decode (where position != index).
+    """
+    orig_dtype = x.dtype
+    half = x.shape[-1] // 2
+    # Gather per-token tables: (batch, seq, half) -> broadcast over heads.
+    cos_p = jnp.take(cos, positions, axis=0)[:, :, None, :].astype(jnp.float32)
+    sin_p = jnp.take(sin, positions, axis=0)[:, :, None, :].astype(jnp.float32)
+    x = x.astype(jnp.float32)
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1
+    )
+    return rotated.astype(orig_dtype)

@@ -1,0 +1,18 @@
+"""Distributed runtime: mesh, sharding presets (ZeRO/TP/SP), multi-host init.
+
+The TPU-native replacement for the reference's DeepSpeed/NCCL stack
+(SURVEY.md §2d): ``jax.sharding.Mesh`` over ICI/DCN with GSPMD-inserted
+collectives instead of NCCL all-reduce/all-gather/reduce-scatter, and
+``jax.distributed.initialize`` instead of torchrun/deepspeed launchers.
+"""
+
+from dlti_tpu.parallel.mesh import MESH_AXES, build_mesh  # noqa: F401
+from dlti_tpu.parallel.sharding import (  # noqa: F401
+    batch_pspec,
+    make_global_batch,
+    make_sharded_train_step,
+    opt_state_shardings,
+    param_pspec,
+    param_shardings,
+    shard_train_state,
+)

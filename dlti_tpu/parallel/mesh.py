@@ -1,0 +1,64 @@
+"""Device mesh construction.
+
+Axes (SURVEY.md §2c build targets):
+
+* ``data``     — replicated data parallelism + ZeRO-1/2 optimizer sharding
+                 (reference: torchrun DP, ``train_deepspeed_zero1.py:10-12``)
+* ``fsdp``     — parameter sharding, the ZeRO-3 equivalent
+                 (reference: ``configs/ds_config_zero3.json:17``)
+* ``tensor``   — tensor parallelism over ICI (reference claims TP only for
+                 the vLLM leg, ``README.md:10``)
+* ``sequence`` — context/sequence parallelism (ring attention) for
+                 long-context training; the reference truncates to 512 and
+                 has no SP (SURVEY.md §5.7) — first-class here.
+
+On real pods ``mesh_utils.create_device_mesh`` lays axes out so that the
+innermost (most communication-heavy) axes ride ICI. On CPU (tests) we fall
+back to a plain reshape of ``jax.devices()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from dlti_tpu.config import ParallelConfig
+
+MESH_AXES = ("data", "fsdp", "tensor", "sequence")
+
+
+def build_mesh(cfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a 4-axis mesh of shape (data, fsdp, tensor, sequence)."""
+    if devices is None:
+        devices = jax.devices()
+    shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence)
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
+        )
+    if devices[0].platform == "tpu":
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    else:
+        dev_array = np.array(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous — replaces the reference's launcher-set
+    MASTER_ADDR/LOCAL_RANK env contract (``train_deepspeed_zero1.py:120-121``,
+    ``train.ipynb:640-647``). With no args, JAX auto-detects cluster env
+    (GKE/GCE metadata, SLURM, or MEGASCALE vars)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

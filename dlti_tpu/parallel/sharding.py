@@ -1,0 +1,276 @@
+"""Sharding rules: ZeRO stages + TP + SP as ``NamedSharding`` presets.
+
+The DeepSpeed ZeRO engine (reference ``configs/ds_config_zero{1,2,3}.json``)
+re-expressed in the XLA/GSPMD model (SURVEY.md §2b):
+
+* **ZeRO-1** — params replicated; *optimizer state* sharded over ``data``.
+  GSPMD then all-gathers the sharded AdamW update into the replicated params
+  (the analog of ``allgather_partitions``, ``ds_config_zero1.json:36``).
+* **ZeRO-2** — as ZeRO-1, plus gradients constrained to the optimizer-state
+  sharding before the update, forcing a reduce-scatter instead of all-reduce
+  (the analog of ``reduce_scatter: true``, ``ds_config_zero1.json:40``).
+* **ZeRO-3** — parameters themselves sharded over ``fsdp``; XLA all-gathers
+  weights per-layer inside the step and re-shards after use (FSDP). Host
+  offload of params/optimizer is a separate memory-kind option
+  (``ds_config_zero3.json:19-27`` parity).
+* **TP** — attention heads + MLP hidden sharded over ``tensor``; the
+  all-reduce after o_proj/down_proj is inserted by GSPMD.
+* **SP** — batch also sharded over ``sequence`` on the length dim for ring
+  attention (see ``dlti_tpu.parallel.ring_attention``).
+
+Rules are *path + shape* based over the model's deterministic param naming
+(``q_proj/kernel``: (in, out), etc.) rather than linen metadata — explicit,
+inspectable, and independent of module internals.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlti_tpu.config import Config, ZeROStage
+from dlti_tpu.training.state import TrainState
+
+# ----------------------------------------------------------------------
+# Tensor-parallel rules: param-name regex -> (dim sharded by 'tensor')
+# Kernels are (in_features, out_features); None = no TP for that param.
+# ----------------------------------------------------------------------
+_TP_RULES = [
+    (r".*(q_proj|k_proj|v_proj)/kernel$", 1),   # column-parallel (heads)
+    (r".*(q_proj|k_proj|v_proj)/lora_b$", 1),   # lora_b out dim follows base
+    (r".*o_proj/kernel$", 0),                    # row-parallel
+    (r".*o_proj/lora_a$", 0),                    # lora_a in dim follows base
+    (r".*(gate_proj|up_proj)/kernel$", 1),       # column-parallel (mlp hidden)
+    (r".*(gate_proj|up_proj)/lora_b$", 1),
+    (r".*down_proj/kernel$", 0),                 # row-parallel
+    (r".*down_proj/lora_a$", 0),
+    (r".*embed_tokens$", 0),                     # shard vocab rows
+    (r".*lm_head$", 1),                          # shard vocab cols
+]
+
+
+def _path_str(path: tuple) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif isinstance(p, tuple):
+            parts.extend(str(q) for q in p)
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _tp_dim(path_s: str) -> Optional[int]:
+    for pattern, dim in _TP_RULES:
+        if re.match(pattern, path_s):
+            return dim
+    return None
+
+
+def _largest_divisible_dim(shape: tuple, size: int, taken: Optional[int] = None) -> Optional[int]:
+    """Pick the largest dim divisible by ``size`` (excluding ``taken``)."""
+    best, best_len = None, 0
+    for d, n in enumerate(shape):
+        if d == taken:
+            continue
+        if n % size == 0 and n > best_len:
+            best, best_len = d, n
+    return best
+
+
+def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
+    """PartitionSpec for one param leaf under the configured strategy."""
+    shape = value.shape
+    if len(shape) == 0:
+        return P()
+    path_s = _path_str(path)
+    spec: list = [None] * len(shape)
+
+    tp_size = mesh.shape["tensor"]
+    tp_d = _tp_dim(path_s) if tp_size > 1 else None
+    if tp_d is not None and shape[tp_d] % tp_size == 0:
+        spec[tp_d] = "tensor"
+    else:
+        tp_d = None
+
+    if cfg.parallel.zero_stage == ZeROStage.ZERO3:
+        fsdp_size = mesh.shape["fsdp"]
+        if fsdp_size > 1:
+            d = _largest_divisible_dim(shape, fsdp_size, taken=tp_d)
+            # Don't FSDP-shard tiny params (norm scales, LoRA factors with
+            # dim < 1024): the all-gather latency outweighs memory savings.
+            if d is not None and shape[d] >= 1024:
+                spec[d] = "fsdp"
+    return P(*spec)
+
+
+def _zero_opt_leaf_pspec(shape: tuple, axis: str, size: int) -> P:
+    """Shard an optimizer-state leaf (ZeRO-1/2): largest divisible dim."""
+    if len(shape) == 0 or size <= 1:
+        return P()
+    d = _largest_divisible_dim(shape, size)
+    if d is None:
+        return P()
+    spec: list = [None] * len(shape)
+    spec[d] = axis
+    return P(*spec)
+
+
+def param_shardings(params: Any, cfg: Config, mesh: Mesh) -> Any:
+    """Pytree of NamedShardings for the full param tree."""
+    if cfg.parallel.offload_params:
+        raise NotImplementedError(
+            "offload_params (ZeRO-3 param paging to host) is not wired yet; "
+            "use offload_optimizer for the ds_config_zero3 offload parity"
+        )
+    return jax.tree_util.tree_map_with_path(
+        lambda path, v: NamedSharding(mesh, param_pspec(path, v, cfg, mesh)), params
+    )
+
+
+def opt_state_shardings(opt_state: Any, cfg: Config, mesh: Mesh) -> Any:
+    """Shardings for optimizer state (ZeRO-1/2/3 semantics).
+
+    Shape-based: each array leaf is sharded on its largest divisible dim —
+    over ``data`` for ZeRO-1/2, over ``fsdp`` for ZeRO-3; replicated for the
+    baseline (the reference keeps the full optimizer on every rank). Scalars
+    (step counts) are replicated.
+    """
+    stage = cfg.parallel.zero_stage
+    if stage in (ZeROStage.ZERO1, ZeROStage.ZERO2):
+        axis, size = "data", mesh.shape["data"]
+    elif stage == ZeROStage.ZERO3:
+        axis, size = "fsdp", mesh.shape["fsdp"]
+    else:
+        axis, size = "data", 1
+
+    # ZeRO-3 CPU-offload parity (configs/ds_config_zero3.json:19-23): place
+    # optimizer state in host memory; XLA streams it in for the update.
+    memory_kind = None
+    if cfg.parallel.offload_optimizer:
+        try:
+            kinds = {m.kind for m in mesh.devices.flat[0].addressable_memories()}
+            if "pinned_host" in kinds:
+                memory_kind = "pinned_host"
+        except Exception:
+            memory_kind = None
+
+    def leaf(v):
+        if not hasattr(v, "shape"):
+            return NamedSharding(mesh, P())
+        return NamedSharding(
+            mesh, _zero_opt_leaf_pspec(v.shape, axis, size), memory_kind=memory_kind
+        )
+
+    return jax.tree_util.tree_map(leaf, opt_state)
+
+
+def batch_pspec(cfg: Config) -> P:
+    """Batch layout for (accum, micro_bs, seq): batch over data+fsdp,
+    sequence over the SP axis."""
+    seq_axis = "sequence" if cfg.parallel.sequence > 1 else None
+    return P(None, ("data", "fsdp"), seq_axis)
+
+
+def make_global_batch(batch: dict, cfg: Config, mesh: Mesh) -> dict:
+    """Assemble per-host numpy batches into global jax.Arrays.
+
+    On a multi-host pod each process holds only its slice of the global
+    batch (``TokenBatchDataset`` shards rows per host); jit with global
+    in_shardings requires global arrays. Single-process: pass through.
+    """
+    if jax.process_count() == 1:
+        return batch
+    sharding = NamedSharding(mesh, batch_pspec(cfg))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch.items()
+    }
+
+
+def state_shardings(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
+    """A TrainState-shaped pytree of NamedShardings."""
+    p_sh = param_shardings(state.params, cfg, mesh)
+    o_sh = opt_state_shardings(state.opt_state, cfg, mesh)
+    return state.replace(
+        step=NamedSharding(mesh, P()), params=p_sh, opt_state=o_sh
+    )
+
+
+def shard_train_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
+    """Place an (unsharded, host-resident) TrainState onto the mesh."""
+    sh = state_shardings(state, cfg, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s) if hasattr(x, "shape") else x,
+        state, sh,
+    )
+
+
+def make_sharded_train_step(
+    model,
+    state: TrainState,
+    cfg: Config,
+    mesh: Mesh,
+    *,
+    accum_steps: int = 1,
+    donate: bool = True,
+) -> Callable:
+    """Jit the train step over the mesh with explicit in/out shardings.
+
+    GSPMD inserts the ZeRO/TP collectives; XLA's latency-hiding scheduler
+    overlaps them with compute (the analog of ``overlap_comm: true``,
+    ``ds_config_zero1.json:38``).
+    """
+    from dlti_tpu.training.step import make_train_step
+
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    if cfg.train.micro_batch_size % dp != 0:
+        raise ValueError(
+            f"global micro_batch_size={cfg.train.micro_batch_size} must be "
+            f"divisible by the batch-sharding extent data*fsdp={dp}"
+        )
+
+    st_sh = state_shardings(state, cfg, mesh)
+    b_sh = NamedSharding(mesh, batch_pspec(cfg))
+    rng_sh = NamedSharding(mesh, P())
+
+    grad_constraint = None
+    if cfg.parallel.zero_stage in (ZeROStage.ZERO2, ZeROStage.ZERO3):
+        # ZeRO-2 semantics: pin accumulated grads to the optimizer-state
+        # layout so XLA reduce-scatters instead of all-reducing.
+        axis = "data" if cfg.parallel.zero_stage == ZeROStage.ZERO2 else "fsdp"
+        size = mesh.shape[axis]
+
+        def grad_constraint(grads):
+            return jax.tree_util.tree_map(
+                lambda g: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, _zero_opt_leaf_pspec(g.shape, axis, size))
+                ),
+                grads,
+            )
+
+    def activation_constraint(input_ids):
+        return jax.lax.with_sharding_constraint(
+            input_ids, NamedSharding(mesh, P(("data", "fsdp"),
+                                             "sequence" if cfg.parallel.sequence > 1 else None))
+        )
+
+    step_fn = make_train_step(
+        model,
+        accum_steps=accum_steps,
+        sharding_constraint=activation_constraint,
+        grad_constraint=grad_constraint,
+    )
+
+    # Every batch field (input_ids/loss_mask/segment_ids/positions) shares
+    # the (accum, batch, seq) layout; a prefix pytree applies b_sh to all.
+    return jax.jit(
+        step_fn,
+        in_shardings=(st_sh, b_sh, rng_sh),
+        out_shardings=(st_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -1,0 +1,5 @@
+"""Training runtime: optimizer, train state, step function, trainer loop."""
+
+from dlti_tpu.training.optimizer import build_optimizer, build_schedule  # noqa: F401
+from dlti_tpu.training.state import TrainState, create_train_state  # noqa: F401
+from dlti_tpu.training.step import make_train_step, causal_lm_loss  # noqa: F401

@@ -1,0 +1,54 @@
+"""Optimizer + LR schedule, mirroring the reference's DeepSpeed config.
+
+Reference: AdamW lr=2e-4|3e-4, betas (0.9, 0.999), eps 1e-8, weight_decay 0
+(``configs/ds_config_zero1.json:6-14``); WarmupLR 0 -> lr over warmup
+(``configs/ds_config_zero1.json:16-23``); grad clip 1.0
+(``configs/ds_config_zero1.json:44``).
+
+The reference disables DeepSpeed's fused CUDA Adam
+(``train_deepspeed_zero2.py:125-128``) and falls back to torch Adam; on TPU
+the fused update comes for free — XLA fuses the optax adamw elementwise chain
+into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from dlti_tpu.config import OptimizerConfig
+
+
+def build_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    if cfg.schedule == "warmup_constant":
+        if cfg.warmup_steps <= 0:
+            return optax.constant_schedule(cfg.learning_rate)
+        # DeepSpeed WarmupLR: linear 0 -> lr, then constant.
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, cfg.learning_rate, max(cfg.warmup_steps, 1)),
+                optax.constant_schedule(cfg.learning_rate),
+            ],
+            boundaries=[max(cfg.warmup_steps, 1)],
+        )
+    if cfg.schedule == "warmup_cosine":
+        total = max(cfg.total_steps, cfg.warmup_steps + 1)
+        return optax.warmup_cosine_decay_schedule(
+            0.0, cfg.learning_rate, max(cfg.warmup_steps, 1), total
+        )
+    raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+
+def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    """Global-norm clip -> AdamW(schedule). Applied to the *trainable* subtree
+    only (the step fn partitions LoRA vs frozen params before calling this),
+    so optimizer state is allocated solely for trainable params."""
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(
+            learning_rate=build_schedule(cfg),
+            b1=cfg.betas[0],
+            b2=cfg.betas[1],
+            eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+        ),
+    )

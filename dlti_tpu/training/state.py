@@ -1,0 +1,83 @@
+"""Train state: full param tree + optimizer state over the trainable subset.
+
+Unlike ``flax.training.train_state.TrainState``, params are kept as one tree
+while the optimizer state covers only the *trainable* (LoRA) flat subset —
+the structure that lets ZeRO-1/2 shard optimizer state over the data axis
+while base params stay frozen (SURVEY.md §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct, traverse_util
+
+
+def _is_trainable_key(key: tuple, lora_enabled: bool) -> bool:
+    if not lora_enabled:
+        return True
+    return key[-1] in ("lora_a", "lora_b")
+
+
+def partition_params(params: dict, lora_enabled: bool) -> tuple:
+    """Split a nested param dict into (trainable_flat, frozen_flat).
+
+    Flat dicts keyed by path tuples are valid pytrees, so the trainable dict
+    can be differentiated / optimized / sharded directly.
+    """
+    flat = traverse_util.flatten_dict(params)
+    trainable = {k: v for k, v in flat.items() if _is_trainable_key(k, lora_enabled)}
+    frozen = {k: v for k, v in flat.items() if not _is_trainable_key(k, lora_enabled)}
+    return trainable, frozen
+
+
+def combine_params(trainable_flat: dict, frozen_flat: dict) -> dict:
+    return traverse_util.unflatten_dict({**frozen_flat, **trainable_flat})
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any  # full nested param tree
+    opt_state: Any  # optax state over the trainable flat subset
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    lora_enabled: bool = struct.field(pytree_node=False)
+
+    def trainable_and_frozen(self) -> tuple:
+        return partition_params(self.params, self.lora_enabled)
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    example_batch_shape: tuple,
+    lora_enabled: bool = True,
+    init_fn: Callable | None = None,
+) -> TrainState:
+    """Initialize params + optimizer state.
+
+    ``example_batch_shape`` is (micro_batch, seq_len). ``init_fn`` overrides
+    model.init for tests / loading pre-trained weights.
+    """
+    dummy = jnp.zeros(example_batch_shape, dtype=jnp.int32)
+    if init_fn is None:
+        variables = model.init(rng, dummy, deterministic=True)
+        params = variables["params"]
+    else:
+        params = init_fn(rng, dummy)
+
+    trainable, _ = partition_params(params, lora_enabled)
+    if not trainable:
+        raise ValueError("no trainable params found (LoRA enabled but no adapters grafted)")
+    # Master copies of trainable params in fp32 (bf16 base stays bf16).
+    opt_state = tx.init(trainable)
+    return TrainState(
+        step=jnp.array(0, dtype=jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        tx=tx,
+        lora_enabled=lora_enabled,
+    )

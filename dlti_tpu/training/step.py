@@ -1,0 +1,162 @@
+"""The jitted train step: loss, grad accumulation, optimizer update.
+
+This is the in-tree replacement for what the reference outsources to HF
+``Trainer`` + the DeepSpeed engine (``trainer.train()``,
+``training/train_baseline.py:217``): forward, causal-LM loss with the
+collator's semantics (labels = input_ids, ``mlm=False`` —
+``train_baseline.py:195-198``), backward w.r.t. the trainable (LoRA) subset
+only, gradient accumulation over microbatches (``lax.scan``, matching
+``gradient_accumulation_steps`` — ``train_baseline.py:69-75``), global-norm
+clip, AdamW update.
+
+Design notes (TPU-first):
+
+* Gradients are computed only for the trainable flat subset — backprop flows
+  *through* frozen bf16 base kernels but never materializes their dW, the
+  same work-skipping PEFT gets from ``requires_grad=False``.
+* Grad accumulation is a ``lax.scan`` over the leading ``accum`` axis of the
+  batch, accumulating fp32 grads; one compiled program per optimizer step,
+  no host round-trips.
+* Everything is shape-static; the same step function is jitted per-device or
+  ``jit``-over-a-``Mesh`` with sharding constraints (see
+  ``dlti_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlti_tpu.training.state import TrainState, combine_params
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,
+    input_ids: jnp.ndarray,
+    loss_mask: Optional[jnp.ndarray] = None,
+) -> tuple:
+    """Next-token cross-entropy.
+
+    Labels are the inputs shifted left (HF ``DataCollatorForLanguageModeling``
+    with ``mlm=False`` shifts inside the model; semantics identical).
+    Returns (sum_loss, num_tokens) so callers can weight across microbatches.
+    """
+    targets = input_ids[:, 1:]
+    logits = logits[:, :-1, :]
+    if loss_mask is None:
+        mask = jnp.ones_like(targets, dtype=jnp.float32)
+    else:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+    token_loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return jnp.sum(token_loss * mask), jnp.sum(mask)
+
+
+def make_train_step(
+    model,
+    *,
+    accum_steps: int = 1,
+    sharding_constraint: Optional[Callable] = None,
+    grad_constraint: Optional[Callable] = None,
+) -> Callable:
+    """Build ``train_step(state, batch, rng) -> (state, metrics)``.
+
+    ``batch`` is a dict with ``input_ids`` (accum, micro_bs, seq) int32 and
+    optional ``loss_mask`` of the same shape. ``sharding_constraint`` is an
+    optional fn applied to per-microbatch inputs (inserted by the parallel
+    layer to pin activations to the mesh). ``grad_constraint`` pins the
+    accumulated grads to the optimizer-state sharding — the ZeRO-2
+    reduce-scatter semantics (``configs/ds_config_zero1.json:40``).
+    """
+
+    def microbatch_loss(trainable, frozen, micro, rng):
+        params = combine_params(trainable, frozen)
+        input_ids = micro["input_ids"]
+        loss_mask = micro.get("loss_mask")
+        if sharding_constraint is not None:
+            input_ids = sharding_constraint(input_ids)
+        logits, _ = model.apply(
+            {"params": params}, input_ids,
+            positions=micro.get("positions"),  # packed: per-doc RoPE restart
+            segment_ids=micro.get("segment_ids"),  # packed: intra-doc attention
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        loss_sum, n_tok = causal_lm_loss(logits, input_ids, loss_mask)
+        return loss_sum, n_tok
+
+    def train_step(state: TrainState, batch: dict, rng: jax.Array):
+        trainable, frozen = state.trainable_and_frozen()
+
+        def accum_body(carry, micro_with_rng):
+            # One fused fwd+bwd per microbatch via value_and_grad.
+            grads_acc, loss_acc, tok_acc = carry
+            micro, micro_rng = micro_with_rng
+            (loss_sum, n_tok), grads = jax.value_and_grad(
+                microbatch_loss, argnums=0, has_aux=True
+            )(trainable, frozen, micro, micro_rng)
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+            )
+            return (grads_acc, loss_acc + loss_sum, tok_acc + n_tok), None
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), trainable
+        )
+        rngs = jax.random.split(rng, accum_steps)
+        if accum_steps == 1:
+            micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+            (grads, loss_sum, n_tok), _ = accum_body(
+                (zero_grads, jnp.float32(0.0), jnp.float32(0.0)), (micro, rngs[0])
+            )
+        else:
+            (grads, loss_sum, n_tok), _ = jax.lax.scan(
+                accum_body,
+                (zero_grads, jnp.float32(0.0), jnp.float32(0.0)),
+                (batch, rngs),
+            )
+
+        # Mean over all tokens in the global batch (matches HF Trainer's
+        # token-mean loss under grad accumulation).
+        n_tok = jnp.maximum(n_tok, 1.0)
+        grads = jax.tree_util.tree_map(lambda g: g / n_tok, grads)
+        loss = loss_sum / n_tok
+        if grad_constraint is not None:
+            grads = grad_constraint(grads)
+
+        updates, new_opt_state = state.tx.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        new_params = combine_params(new_trainable, frozen)
+
+        grad_norm = optax.global_norm(grads)
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "num_tokens": n_tok,
+        }
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable:
+    """Build ``eval_step(state, batch) -> metrics`` (no dropout, no update)."""
+
+    def eval_step(state: TrainState, batch: dict):
+        logits, _ = model.apply(
+            {"params": state.params}, batch["input_ids"],
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            deterministic=True,
+        )
+        loss_sum, n_tok = causal_lm_loss(
+            logits, batch["input_ids"], batch.get("loss_mask")
+        )
+        return {"loss": loss_sum / jnp.maximum(n_tok, 1.0), "num_tokens": n_tok}
+
+    return eval_step

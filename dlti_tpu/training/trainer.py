@@ -1,0 +1,255 @@
+"""The training loop — in-tree replacement for HF ``Trainer`` + DeepSpeed.
+
+One class drives what the reference spreads across four scripts
+(``training/train_baseline.py`` / ``train_deepspeed_zero{1,2,3}.py``):
+
+* build mesh + shard state per the configured ZeRO stage / TP / SP
+* iterate epochs of per-host sharded batches
+* per-``logging_steps`` loss/throughput logging (``train_baseline.py:184``)
+* step- or epoch-based checkpointing with rotation
+  (``train_deepspeed_zero1.py:243-245``: save_steps=100, keep 3)
+* scan-latest-and-resume (``train_deepspeed_zero1.py:267-279``)
+* final metrics in the reference CSV schema + tokens/sec/chip + MFU
+  (``train_baseline.py:239-259``)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional
+
+import jax
+import numpy as np
+
+from dlti_tpu.config import Config
+from dlti_tpu.models import LlamaForCausalLM, count_params
+from dlti_tpu.parallel import build_mesh, make_sharded_train_step, shard_train_state
+from dlti_tpu.training.optimizer import build_optimizer
+from dlti_tpu.training.state import TrainState, create_train_state
+from dlti_tpu.training.step import make_train_step
+from dlti_tpu.utils.experiment import experiment_name_from_config
+from dlti_tpu.utils.logging import StepTimer, get_logger, is_main_process
+from dlti_tpu.utils.metrics import (
+    MetricsRecord,
+    compute_mfu,
+    detect_chip_peak_flops,
+    device_peak_memory_gb,
+    print_metrics_summary,
+    save_training_metrics,
+)
+
+
+class Trainer:
+    def __init__(self, cfg: Config, model: Optional[LlamaForCausalLM] = None):
+        self.cfg = cfg
+        self.logger = get_logger()
+        self.model = model or LlamaForCausalLM(
+            cfg.model, cfg.lora if cfg.lora.enabled else None
+        )
+        self.tx = build_optimizer(cfg.optimizer)
+        self.mesh = None
+        if cfg.parallel.num_devices > 1:
+            self.mesh = build_mesh(cfg.parallel)
+        self._step_fn = None
+        self._ckpt_mgr = None
+
+    # ------------------------------------------------------------------
+    def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
+        rng = rng if rng is not None else jax.random.PRNGKey(self.cfg.train.seed)
+        state = create_train_state(
+            rng,
+            self.model,
+            self.tx,
+            (self.cfg.train.micro_batch_size, self.cfg.data.max_seq_len),
+            lora_enabled=self.cfg.lora.enabled,
+        )
+        if self.mesh is not None:
+            state = shard_train_state(state, self.cfg, self.mesh)
+        return state
+
+    def _build_step(self, state: TrainState):
+        if self.mesh is not None:
+            return make_sharded_train_step(
+                self.model, state, self.cfg, self.mesh,
+                accum_steps=self.cfg.train.grad_accum_steps,
+            )
+        return jax.jit(
+            make_train_step(self.model, accum_steps=self.cfg.train.grad_accum_steps),
+            donate_argnums=(0,),
+        )
+
+    # ------------------------------------------------------------------
+    def train(
+        self,
+        batches_per_epoch: Iterable[dict] | None = None,
+        dataset=None,
+        eval_dataset=None,
+        state: Optional[TrainState] = None,
+        resume: Optional[bool] = None,
+    ) -> tuple:
+        """Run the configured number of epochs. Returns (state, MetricsRecord).
+
+        ``dataset`` (a :class:`~dlti_tpu.data.TokenBatchDataset`) enables
+        epoch re-iteration and exact resume of the data schedule;
+        ``batches_per_epoch`` is a simpler single-epoch iterable for custom
+        loops (resume restores weights but not batch order).
+        """
+        cfg = self.cfg
+        state = state or self.init_state()
+        resume = cfg.checkpoint.resume if resume is None else resume
+
+        start_step = 0
+        if resume and cfg.checkpoint.save_strategy != "no":
+            from dlti_tpu.checkpoint import latest_step, restore_train_state
+
+            step = latest_step(cfg.checkpoint.output_dir)
+            if step is not None:
+                state = restore_train_state(cfg.checkpoint.output_dir, step, state)
+                start_step = int(step)
+                self.logger.info("resumed from checkpoint step %d", start_step)
+
+        step_fn = self._build_step(state)
+        rng = jax.random.PRNGKey(cfg.train.seed + 1)
+        timer = StepTimer(warmup_steps=2)
+
+        trainable, total = count_params(state.params)
+        if is_main_process():
+            self.logger.info(
+                "trainable params: %s / %s (%.4f%%)",
+                f"{trainable:,}", f"{total:,}", 100 * trainable / total,
+            )
+
+        tokens_per_step = (
+            cfg.train.micro_batch_size * cfg.train.grad_accum_steps * cfg.data.max_seq_len
+        )
+        losses: list = []
+        global_step = start_step
+        samples_seen = 0
+        t_start = time.time()
+
+        # Resume the *data schedule* too, not just the weights: skip the
+        # epochs/steps already consumed so no batch is trained twice (the
+        # reference delegates this to HF Trainer's resume machinery).
+        start_epoch, skip_steps = 0, 0
+        if start_step > 0 and dataset is not None:
+            spe = dataset.steps_per_epoch()
+            if spe > 0:
+                start_epoch = min(start_step // spe, cfg.train.num_epochs)
+                skip_steps = start_step % spe
+
+        def epoch_batches(epoch):
+            if dataset is not None:
+                return dataset.epoch(epoch, skip_steps=skip_steps if epoch == start_epoch else 0)
+            return batches_per_epoch
+
+        eval_fn = None
+        if eval_dataset is not None and cfg.train.eval_steps:
+            from dlti_tpu.training.step import make_eval_step
+
+            eval_fn = jax.jit(make_eval_step(self.model))
+
+        for epoch in range(start_epoch, cfg.train.num_epochs):
+            for batch in epoch_batches(epoch):
+                if cfg.train.max_steps and global_step >= cfg.train.max_steps:
+                    break
+                if self.mesh is not None:
+                    from dlti_tpu.parallel import make_global_batch
+
+                    batch = make_global_batch(batch, cfg, self.mesh)
+                rng, step_rng = jax.random.split(rng)
+                with timer.measure():
+                    state, metrics = step_fn(state, batch, step_rng)
+                    metrics = jax.device_get(metrics)  # blocks: true step time
+                global_step += 1
+                samples_seen += cfg.train.micro_batch_size * cfg.train.grad_accum_steps
+                losses.append(float(metrics["loss"]))
+
+                if global_step % cfg.train.logging_steps == 0 and is_main_process():
+                    self.logger.info(
+                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s | %.0f tok/s/chip",
+                        global_step, losses[-1], float(metrics["grad_norm"]),
+                        timer.steps_per_second,
+                        timer.steps_per_second * tokens_per_step
+                        / max(jax.device_count(), 1),
+                    )
+                if (
+                    eval_fn is not None
+                    and global_step % cfg.train.eval_steps == 0
+                ):
+                    self._run_eval(eval_fn, state, eval_dataset, global_step)
+                self._maybe_save(state, global_step, epoch_end=False)
+            self._maybe_save(state, global_step, epoch_end=True)
+            if cfg.train.max_steps and global_step >= cfg.train.max_steps:
+                break
+
+        if cfg.checkpoint.save_strategy != "no":
+            from dlti_tpu.checkpoint import wait_for_saves
+
+            wait_for_saves(cfg.checkpoint.output_dir)  # async saves must land
+
+        wall = time.time() - t_start
+        record = self._final_metrics(
+            losses, wall, samples_seen, tokens_per_step, global_step - start_step,
+            trainable, total, timer,
+        )
+        if is_main_process():
+            print_metrics_summary(record)
+            save_training_metrics(record)
+        return state, record
+
+    # ------------------------------------------------------------------
+    def _run_eval(self, eval_fn, state, eval_dataset, step: int) -> None:
+        losses, toks = [], 0.0
+        for batch in eval_dataset.epoch(0):
+            flat = {
+                k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()
+            }  # eval ignores the accum dim
+            m = jax.device_get(eval_fn(state, flat))
+            losses.append(float(m["loss"]) * float(m["num_tokens"]))
+            toks += float(m["num_tokens"])
+        if toks and is_main_process():
+            self.logger.info("eval @ step %d | loss %.4f", step, sum(losses) / toks)
+
+    def _maybe_save(self, state: TrainState, step: int, epoch_end: bool) -> None:
+        cfg = self.cfg.checkpoint
+        if cfg.save_strategy == "no":
+            return
+        due = (
+            (cfg.save_strategy == "steps" and step % cfg.save_steps == 0 and step > 0)
+            or (cfg.save_strategy == "epoch" and epoch_end)
+        )
+        if not due:
+            return
+        from dlti_tpu.checkpoint import save_train_state
+
+        save_train_state(
+            cfg.output_dir, step, state,
+            keep=cfg.save_total_limit, async_save=cfg.async_save,
+        )
+
+    def _final_metrics(
+        self, losses, wall, samples_seen, tokens_per_step, steps, trainable, total, timer,
+    ) -> MetricsRecord:
+        cfg = self.cfg
+        final_loss = losses[-1] if losses else float("nan")
+        sps = samples_seen / wall if wall > 0 else 0.0
+        tok_s_chip = (
+            timer.steps_per_second * tokens_per_step / max(jax.device_count(), 1)
+        )
+        peak_flops = detect_chip_peak_flops()
+        mfu = compute_mfu(tok_s_chip, total, peak_flops, trainable_params=trainable)
+        return MetricsRecord(
+            experiment=experiment_name_from_config(cfg),
+            num_gpus=cfg.parallel.num_devices,
+            zero_stage=int(cfg.parallel.zero_stage),
+            strategy=(
+                "baseline" if int(cfg.parallel.zero_stage) == 0
+                else f"zero{int(cfg.parallel.zero_stage)}"
+            ),
+            training_time_hours=wall / 3600.0,
+            samples_per_second=sps,
+            peak_memory_gb=device_peak_memory_gb(),
+            final_loss=final_loss,
+            tokens_per_second_per_chip=tok_s_chip,
+            mfu_percent=mfu,
+        )

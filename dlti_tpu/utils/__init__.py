@@ -1,0 +1,12 @@
+"""Shared utilities: experiment naming, metrics, logging, profiling."""
+
+from dlti_tpu.utils.experiment import (  # noqa: F401
+    create_experiment_name,
+    get_zero_stage_from_config,
+)
+from dlti_tpu.utils.metrics import (  # noqa: F401
+    MetricsRecord,
+    compute_mfu,
+    print_metrics_summary,
+    save_training_metrics,
+)

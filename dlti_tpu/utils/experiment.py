@@ -1,0 +1,56 @@
+"""Experiment naming + config introspection.
+
+API-parity with the reference's ``training/utils.py``:
+
+* :func:`create_experiment_name` — ``training/utils.py:11-33``
+* :func:`get_zero_stage_from_config` — ``training/utils.py:36-48`` (extended:
+  also accepts this framework's own JSON config files)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Union
+
+from dlti_tpu.config import Config, ZeROStage
+
+
+def create_experiment_name(num_devices: int, zero_stage: Union[int, ZeROStage, None]) -> str:
+    """``(num_devices, zero_stage)`` -> experiment name.
+
+    >>> create_experiment_name(1, None)
+    'baseline'
+    >>> create_experiment_name(1, 0)
+    'baseline'
+    >>> create_experiment_name(2, 1)
+    'zero1_2dev'
+    >>> create_experiment_name(4, 3)
+    'zero3_4dev'
+    """
+    stage = int(zero_stage) if zero_stage is not None else 0
+    if stage == 0:
+        return "baseline"
+    return f"zero{stage}_{num_devices}dev"
+
+
+def get_zero_stage_from_config(config_path: str) -> Optional[int]:
+    """Read the ZeRO stage out of a JSON config file.
+
+    Accepts both DeepSpeed-style files (``{"zero_optimization": {"stage": N}}``,
+    reference ``configs/ds_config_zero1.json:34``) and this framework's
+    serialized :class:`~dlti_tpu.config.Config` (``parallel.zero_stage``).
+    Returns None if the file has neither.
+    """
+    with open(config_path) as f:
+        cfg = json.load(f)
+    if "zero_optimization" in cfg:
+        return cfg["zero_optimization"].get("stage")
+    if "parallel" in cfg:
+        return cfg["parallel"].get("zero_stage")
+    return None
+
+
+def experiment_name_from_config(cfg: Config) -> str:
+    if cfg.experiment_name:
+        return cfg.experiment_name
+    return create_experiment_name(cfg.parallel.num_devices, cfg.parallel.zero_stage)

@@ -1,0 +1,85 @@
+"""Rank-0 logging + step timing.
+
+Reference analogs: main-process gating via ``is_main_process``
+(``train_deepspeed_zero1.py:123,126``) / ``local_rank <= 0``
+(``train_deepspeed_zero3.py:128``); per-10-step logging
+(``logging_steps=10``, ``train_baseline.py:184``).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from contextlib import contextmanager
+
+
+def is_main_process() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def get_logger(name: str = "dlti_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO if is_main_process() else logging.WARNING)
+        logger.propagate = False
+    return logger
+
+
+class StepTimer:
+    """Wall-clock per-step timing with warm-up discard — the in-tree
+    equivalent of DeepSpeed's ``wall_clock_breakdown`` (always available,
+    reference keeps it disabled — ``configs/ds_config_zero1.json:48``)."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._times: list = []
+        self._t0: float | None = None
+        self._count = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        dt = time.perf_counter() - self._t0
+        self._count += 1
+        if self._count > self.warmup_steps:
+            self._times.append(dt)
+
+    @contextmanager
+    def measure(self):
+        self.start()
+        yield
+        self.stop()
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return sum(self._times) / len(self._times) if self._times else 0.0
+
+    @property
+    def steps_per_second(self) -> float:
+        m = self.mean_step_seconds
+        return 1.0 / m if m > 0 else 0.0
+
+
+@contextmanager
+def profile_trace(log_dir: str, enabled: bool = True):
+    """Capture a ``jax.profiler`` trace (view in TensorBoard/XProf) —
+    the tracing capability the reference lacks (SURVEY.md §5.1)."""
+    import jax
+
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

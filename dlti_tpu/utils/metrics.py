@@ -1,0 +1,128 @@
+"""Metrics: reference CSV schema + TPU-native additions (tokens/sec/chip, MFU).
+
+Reference schema (``training/train_baseline.py:246-255``, appended to
+``results/training_metrics.csv`` by ``training/utils.py:51-69``):
+``experiment, num_gpus, zero_stage, strategy, training_time_hours,
+samples_per_second, peak_memory_gb, final_loss``.
+
+We keep those columns byte-compatible (``num_gpus`` meaning "num chips") so
+the reference's analysis workflow ports directly, and append
+``tokens_per_second_per_chip`` and ``mfu_percent`` — the BASELINE.json north
+star metrics.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+# v5e: 197 TFLOP/s bf16 per chip; v5p: 459; v4: 275. Used for MFU.
+# NOTE: ordered most-specific-first — the lookup scans in insertion order and
+# e.g. "v5" is a substring of every v5p device_kind.
+TPU_PEAK_FLOPS = {
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v6e": 918e12,
+    "v5": 197e12,
+    "v4": 275e12,
+    "cpu": 1e12,  # placeholder so CPU smoke runs produce finite MFU
+}
+
+
+@dataclass
+class MetricsRecord:
+    experiment: str
+    num_gpus: int  # column name kept for reference CSV parity; = num chips
+    zero_stage: int
+    strategy: str
+    training_time_hours: float
+    samples_per_second: float
+    peak_memory_gb: float
+    final_loss: float
+    tokens_per_second_per_chip: float = 0.0
+    mfu_percent: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def training_flops_per_token(num_params: int, trainable_params: Optional[int] = None) -> float:
+    """Approximate FLOPs/token for one train step.
+
+    Full fine-tune: ~6N (fwd 2N + bwd 4N). LoRA: bwd skips dW for frozen
+    params (~2N of the 4N), giving ~4N + small adapter terms.
+    """
+    if trainable_params is not None and trainable_params < 0.5 * num_params:
+        return 4.0 * num_params
+    return 6.0 * num_params
+
+
+def compute_mfu(
+    tokens_per_second_per_chip: float,
+    num_params: int,
+    chip_peak_flops: float,
+    trainable_params: Optional[int] = None,
+) -> float:
+    """Model FLOPs Utilization in percent."""
+    achieved = tokens_per_second_per_chip * training_flops_per_token(
+        num_params, trainable_params
+    )
+    return 100.0 * achieved / chip_peak_flops
+
+
+def detect_chip_peak_flops() -> float:
+    """Best-effort peak-FLOPs lookup for the local accelerator."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower().replace(" ", "")
+    for key, val in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return TPU_PEAK_FLOPS["cpu"]
+
+
+def device_peak_memory_gb() -> float:
+    """Peak device memory (the ``torch.cuda.max_memory_allocated`` analog,
+    reference ``train_baseline.py:253``)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:  # some PJRT plugins return None
+            return 0.0
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+        return peak / 1024**3
+    except Exception:
+        return 0.0
+
+
+def save_training_metrics(metrics: MetricsRecord | dict,
+                          csv_path: str = "results/training_metrics.csv") -> None:
+    """Append a row; write header on first write (``training/utils.py:51-69``)."""
+    row = metrics.to_dict() if isinstance(metrics, MetricsRecord) else dict(metrics)
+    os.makedirs(os.path.dirname(csv_path) or ".", exist_ok=True)
+    exists = os.path.isfile(csv_path)
+    with open(csv_path, "a", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(row.keys()))
+        if not exists:
+            writer.writeheader()
+        writer.writerow(row)
+
+
+def print_metrics_summary(metrics: MetricsRecord | dict) -> None:
+    """Formatted stdout dump (``training/utils.py:72-88``)."""
+    row = metrics.to_dict() if isinstance(metrics, MetricsRecord) else dict(metrics)
+    print("\n" + "=" * 60)
+    print("TRAINING METRICS SUMMARY")
+    print("=" * 60)
+    for k, v in row.items():
+        if isinstance(v, float):
+            print(f"  {k:<28} {v:.4f}")
+        else:
+            print(f"  {k:<28} {v}")
+    print("=" * 60 + "\n")

@@ -1,0 +1,181 @@
+"""Model unit tests: shapes, RoPE, RMSNorm, GQA, LoRA semantics, caching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.config import LoRAConfig, MODEL_PRESETS
+from dlti_tpu.models import LlamaForCausalLM, count_params, merge_lora_params
+from dlti_tpu.models.llama import RMSNorm
+from dlti_tpu.ops.attention import make_causal_mask, reference_attention
+from dlti_tpu.ops.rope import apply_rope, rope_frequencies
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+def _init(model, rng, batch=2, seq=16):
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(rng, ids)["params"]
+
+
+def test_forward_shapes(rng):
+    model = LlamaForCausalLM(CFG)
+    params = _init(model, rng)
+    ids = jax.random.randint(rng, (2, 16), 0, CFG.vocab_size)
+    logits, cache = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+
+
+def test_rmsnorm_matches_formula(rng):
+    x = jax.random.normal(rng, (2, 8, 32))
+    mod = RMSNorm(eps=1e-5)
+    params = mod.init(rng, x)
+    out = mod.apply(params, x)
+    expected = x / np.sqrt(np.mean(np.square(x), axis=-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_rope_preserves_norm_and_relativity(rng):
+    """RoPE is a rotation (norm-preserving) and q·k depends only on the
+    relative position offset."""
+    d, seq = 64, 32
+    cos, sin = rope_frequencies(d, seq)
+    x = jax.random.normal(rng, (1, seq, 1, d))
+    pos = jnp.arange(seq)[None, :]
+    rx = apply_rope(x, cos, sin, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rx), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # Relativity: <R_m q, R_n k> == <R_{m+s} q, R_{n+s} k>
+    q = jax.random.normal(jax.random.fold_in(rng, 1), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 2), (1, 1, 1, d))
+    def dot_at(m, n):
+        rq = apply_rope(q, cos, sin, jnp.array([[m]]))
+        rk = apply_rope(k, cos, sin, jnp.array([[n]]))
+        return float(jnp.sum(rq * rk))
+    assert abs(dot_at(3, 1) - dot_at(13, 11)) < 1e-3
+
+
+def test_causal_mask_decode_offset():
+    m = make_causal_mask(1, 4)
+    assert m.shape == (1, 1, 1, 4)
+    assert np.all(np.asarray(m) == 0.0)  # single query sees whole prefix
+    m2 = np.asarray(make_causal_mask(2, 4))[0, 0]
+    assert m2[0, 3] < -1e30 and m2[1, 3] == 0.0
+
+
+def test_gqa_equals_mha_when_heads_repeat(rng):
+    """GQA with kv repeated == full MHA with duplicated kv heads."""
+    b, s, h, kv, d = 2, 8, 4, 2, 16
+    q = jax.random.normal(rng, (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, s, kv, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, s, kv, d))
+    out_gqa = reference_attention(q, k, v)
+    k_full = jnp.repeat(k, h // kv, axis=2)
+    v_full = jnp.repeat(v, h // kv, axis=2)
+    out_mha = reference_attention(q, k_full, v_full)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5)
+
+
+def test_attention_is_causal(rng):
+    """Changing future tokens must not change earlier outputs."""
+    model = LlamaForCausalLM(CFG)
+    params = _init(model, rng)
+    ids = jax.random.randint(rng, (1, 16), 0, CFG.vocab_size)
+    logits1, _ = model.apply({"params": params}, ids)
+    ids2 = ids.at[0, -1].set((ids[0, -1] + 1) % CFG.vocab_size)
+    logits2, _ = model.apply({"params": params}, ids2)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :-1]), np.asarray(logits2[0, :-1]), atol=1e-5
+    )
+
+
+def test_lora_starts_as_identity(rng):
+    """With B=0 init, LoRA model output == base model output."""
+    base = LlamaForCausalLM(CFG)
+    lora = LlamaForCausalLM(CFG, LoRAConfig(r=8, alpha=16))
+    lora_params = _init(lora, rng)
+    base_params = merge_lora_params(lora_params, alpha=16)
+    out_lora, _ = lora.apply({"params": lora_params},
+                             jnp.arange(16, dtype=jnp.int32)[None, :])
+    out_base, _ = base.apply({"params": base_params},
+                             jnp.arange(16, dtype=jnp.int32)[None, :])
+    np.testing.assert_allclose(np.asarray(out_lora), np.asarray(out_base), atol=1e-5)
+
+
+def test_lora_merge_changes_with_nonzero_b(rng):
+    """After perturbing lora_b, merged base model == lora model (fold-in
+    correctness, the PEFT merge_and_unload contract)."""
+    lora_cfg = LoRAConfig(r=8, alpha=16)
+    lora = LlamaForCausalLM(CFG, lora_cfg)
+    params = _init(lora, rng)
+
+    def bump(tree):
+        if isinstance(tree, dict):
+            return {k: (v * 0 + 0.02 if k == "lora_b" else bump(v)) for k, v in tree.items()}
+        return tree
+
+    params = bump(params)
+    merged = merge_lora_params(params, alpha=16)
+    base = LlamaForCausalLM(CFG)
+    ids = jnp.arange(16, dtype=jnp.int32)[None, :]
+    out_lora, _ = lora.apply({"params": params}, ids)
+    out_merged, _ = base.apply({"params": merged}, ids)
+    np.testing.assert_allclose(np.asarray(out_lora), np.asarray(out_merged),
+                               atol=2e-4)
+
+
+def test_trainable_fraction(rng):
+    """LoRA trainable-param accounting: only lora_a/lora_b are trainable."""
+    model = LlamaForCausalLM(CFG, LoRAConfig())
+    params = _init(model, rng)
+    trainable, total = count_params(params)
+    # 4 target projections x 2 layers x (in*r + r*out)
+    assert 0 < trainable < total
+    hd = CFG.resolved_head_dim
+    expected = 0
+    for layer in range(CFG.num_layers):
+        for name, out in [("q_proj", CFG.num_heads * hd),
+                          ("k_proj", CFG.num_kv_heads * hd),
+                          ("v_proj", CFG.num_kv_heads * hd),
+                          ("o_proj", CFG.hidden_size)]:
+            inf = CFG.hidden_size if name != "o_proj" else CFG.num_heads * hd
+            expected += inf * 16 + 16 * out
+    assert trainable == expected
+
+
+def test_kv_cache_decode_matches_full_forward(rng):
+    """Prefill+decode through the cache == one full forward (greedy logits)."""
+    model = LlamaForCausalLM(CFG)
+    params = _init(model, rng)
+    ids = jax.random.randint(rng, (1, 12), 0, CFG.vocab_size)
+
+    full_logits, _ = model.apply({"params": params}, ids)
+
+    cache = model.init_cache(1, 16, dtype=jnp.float32)
+    prefill, cache = model.apply(
+        {"params": params}, ids[:, :8],
+        positions=jnp.arange(8)[None, :], cache=cache,
+    )
+    np.testing.assert_allclose(np.asarray(prefill), np.asarray(full_logits[:, :8]),
+                               atol=1e-4)
+    for t in range(8, 12):
+        step_logits, cache = model.apply(
+            {"params": params}, ids[:, t:t + 1],
+            positions=jnp.array([[t]]), cache=cache,
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[0, 0]), np.asarray(full_logits[0, t]), atol=1e-4
+        )
+
+
+def test_num_params_analytic_matches_actual(rng):
+    model = LlamaForCausalLM(CFG)
+    params = _init(model, rng)
+    actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert CFG.num_params() == actual
