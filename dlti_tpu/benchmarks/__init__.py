@@ -1,0 +1,9 @@
+"""Load-testing + benchmark reporting.
+
+The reference pins ``locust==2.29.0`` and ``aiohttp==3.10.0``
+(``requirements.txt:35-36``) and claims "Benchmarking: Locust, AsyncIO"
+(``README.md:11,17``) but ships no benchmark code (SURVEY.md §0). This
+package is that leg, dependency-free.
+"""
+
+from dlti_tpu.benchmarks.loadgen import LoadGenConfig, LoadReport, run_load_test  # noqa: F401

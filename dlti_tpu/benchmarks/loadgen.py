@@ -1,0 +1,241 @@
+"""Async load generator for the serving engine — the Locust/AsyncIO leg.
+
+The reference claims "Benchmarking: Locust, AsyncIO" (``README.md:11,17``)
+with ``locust``/``aiohttp`` pinned but unused (``requirements.txt:35-36``).
+This is that capability, stdlib-only: an asyncio closed-loop (N concurrent
+users, Locust's model) or open-loop (Poisson arrivals at a target QPS)
+driver speaking HTTP/1.1 over raw asyncio streams, measuring what serving
+benchmarks actually need:
+
+* per-request latency and output token counts
+* TTFT (time to first streamed token) and TPOT (per-token latency) when
+  ``stream=True``
+* aggregate request/output-token throughput + p50/p90/p99 percentiles
+
+Report schema feeds ``scripts/benchmark_serving.py`` and the CSV/plot
+tooling (the serving analog of ``results/training_metrics.csv``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class LoadGenConfig:
+    host: str = "127.0.0.1"
+    port: int = 8000
+    num_requests: int = 64
+    concurrency: int = 8            # closed-loop users
+    qps: Optional[float] = None     # set => open-loop Poisson arrivals
+    stream: bool = True             # measure TTFT via SSE
+    max_tokens: int = 64
+    temperature: float = 0.0
+    prompt: str = "Write a function that reverses a linked list."
+    prompts: Tuple[str, ...] = ()   # optional pool; falls back to `prompt`
+    chat: bool = False
+    timeout_s: float = 300.0
+    seed: int = 0
+
+
+@dataclass
+class RequestRecord:
+    start: float
+    end: float = 0.0
+    first_token: Optional[float] = None
+    output_tokens: int = 0
+    ok: bool = False
+    error: str = ""
+
+    @property
+    def latency(self) -> float:
+        return self.end - self.start
+
+    @property
+    def ttft(self) -> Optional[float]:
+        return None if self.first_token is None else self.first_token - self.start
+
+
+@dataclass
+class LoadReport:
+    num_requests: int
+    num_ok: int
+    duration_s: float
+    requests_per_s: float
+    output_tokens_per_s: float
+    latency_p50_s: float
+    latency_p90_s: float
+    latency_p99_s: float
+    ttft_p50_s: float = 0.0
+    ttft_p90_s: float = 0.0
+    ttft_p99_s: float = 0.0
+    tpot_mean_ms: float = 0.0
+    errors: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        import dataclasses
+
+        return dataclasses.asdict(self)
+
+
+def _percentile(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+async def _http_post_sse(host: str, port: int, path: str, body: dict,
+                         rec: RequestRecord, timeout_s: float) -> None:
+    """POST; if the response is SSE, count data chunks and stamp TTFT."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        payload = json.dumps(body).encode()
+        req = (f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+               f"Content-Type: application/json\r\n"
+               f"Content-Length: {len(payload)}\r\nConnection: close\r\n\r\n"
+               ).encode() + payload
+        writer.write(req)
+        await writer.drain()
+
+        status_line = await asyncio.wait_for(reader.readline(), timeout_s)
+        status = int(status_line.split()[1])
+        headers = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), timeout_s)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+
+        if status != 200:
+            raw = await asyncio.wait_for(reader.read(), timeout_s)
+            rec.error = f"HTTP {status}: {raw[:200].decode(errors='replace')}"
+            return
+
+        if headers.get("content-type", "").startswith("text/event-stream"):
+            # SSE over chunked transfer: scan for `data:` lines.
+            n_data = 0
+            buf = b""
+            while True:
+                chunk = await asyncio.wait_for(reader.read(4096), timeout_s)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    s = line.strip()
+                    if not s.startswith(b"data:"):
+                        continue
+                    data = s[5:].strip()
+                    if data == b"[DONE]":
+                        rec.ok = True
+                        continue
+                    try:
+                        obj = json.loads(data)
+                    except json.JSONDecodeError:
+                        continue
+                    choices = obj.get("choices") or [{}]
+                    delta = choices[0].get("delta", {}).get("content") \
+                        if "delta" in choices[0] else choices[0].get("text")
+                    if delta:
+                        if rec.first_token is None:
+                            rec.first_token = time.monotonic()
+                        n_data += 1
+            # Tokens != SSE chunks in general; chunk count is the stream's
+            # visible progress unit and the per-chunk latency is the TPOT
+            # proxy. Usage-accurate counts come from non-stream mode.
+            rec.output_tokens = n_data
+            rec.ok = rec.ok or n_data > 0
+        else:
+            raw = await asyncio.wait_for(reader.read(), timeout_s)
+            # Strip chunked framing if present.
+            text = raw.decode(errors="replace")
+            start = text.find("{")
+            obj = json.loads(text[start:text.rfind("}") + 1])
+            usage = obj.get("usage", {})
+            rec.output_tokens = int(usage.get("completion_tokens", 0))
+            rec.ok = True
+    except (asyncio.TimeoutError, OSError, ValueError, json.JSONDecodeError) as e:
+        rec.error = f"{type(e).__name__}: {e}"
+    finally:
+        rec.end = time.monotonic()
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except OSError:
+            pass
+
+
+def _build_body(cfg: LoadGenConfig, rng: random.Random) -> Tuple[str, dict]:
+    prompt = rng.choice(cfg.prompts) if cfg.prompts else cfg.prompt
+    if cfg.chat:
+        path = "/v1/chat/completions"
+        body = {"messages": [{"role": "user", "content": prompt}]}
+    else:
+        path = "/v1/completions"
+        body = {"prompt": prompt}
+    body.update({"max_tokens": cfg.max_tokens, "temperature": cfg.temperature,
+                 "stream": cfg.stream})
+    return path, body
+
+
+async def _run_async(cfg: LoadGenConfig) -> LoadReport:
+    rng = random.Random(cfg.seed)
+    records: List[RequestRecord] = []
+    sem = asyncio.Semaphore(cfg.concurrency)
+
+    async def one() -> None:
+        async with sem:
+            path, body = _build_body(cfg, rng)
+            rec = RequestRecord(start=time.monotonic())
+            records.append(rec)
+            await _http_post_sse(cfg.host, cfg.port, path, body, rec, cfg.timeout_s)
+
+    t0 = time.monotonic()
+    if cfg.qps:
+        # Open loop: Poisson arrivals; concurrency still caps in-flight.
+        tasks = []
+        for _ in range(cfg.num_requests):
+            tasks.append(asyncio.create_task(one()))
+            await asyncio.sleep(rng.expovariate(cfg.qps))
+        await asyncio.gather(*tasks)
+    else:
+        # Closed loop: `concurrency` users issuing back-to-back requests.
+        await asyncio.gather(*(one() for _ in range(cfg.num_requests)))
+    duration = time.monotonic() - t0
+
+    ok = [r for r in records if r.ok]
+    lat = [r.latency for r in ok]
+    ttfts = [r.ttft for r in ok if r.ttft is not None]
+    total_out = sum(r.output_tokens for r in ok)
+    tpots_ms = [
+        (r.latency - r.ttft) / max(1, r.output_tokens - 1) * 1000
+        for r in ok if r.ttft is not None and r.output_tokens > 1
+    ]
+    return LoadReport(
+        num_requests=len(records),
+        num_ok=len(ok),
+        duration_s=round(duration, 3),
+        requests_per_s=round(len(ok) / duration, 3) if duration else 0.0,
+        output_tokens_per_s=round(total_out / duration, 1) if duration else 0.0,
+        latency_p50_s=round(_percentile(lat, 50), 4),
+        latency_p90_s=round(_percentile(lat, 90), 4),
+        latency_p99_s=round(_percentile(lat, 99), 4),
+        ttft_p50_s=round(_percentile(ttfts, 50), 4),
+        ttft_p90_s=round(_percentile(ttfts, 90), 4),
+        ttft_p99_s=round(_percentile(ttfts, 99), 4),
+        tpot_mean_ms=round(sum(tpots_ms) / len(tpots_ms), 2) if tpots_ms else 0.0,
+        errors=[r.error for r in records if r.error][:10],
+    )
+
+
+def run_load_test(cfg: LoadGenConfig) -> LoadReport:
+    """Blocking entry point (used by ``scripts/benchmark_serving.py``)."""
+    return asyncio.run(_run_async(cfg))

@@ -43,7 +43,9 @@ class ByteTokenizer:
         return ids
 
     def decode(self, ids: List[int]) -> str:
-        data = bytes(i - 3 for i in ids if i >= 3)
+        # Ignore specials and out-of-vocab ids (a serving model's vocab may
+        # exceed 259; decode must never raise on sampled ids).
+        data = bytes(i - 3 for i in ids if 3 <= i < 259)
         return data.decode("utf-8", errors="replace")
 
 

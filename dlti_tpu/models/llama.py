@@ -100,7 +100,22 @@ class LlamaAttention(nn.Module):
         k = apply_rope(k, cos, sin, positions)
 
         new_cache = None
-        if cache is not None:
+        if cache is not None and "block_tables" in cache:
+            # Paged cache (serving engine): scatter K/V into the shared block
+            # pool, then attend over this sequence's gathered logical window.
+            # Stale/unallocated slots are at logical positions > the query
+            # position, so the explicit-position causal mask hides them.
+            from dlti_tpu.ops.kv_cache import paged_gather, paged_update, slot_mapping
+
+            nb, blk_size = cache["k"].shape[0], cache["k"].shape[1]
+            slots = slot_mapping(cache["block_tables"], positions, blk_size, nb)
+            new_cache = paged_update(cache, k, v, slots)
+            ck, cv = paged_gather(new_cache, cache["block_tables"])
+            out = reference_attention(
+                q, ck.astype(q.dtype), cv.astype(q.dtype),
+                causal=True, q_positions=positions,
+            )
+        elif cache is not None:
             # Fixed-capacity cache: (b, max_len, kv_heads, hd). `index` is the
             # write offset (same for the whole batch in the engine's design —
             # per-sequence offsets live in the paged serving cache instead).
@@ -210,7 +225,13 @@ class LlamaModel(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
 
         # RoPE tables sized to cache capacity when decoding, else seq len.
-        table_len = cfg.max_seq_len if cache is None else cache[0]["k"].shape[1]
+        if cache is None:
+            table_len = cfg.max_seq_len
+        elif "block_tables" in cache[0]:
+            # Paged: capacity = logical window = blocks/seq * block_size.
+            table_len = cache[0]["block_tables"].shape[1] * cache[0]["k"].shape[1]
+        else:
+            table_len = cache[0]["k"].shape[1]
         cos, sin = rope_frequencies(cfg.resolved_head_dim, table_len, cfg.rope_theta)
 
         block_cls = LlamaBlock

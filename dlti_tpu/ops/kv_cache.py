@@ -1,0 +1,97 @@
+"""Paged KV cache — device-side ops.
+
+The reference claims a vLLM serving leg ("PagedAttention, continuous
+batching", ``README.md:10``; ``requirements.txt:18``) but ships no code.
+This is the TPU-native equivalent of vLLM's block-based KV cache, designed
+for XLA's static-shape model:
+
+* One physical pool per layer: ``(num_blocks, block_size, kv_heads, head_dim)``
+  living in HBM for the whole engine lifetime (no per-request allocation).
+* A ``block_tables`` int32 array ``(batch, max_blocks_per_seq)`` maps each
+  sequence's *logical* block ``i`` to a physical block id. Logical token
+  position ``p`` lives at physical row ``block_tables[b, p // bs]`` offset
+  ``p % bs``.
+* Writes are flat scatters (``.at[...].set(mode="drop")``) — out-of-range
+  slot ids (padding tokens) are dropped, so prefill and decode share one
+  compiled update path.
+* The XLA reference read path gathers a sequence's blocks back into a
+  contiguous ``(batch, max_kv, kv_heads, head_dim)`` window; causal masking
+  against explicit positions hides stale/unallocated slots (unwritten
+  logical positions are always > the query position). The Pallas kernel
+  (``dlti_tpu.ops.pallas.paged_attention``) reads blocks in place instead.
+
+All functions are pure; the host-side block allocator lives in
+``dlti_tpu.serving.block_manager``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+
+def init_paged_cache(
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> List[dict]:
+    """Allocate the physical block pools, one ``{"k", "v"}`` dict per layer."""
+    shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(num_layers)
+    ]
+
+
+def slot_mapping(block_tables: jnp.ndarray, positions: jnp.ndarray,
+                 block_size: int, num_blocks: int) -> jnp.ndarray:
+    """Flat physical slot index for each (batch, seq) token.
+
+    ``positions`` are logical token positions; negative positions (padding)
+    map to an out-of-range slot so the scatter drops them.
+    """
+    blk = jnp.maximum(positions, 0) // block_size
+    off = jnp.maximum(positions, 0) % block_size
+    phys = jnp.take_along_axis(block_tables, blk, axis=1)
+    slots = phys * block_size + off
+    oob = num_blocks * block_size  # one past the end -> dropped by mode="drop"
+    return jnp.where(positions >= 0, slots, oob)
+
+
+def paged_update(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 slots: jnp.ndarray) -> dict:
+    """Scatter new K/V rows into the physical pool.
+
+    ``k_new``/``v_new``: (batch, s, kv_heads, head_dim); ``slots``: (batch, s)
+    flat physical slot ids from :func:`slot_mapping`.
+    """
+    k_pool, v_pool = layer_cache["k"], layer_cache["v"]
+    nb, bs, kvh, hd = k_pool.shape
+    flat = slots.reshape(-1)
+    k_flat = k_pool.reshape(nb * bs, kvh, hd)
+    v_flat = v_pool.reshape(nb * bs, kvh, hd)
+    k_flat = k_flat.at[flat].set(k_new.reshape(-1, kvh, hd).astype(k_pool.dtype),
+                                 mode="drop")
+    v_flat = v_flat.at[flat].set(v_new.reshape(-1, kvh, hd).astype(v_pool.dtype),
+                                 mode="drop")
+    return {**layer_cache, "k": k_flat.reshape(nb, bs, kvh, hd),
+            "v": v_flat.reshape(nb, bs, kvh, hd)}
+
+
+def paged_gather(layer_cache: dict, block_tables: jnp.ndarray):
+    """Gather each sequence's logical KV window from the pool.
+
+    Returns (k, v) of shape (batch, max_blocks*block_size, kv_heads, head_dim)
+    in logical order; garbage beyond a sequence's written length is masked by
+    the caller's causal/position mask.
+    """
+    k_pool, v_pool = layer_cache["k"], layer_cache["v"]
+    nb, bs, kvh, hd = k_pool.shape
+    b, max_blk = block_tables.shape
+    k = k_pool[block_tables].reshape(b, max_blk * bs, kvh, hd)
+    v = v_pool[block_tables].reshape(b, max_blk * bs, kvh, hd)
+    return k, v

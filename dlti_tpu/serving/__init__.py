@@ -1,0 +1,25 @@
+"""TPU-native serving engine.
+
+The leg the reference README claims — "High-throughput serving with vLLM and
+tensor parallelism" (``README.md:10``), ``vllm==0.6.0`` pinned at
+``requirements.txt:18`` — but never implements (SURVEY.md §0). Built here
+from scratch for TPU:
+
+* :mod:`dlti_tpu.ops.kv_cache` — paged block KV cache (device ops)
+* :mod:`dlti_tpu.serving.block_manager` — host-side block allocator
+  (C++ core via ctypes when built, pure-Python fallback)
+* :mod:`dlti_tpu.serving.sampling` — jitted sampling (greedy / temperature /
+  top-k / top-p)
+* :mod:`dlti_tpu.serving.engine` — continuous-batching inference engine:
+  bucketed prefill + single-token batched decode, one compiled program each
+* :mod:`dlti_tpu.serving.server` — OpenAI-compatible HTTP server
+"""
+
+from dlti_tpu.serving.block_manager import BlockManager  # noqa: F401
+from dlti_tpu.serving.sampling import SamplingParams, sample_tokens  # noqa: F401
+from dlti_tpu.serving.engine import (  # noqa: F401
+    EngineConfig,
+    GenerationResult,
+    InferenceEngine,
+    Request,
+)

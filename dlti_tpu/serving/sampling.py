@@ -1,0 +1,98 @@
+"""Token sampling — jitted, batched, per-request parameters.
+
+The reference's claimed serving stack (vLLM, ``README.md:10``) samples with
+per-request temperature / top-k / top-p; this is the TPU-native equivalent.
+One compiled function handles the whole decode batch: every request carries
+its own knobs as array entries, so mixed greedy/sampling batches never
+recompile.
+
+Design notes (XLA-first):
+
+* The vocab is fully sorted once per step (``lax.top_k`` over V) — O(V log V)
+  on the VPU, negligible next to the decode matmuls — and top-k/top-p become
+  rank/cumulative-probability masks in sorted space.
+* ``temperature == 0`` selects greedy via ``jnp.where`` on the same path
+  (no branch, no recompile).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling knobs (OpenAI API semantics)."""
+
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled (full vocab)
+    top_p: float = 1.0
+    max_tokens: int = 128
+    stop_token_ids: Sequence[int] = field(default_factory=tuple)
+    # Per-request seed: fixes the request's own draw stream regardless of
+    # what else shares the decode batch (engine folds it per emitted token).
+    seed: Optional[int] = None
+    # Whether the server should return logprobs in the API response (they
+    # are always computed device-side; this is a response-shaping flag).
+    logprobs: bool = False
+
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    rng: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample one token per row.
+
+    Args:
+      logits: (batch, vocab) float32.
+      rng: a single PRNG key (split per-row internally) or a batch of
+        per-row keys of shape (batch, 2) — the engine passes per-request
+        keys so ``SamplingParams.seed`` reproduces a request's draw stream
+        independent of what else is in the batch.
+      temperature: (batch,) float32; 0 => greedy (argmax).
+      top_k: (batch,) int32; 0 => disabled.
+      top_p: (batch,) float32; 1.0 => disabled.
+
+    Returns:
+      (tokens (batch,) int32, logprob of each sampled token (batch,) float32).
+    """
+    b, v = logits.shape
+    sorted_logits, sorted_idx = jax.lax.top_k(logits, v)  # descending
+
+    # Scale by temperature (guard 0 for the greedy rows).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = sorted_logits / safe_t
+
+    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+    k = jnp.where(top_k > 0, top_k, v).astype(jnp.int32)[:, None]
+    keep = ranks < k
+
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # Keep tokens while cumulative prob *before* this token < top_p
+    # (always keeps the head token).
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep &= cum_before < top_p[:, None]
+
+    masked = jnp.where(keep, scaled, -jnp.inf)
+    rngs = rng if rng.ndim == 2 else jax.random.split(rng, b)
+    sampled_rank = jax.vmap(lambda r, lg: jax.random.categorical(r, lg))(rngs, masked)
+
+    greedy_rank = jnp.zeros((b,), jnp.int32)  # sorted descending -> rank 0
+    rank = jnp.where(temperature > 0, sampled_rank, greedy_rank)
+    tokens = jnp.take_along_axis(sorted_idx, rank[:, None], axis=1)[:, 0]
+
+    # Log-prob of the chosen token under the *unmasked, unscaled* distribution
+    # (what the OpenAI API reports).
+    logz = jax.nn.logsumexp(sorted_logits, axis=-1)
+    chosen_logit = jnp.take_along_axis(sorted_logits, rank[:, None], axis=1)[:, 0]
+    return tokens.astype(jnp.int32), chosen_logit - logz

@@ -1,0 +1,405 @@
+"""OpenAI-compatible HTTP server over the continuous-batching engine.
+
+Closes the reference's claimed-but-absent serving leg: "High-throughput
+serving with vLLM and tensor parallelism" (``README.md:10``), "REST API"
+(``README.md:16``) — no code in the reference repo (SURVEY.md §0). Endpoints
+mirror the vLLM/OpenAI surface the reference's pins imply:
+
+* ``POST /v1/completions``        — text completion, optional SSE streaming
+* ``POST /v1/chat/completions``   — chat with the Llama-2 template the
+  reference's data pipeline defines (``scripts/prepare_dataset.py:12-25``:
+  ``<s>[INST] {q} [/INST] {a}</s>``)
+* ``GET /v1/models`` · ``GET /health`` · ``GET /stats``
+
+Stdlib only (``http.server`` + threads): the engine steps in one background
+thread (the TPU is a single serialized stream anyway); handler threads block
+on per-request token queues. No aiohttp/FastAPI dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from dlti_tpu.data.tokenizer import Tokenizer
+from dlti_tpu.serving.engine import InferenceEngine, Request
+from dlti_tpu.serving.sampling import SamplingParams
+from dlti_tpu.utils.logging import get_logger
+
+
+def llama2_chat_prompt(messages: List[dict]) -> str:
+    """Messages -> Llama-2 chat string (the reference's training format,
+    ``scripts/prepare_dataset.py:12-25``), so serve-time prompts match the
+    fine-tuning distribution."""
+    system = ""
+    turns: List[Tuple[str, str]] = []  # (user, assistant?) pairs
+    pending_user: Optional[str] = None
+    for m in messages:
+        role, content = m.get("role"), m.get("content", "")
+        if role == "system":
+            system = content
+        elif role == "user":
+            if pending_user is not None:
+                turns.append((pending_user, ""))
+            pending_user = content
+        elif role == "assistant":
+            turns.append((pending_user or "", content))
+            pending_user = None
+    if pending_user is not None:
+        turns.append((pending_user, None))
+
+    out = []
+    first = True
+    for user, assistant in turns:
+        u = user
+        if first and system:
+            u = f"<<SYS>>\n{system}\n<</SYS>>\n\n{user}"
+        first = False
+        if assistant is None:
+            out.append(f"[INST] {u} [/INST]")
+        else:
+            out.append(f"[INST] {u} [/INST] {assistant}")
+    return " ".join(out)
+
+
+class AsyncEngine:
+    """Thread-safe facade: a single stepper thread drives the engine;
+    callers get a per-request event queue for streaming."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self.logger = get_logger()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queues: Dict[str, queue.Queue] = {}
+        self._seen: Dict[str, int] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dlti-engine-stepper")
+        self._thread.start()
+
+    def submit(self, prompt_ids: List[int], params: SamplingParams,
+               request_id: Optional[str] = None) -> Tuple[Request, queue.Queue]:
+        """Enqueue a request; returns (request, event queue).
+
+        Queue events: ``("token", token_id, logprob)`` per generated token,
+        then ``("done", finish_reason)`` — or ``("error", message)``.
+        """
+        q: queue.Queue = queue.Queue()
+        with self._work:
+            req = self.engine.submit(prompt_ids, params, request_id)
+            self._queues[req.request_id] = q
+            self._seen[req.request_id] = 0
+            self._work.notify()
+        return req, q
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify()
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and not self.engine.has_work:
+                    self._work.wait()
+                if self._stop:
+                    for q in self._queues.values():
+                        q.put(("error", "server shutting down"))
+                    return
+                try:
+                    self.engine.step()
+                except Exception as e:  # surface engine faults to all waiters
+                    self.logger.exception("engine step failed")
+                    for q in self._queues.values():
+                        q.put(("error", f"{type(e).__name__}: {e}"))
+                    self._queues.clear()
+                    self._seen.clear()
+                    continue
+                self._drain_events()
+
+    def _drain_events(self) -> None:
+        """Push tokens generated since the last step to per-request queues."""
+        live = list(self.engine.slots)
+        reqs = [s.request for s in live if s.request is not None]
+        reqs.extend(r for r in list(self.engine.finished)
+                    if r.request_id in self._queues)
+        for req in reqs:
+            q = self._queues.get(req.request_id)
+            if q is None:
+                continue
+            seen = self._seen.get(req.request_id, 0)
+            for i in range(seen, len(req.output_token_ids)):
+                q.put(("token", req.output_token_ids[i], req.output_logprobs[i]))
+            self._seen[req.request_id] = len(req.output_token_ids)
+            if req.done:
+                q.put(("done", req.finish_reason))
+                del self._queues[req.request_id]
+                del self._seen[req.request_id]
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8000
+    model_name: str = "dlti-tpu-model"
+    request_timeout_s: float = 600.0
+    default_params: SamplingParams = field(default_factory=SamplingParams)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One instance per connection (ThreadingHTTPServer)."""
+
+    server_version = "dlti-tpu"
+    protocol_version = "HTTP/1.1"
+
+    # Injected via functools-partial-style subclassing in serve().
+    async_engine: AsyncEngine
+    tokenizer: Tokenizer
+    cfg: ServerConfig
+
+    def log_message(self, fmt, *args):  # route through our logger
+        get_logger().debug("http: " + fmt, *args)
+
+    # -- helpers -------------------------------------------------------
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+
+    def _read_body(self) -> Optional[dict]:
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._error(400, "invalid JSON body")
+            return None
+
+    def _params_from(self, body: dict) -> SamplingParams:
+        d = self.cfg.default_params
+        stop_ids = tuple(body.get("stop_token_ids", ()))
+        return SamplingParams(
+            temperature=float(body.get("temperature", d.temperature)),
+            top_k=int(body.get("top_k", d.top_k)),
+            top_p=float(body.get("top_p", d.top_p)),
+            max_tokens=int(body.get("max_tokens", d.max_tokens)),
+            stop_token_ids=stop_ids,
+            seed=body.get("seed"),
+            logprobs=bool(body.get("logprobs", False)),
+        )
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/health":
+            self._json(200, {"status": "ok"})
+        elif self.path == "/stats":
+            eng = self.async_engine.engine
+            self._json(200, {
+                **eng.stats,
+                "active_seqs": eng.num_active,
+                "waiting": len(eng.waiting),
+                "free_blocks": eng.block_manager.num_free,
+            })
+        elif self.path == "/v1/models":
+            self._json(200, {"object": "list", "data": [{
+                "id": self.cfg.model_name, "object": "model",
+                "owned_by": "dlti_tpu",
+            }]})
+        else:
+            self._error(404, f"no route {self.path}")
+
+    def do_POST(self):
+        if self.path == "/v1/completions":
+            self._completions(chat=False)
+        elif self.path == "/v1/chat/completions":
+            self._completions(chat=True)
+        else:
+            self._error(404, f"no route {self.path}")
+
+    # -- completion core ----------------------------------------------
+    def _completions(self, chat: bool) -> None:
+        body = self._read_body()
+        if body is None:
+            return
+        tok = self.tokenizer
+        if chat:
+            messages = body.get("messages")
+            if not isinstance(messages, list) or not messages:
+                return self._error(400, "messages must be a non-empty list")
+            prompt = llama2_chat_prompt(messages)
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            if not isinstance(prompt, str) or not prompt:
+                return self._error(400, "prompt must be a non-empty string")
+
+        prompt_ids = tok.encode(prompt, add_bos=True)
+        params = self._params_from(body)
+        max_len = self.async_engine.engine.cfg.max_model_len
+        if len(prompt_ids) >= max_len:
+            return self._error(400, f"prompt has {len(prompt_ids)} tokens; "
+                                    f"max_model_len is {max_len}")
+
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(time.time())
+        try:
+            req, q = self.async_engine.submit(prompt_ids, params, rid)
+        except ValueError as e:
+            return self._error(400, str(e))
+
+        if body.get("stream"):
+            self._stream_response(req, q, chat, created)
+        else:
+            self._full_response(req, q, chat, created)
+
+    def _collect(self, q: queue.Queue):
+        """Yield events until done/error/timeout."""
+        deadline = time.monotonic() + self.cfg.request_timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                yield ("error", "request timed out")
+                return
+            try:
+                ev = q.get(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            yield ev
+            if ev[0] in ("done", "error"):
+                return
+
+    def _full_response(self, req: Request, q: queue.Queue, chat: bool,
+                       created: int) -> None:
+        token_ids: List[int] = []
+        logprobs: List[float] = []
+        finish = "stop"
+        for ev in self._collect(q):
+            if ev[0] == "token":
+                token_ids.append(ev[1])
+                logprobs.append(ev[2])
+            elif ev[0] == "done":
+                finish = ev[1]
+            else:
+                return self._error(500, ev[1])
+        text = self.tokenizer.decode(token_ids)
+        usage = {
+            "prompt_tokens": len(req.prompt_token_ids),
+            "completion_tokens": len(token_ids),
+            "total_tokens": len(req.prompt_token_ids) + len(token_ids),
+        }
+        if chat:
+            choice = {"index": 0, "message": {"role": "assistant", "content": text},
+                      "finish_reason": finish}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish}
+            obj = "text_completion"
+        if req.params.logprobs:
+            choice["logprobs"] = {"token_logprobs": logprobs,
+                                  "tokens": token_ids}
+        self._json(200, {
+            "id": req.request_id, "object": obj, "created": created,
+            "model": self.cfg.model_name, "choices": [choice], "usage": usage,
+        })
+
+    def _stream_response(self, req: Request, q: queue.Queue, chat: bool,
+                         created: int) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: str) -> None:
+            payload = f"data: {data}\n\n".encode()
+            self.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+            self.wfile.flush()
+
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        # Incremental detokenization: decode the full id list and emit the
+        # suffix, so multi-token unicode never splits mid-character.
+        token_ids: List[int] = []
+        emitted = ""
+        finish = None
+        try:
+            if chat:
+                chunk(json.dumps({
+                    "id": req.request_id, "object": obj, "created": created,
+                    "model": self.cfg.model_name,
+                    "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                 "finish_reason": None}]}))
+            for ev in self._collect(q):
+                if ev[0] == "token":
+                    token_ids.append(ev[1])
+                    text = self.tokenizer.decode(token_ids)
+                    delta, emitted = text[len(emitted):], text
+                    if not delta:
+                        continue  # partial unicode; wait for more tokens
+                    key = "delta" if chat else "text"
+                    val = {"content": delta} if chat else delta
+                    chunk(json.dumps({
+                        "id": req.request_id, "object": obj, "created": created,
+                        "model": self.cfg.model_name,
+                        "choices": [{"index": 0, key: val, "finish_reason": None}]}))
+                elif ev[0] == "done":
+                    finish = ev[1]
+                else:
+                    chunk(json.dumps({"error": {"message": ev[1]}}))
+                    break
+            if finish is not None:
+                key = "delta" if chat else "text"
+                val = {} if chat else ""
+                chunk(json.dumps({
+                    "id": req.request_id, "object": obj, "created": created,
+                    "model": self.cfg.model_name,
+                    "choices": [{"index": 0, key: val, "finish_reason": finish}]}))
+            chunk("[DONE]")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            get_logger().info("client disconnected mid-stream: %s", req.request_id)
+
+
+def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
+                cfg: Optional[ServerConfig] = None,
+                ) -> Tuple[ThreadingHTTPServer, AsyncEngine]:
+    """Build (but don't start) the HTTP server; caller runs serve_forever()."""
+    cfg = cfg or ServerConfig()
+    async_engine = AsyncEngine(engine)
+
+    handler = type("BoundHandler", (_Handler,), {
+        "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
+    })
+    httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
+    httpd.daemon_threads = True
+    return httpd, async_engine
+
+
+def serve(engine: InferenceEngine, tokenizer: Tokenizer,
+          cfg: Optional[ServerConfig] = None) -> None:
+    """Blocking entry point (used by ``scripts/serve.py``)."""
+    cfg = cfg or ServerConfig()
+    httpd, async_engine = make_server(engine, tokenizer, cfg)
+    get_logger().info("serving on http://%s:%d (model=%s)",
+                      cfg.host, cfg.port, cfg.model_name)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        async_engine.shutdown()
+        httpd.server_close()

@@ -1,0 +1,56 @@
+"""Loader for the optional native (C++) runtime library.
+
+The reference outsources its native runtime to external wheels (torch/NCCL/
+DeepSpeed ops — SURVEY.md §2b); ours is in-tree under ``native/`` and built
+with ``make -C native``. Everything degrades gracefully to pure Python when
+the library hasn't been built, so tests and CPU smoke runs never require a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "native", "libdlti_runtime.so")
+
+
+def load_native_runtime() -> Optional[ctypes.CDLL]:
+    """Return the loaded native runtime, or None if unavailable.
+
+    Set ``DLTI_DISABLE_NATIVE=1`` to force the pure-Python paths (used by
+    tests to cover both implementations).
+    """
+    global _LIB, _TRIED
+    if os.environ.get("DLTI_DISABLE_NATIVE") == "1":
+        return None
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    # Allocator ABI.
+    lib.dlti_allocator_create.argtypes = [ctypes.c_int32]
+    lib.dlti_allocator_create.restype = ctypes.c_void_p
+    lib.dlti_allocator_destroy.argtypes = [ctypes.c_void_p]
+    lib.dlti_allocator_num_free.argtypes = [ctypes.c_void_p]
+    lib.dlti_allocator_num_free.restype = ctypes.c_int32
+    lib.dlti_allocator_allocate.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    lib.dlti_allocator_allocate.restype = ctypes.c_int32
+    lib.dlti_allocator_free.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    _LIB = lib
+    return _LIB

@@ -1,0 +1,202 @@
+"""HTTP server + load generator tests (tiny model, ephemeral port, CPU).
+
+End-to-end over real sockets: OpenAI-compatible routes, streaming SSE,
+chat templating, the async engine facade, and the Locust-equivalent load
+generator driving the live server.
+"""
+
+import http.client
+import json
+import subprocess
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlti_tpu.config import MODEL_PRESETS
+from dlti_tpu.data.tokenizer import ByteTokenizer
+from dlti_tpu.models import LlamaForCausalLM
+from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+from dlti_tpu.serving.server import ServerConfig, llama2_chat_prompt, make_server
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    model = LlamaForCausalLM(CFG, None)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=128, max_model_len=128,
+                      cache_dtype="float32", eos_token_id=-1)
+    engine = InferenceEngine(CFG, params, ec)
+    tok = ByteTokenizer()
+    httpd, async_engine = make_server(
+        engine, tok, ServerConfig(host="127.0.0.1", port=0,
+                                  default_params=SamplingParams(max_tokens=8)))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield "127.0.0.1", port
+    httpd.shutdown()
+    async_engine.shutdown()
+    httpd.server_close()
+
+
+def _post(host, port, path, body):
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _get(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return resp.status, data
+
+
+def test_health_models_stats(live_server):
+    host, port = live_server
+    assert _get(host, port, "/health") == (200, {"status": "ok"})
+    status, models = _get(host, port, "/v1/models")
+    assert status == 200 and models["data"][0]["id"] == "dlti-tpu-model"
+    status, stats = _get(host, port, "/stats")
+    assert status == 200 and "free_blocks" in stats
+
+
+def test_completions_roundtrip(live_server):
+    host, port = live_server
+    status, data = _post(host, port, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 6, "temperature": 0.0,
+    })
+    assert status == 200, data
+    obj = json.loads(data)
+    assert obj["object"] == "text_completion"
+    assert obj["usage"]["completion_tokens"] == 6
+    assert obj["choices"][0]["finish_reason"] == "length"
+    assert isinstance(obj["choices"][0]["text"], str)
+
+
+def test_completions_deterministic_greedy(live_server):
+    host, port = live_server
+    body = {"prompt": "abc", "max_tokens": 5, "temperature": 0.0}
+    _, d1 = _post(host, port, "/v1/completions", body)
+    _, d2 = _post(host, port, "/v1/completions", body)
+    assert json.loads(d1)["choices"][0]["text"] == json.loads(d2)["choices"][0]["text"]
+
+
+def test_chat_completions(live_server):
+    host, port = live_server
+    status, data = _post(host, port, "/v1/chat/completions", {
+        "messages": [{"role": "system", "content": "Be brief."},
+                     {"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0.0,
+    })
+    assert status == 200, data
+    obj = json.loads(data)
+    assert obj["object"] == "chat.completion"
+    assert obj["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_streaming_sse(live_server):
+    host, port = live_server
+    conn = http.client.HTTPConnection(*live_server, timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": "xy", "max_tokens": 5, "temperature": 0.0, "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    conn.close()
+    events = [l[5:].strip() for l in raw.splitlines() if l.startswith("data:")]
+    assert events[-1] == "[DONE]"
+    finals = [json.loads(e) for e in events[:-1]]
+    assert any(c["choices"][0]["finish_reason"] == "length" for c in finals)
+
+
+def test_error_paths(live_server):
+    host, port = live_server
+    status, data = _post(host, port, "/v1/completions", {"prompt": ""})
+    assert status == 400
+    status, _ = _post(host, port, "/v1/chat/completions", {"messages": []})
+    assert status == 400
+    status, _ = _post(host, port, "/nope", {})
+    assert status == 404
+    # Prompt longer than max_model_len rejected cleanly.
+    status, data = _post(host, port, "/v1/completions",
+                         {"prompt": "z" * 500, "max_tokens": 2})
+    assert status == 400
+    assert b"max_model_len" in data
+
+
+def test_llama2_chat_template():
+    """Serve-time template must match the training format contract
+    (scripts/prepare_dataset.py:12-25: "<s>[INST] q [/INST] a</s>")."""
+    s = llama2_chat_prompt([{"role": "user", "content": "Q1"}])
+    assert s == "[INST] Q1 [/INST]"
+    s = llama2_chat_prompt([
+        {"role": "system", "content": "SYS"},
+        {"role": "user", "content": "Q1"},
+        {"role": "assistant", "content": "A1"},
+        {"role": "user", "content": "Q2"},
+    ])
+    assert s == "[INST] <<SYS>>\nSYS\n<</SYS>>\n\nQ1 [/INST] A1 [INST] Q2 [/INST]"
+
+
+def test_loadgen_against_live_server(live_server):
+    from dlti_tpu.benchmarks import LoadGenConfig, run_load_test
+
+    host, port = live_server
+    report = run_load_test(LoadGenConfig(
+        host=host, port=port, num_requests=8, concurrency=4,
+        max_tokens=4, stream=True, prompt="bench", timeout_s=120))
+    assert report.num_ok == 8, report.errors
+    assert report.output_tokens_per_s > 0
+    assert report.ttft_p50_s > 0
+    assert report.latency_p99_s >= report.latency_p50_s
+
+    # Non-streaming path exercises usage-based token accounting.
+    report = run_load_test(LoadGenConfig(
+        host=host, port=port, num_requests=4, concurrency=2,
+        max_tokens=4, stream=False, prompt="bench", timeout_s=120))
+    assert report.num_ok == 4, report.errors
+    assert report.output_tokens_per_s > 0
+
+
+def test_native_allocator_contract(tmp_path):
+    """C++ allocator obeys the same contract as the Python fallback."""
+    import os
+    from dlti_tpu.utils import native as native_mod
+
+    so = native_mod._lib_path()
+    if not os.path.exists(so):
+        r = subprocess.run(["make", "-C", os.path.dirname(so)],
+                           capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("native toolchain unavailable")
+    # Fresh load (bypass module cache).
+    native_mod._TRIED = False
+    native_mod._LIB = None
+    lib = native_mod.load_native_runtime()
+    assert lib is not None
+
+    from dlti_tpu.serving import BlockManager
+
+    bm = BlockManager(num_blocks=8, block_size=4)
+    assert bm._native is not None
+    assert bm.num_free == 7
+    a = bm.allocate(3)
+    assert a is not None and len(set(a)) == 3 and 0 not in a
+    assert bm.allocate(5) is None
+    assert bm.num_free == 4
+    bm.free(a)
+    assert bm.num_free == 7
