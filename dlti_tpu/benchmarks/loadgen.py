@@ -91,6 +91,41 @@ def _percentile(xs: List[float], p: float) -> float:
     return xs[i]
 
 
+async def _iter_body(reader, headers: dict, timeout_s: float):
+    """Yield decoded body byte chunks, honoring Transfer-Encoding: chunked
+    (RFC 9112 §7.1) so framing never corrupts the payload — against servers
+    beyond the in-repo one (which uses Content-Length), chunk-size lines
+    would otherwise interleave with the JSON/SSE bytes."""
+    if "chunked" in headers.get("transfer-encoding", "").lower():
+        while True:
+            size_line = await asyncio.wait_for(reader.readline(), timeout_s)
+            if not size_line:
+                return  # truncated stream
+            try:
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+            except ValueError:
+                return
+            if size == 0:
+                # Drain trailer section up to the blank line.
+                while True:
+                    t = await asyncio.wait_for(reader.readline(), timeout_s)
+                    if t in (b"\r\n", b"\n", b""):
+                        return
+            data = await asyncio.wait_for(reader.readexactly(size), timeout_s)
+            yield data
+            await asyncio.wait_for(reader.readline(), timeout_s)  # CRLF
+    elif "content-length" in headers:
+        n = int(headers["content-length"])
+        if n > 0:
+            yield await asyncio.wait_for(reader.readexactly(n), timeout_s)
+    else:
+        while True:
+            chunk = await asyncio.wait_for(reader.read(4096), timeout_s)
+            if not chunk:
+                return
+            yield chunk
+
+
 async def _http_post_sse(host: str, port: int, path: str, body: dict,
                          rec: RequestRecord, timeout_s: float) -> None:
     """POST; if the response is SSE, count data chunks and stamp TTFT."""
@@ -105,7 +140,11 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
         await writer.drain()
 
         status_line = await asyncio.wait_for(reader.readline(), timeout_s)
-        status = int(status_line.split()[1])
+        parts = status_line.split()
+        if len(parts) < 2:
+            rec.error = f"malformed/empty status line: {status_line[:80]!r}"
+            return
+        status = int(parts[1])
         headers = {}
         while True:
             line = await asyncio.wait_for(reader.readline(), timeout_s)
@@ -115,18 +154,15 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             headers[k.strip().lower()] = v.strip()
 
         if status != 200:
-            raw = await asyncio.wait_for(reader.read(), timeout_s)
+            raw = b"".join([c async for c in _iter_body(reader, headers, timeout_s)])
             rec.error = f"HTTP {status}: {raw[:200].decode(errors='replace')}"
             return
 
         if headers.get("content-type", "").startswith("text/event-stream"):
-            # SSE over chunked transfer: scan for `data:` lines.
+            # SSE: scan dechunked stream for `data:` lines.
             n_data = 0
             buf = b""
-            while True:
-                chunk = await asyncio.wait_for(reader.read(4096), timeout_s)
-                if not chunk:
-                    break
+            async for chunk in _iter_body(reader, headers, timeout_s):
                 buf += chunk
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
@@ -154,15 +190,13 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             rec.output_tokens = n_data
             rec.ok = rec.ok or n_data > 0
         else:
-            raw = await asyncio.wait_for(reader.read(), timeout_s)
-            # Strip chunked framing if present.
-            text = raw.decode(errors="replace")
-            start = text.find("{")
-            obj = json.loads(text[start:text.rfind("}") + 1])
+            raw = b"".join([c async for c in _iter_body(reader, headers, timeout_s)])
+            obj = json.loads(raw)
             usage = obj.get("usage", {})
             rec.output_tokens = int(usage.get("completion_tokens", 0))
             rec.ok = True
-    except (asyncio.TimeoutError, OSError, ValueError, json.JSONDecodeError) as e:
+    except Exception as e:  # noqa: BLE001 — one request's failure is a
+        # recorded data point, never a crash of the whole load test.
         rec.error = f"{type(e).__name__}: {e}"
     finally:
         rec.end = time.monotonic()
@@ -205,10 +239,11 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         for _ in range(cfg.num_requests):
             tasks.append(asyncio.create_task(one()))
             await asyncio.sleep(rng.expovariate(cfg.qps))
-        await asyncio.gather(*tasks)
+        await asyncio.gather(*tasks, return_exceptions=True)
     else:
         # Closed loop: `concurrency` users issuing back-to-back requests.
-        await asyncio.gather(*(one() for _ in range(cfg.num_requests)))
+        await asyncio.gather(*(one() for _ in range(cfg.num_requests)),
+                             return_exceptions=True)
     duration = time.monotonic() - t0
 
     ok = [r for r in records if r.ok]

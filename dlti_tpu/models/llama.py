@@ -64,6 +64,11 @@ def _lora_kwargs(cfg: ModelConfig, lora: Optional[LoRAConfig], name: str) -> dic
 class LlamaAttention(nn.Module):
     cfg: ModelConfig
     lora: Optional[LoRAConfig] = None
+    # Device mesh, threaded in by the parallel layer. When its 'sequence'
+    # axis is >1, training attention runs the ring schedule
+    # (dlti_tpu.parallel.ring_attention) — the reference has no SP at all
+    # (SURVEY.md §5.7); here it is first-class.
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -130,6 +135,17 @@ class LlamaAttention(nn.Module):
                 q, ck.astype(q.dtype), cv.astype(q.dtype),
                 causal=True, q_positions=positions,
             )
+        elif (self.mesh is not None and "sequence" in self.mesh.shape
+              and self.mesh.shape["sequence"] > 1 and segment_ids is None):
+            # Sequence-parallel training: exact ring attention over the
+            # 'sequence' mesh axis. RoPE positions are passed through so
+            # the ring's causal mask always agrees with the embedded
+            # positions; packed batches (segment_ids) are gated off above
+            # and rejected at config level (make_sharded_train_step).
+            from dlti_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, self.mesh, positions=positions,
+                                 causal=True)
         else:
             if cfg.attention_impl in ("flash", "auto"):
                 from dlti_tpu.ops.attention import multi_head_attention
@@ -173,12 +189,13 @@ class LlamaMLP(nn.Module):
 class LlamaBlock(nn.Module):
     cfg: ModelConfig
     lora: Optional[LoRAConfig] = None
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, segment_ids=None, cache=None,
                  deterministic: bool = True):
         cfg = self.cfg
-        attn_out, new_cache = LlamaAttention(cfg, self.lora, name="attn")(
+        attn_out, new_cache = LlamaAttention(cfg, self.lora, self.mesh, name="attn")(
             RMSNorm(cfg.rms_norm_eps, name="input_norm")(x),
             cos, sin, positions, segment_ids, cache, deterministic,
         )
@@ -204,6 +221,7 @@ class LlamaModel(nn.Module):
 
     cfg: ModelConfig
     lora: Optional[LoRAConfig] = None
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
@@ -245,7 +263,7 @@ class LlamaModel(nn.Module):
         new_caches = [] if cache is not None else None
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
-            x, layer_new_cache = block_cls(cfg, self.lora, name=f"layers_{i}")(
+            x, layer_new_cache = block_cls(cfg, self.lora, self.mesh, name=f"layers_{i}")(
                 x, cos, sin, positions, segment_ids, layer_cache, deterministic
             )
             if cache is not None:
@@ -260,13 +278,14 @@ class LlamaForCausalLM(nn.Module):
 
     cfg: ModelConfig
     lora: Optional[LoRAConfig] = None
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
                  deterministic: bool = True):
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
-        x, new_cache = LlamaModel(cfg, self.lora, name="model")(
+        x, new_cache = LlamaModel(cfg, self.lora, self.mesh, name="model")(
             input_ids, positions, segment_ids, cache, deterministic
         )
         if cfg.tie_embeddings:

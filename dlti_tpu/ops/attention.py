@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """(b, s, kv_heads, d) -> (b, s, kv_heads * n_rep, d) for GQA."""
     if n_rep == 1:
         return x
@@ -65,8 +65,8 @@ def reference_attention(
     """
     b, sq, num_heads, head_dim = q.shape
     num_kv = k.shape[2]
-    k = _repeat_kv(k, num_heads // num_kv)
-    v = _repeat_kv(v, num_heads // num_kv)
+    k = repeat_kv(k, num_heads // num_kv)
+    v = repeat_kv(v, num_heads // num_kv)
 
     scale = head_dim ** -0.5
     # (b, h, sq, skv) scores on the MXU in compute dtype, accumulated fp32.

@@ -7,6 +7,10 @@ collectives instead of NCCL all-reduce/all-gather/reduce-scatter, and
 """
 
 from dlti_tpu.parallel.mesh import MESH_AXES, build_mesh  # noqa: F401
+from dlti_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_local,
+)
 from dlti_tpu.parallel.sharding import (  # noqa: F401
     batch_pspec,
     make_global_batch,

@@ -227,6 +227,14 @@ def make_sharded_train_step(
     """
     from dlti_tpu.training.step import make_train_step
 
+    if cfg.parallel.sequence > 1 and cfg.data.pack_sequences:
+        raise ValueError(
+            "sequence parallelism (parallel.sequence > 1) does not compose "
+            "with pack_sequences: packed batches carry segment_ids, which "
+            "bypass the ring-attention path and force GSPMD to all-gather "
+            "the length-sharded activations every layer. Disable packing "
+            "or set parallel.sequence=1."
+        )
     dp = mesh.shape["data"] * mesh.shape["fsdp"]
     if cfg.train.micro_batch_size % dp != 0:
         raise ValueError(

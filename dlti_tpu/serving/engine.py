@@ -139,6 +139,17 @@ class InferenceEngine:
                 "tensor-parallel serving (mesh=...) is not wired yet; "
                 "construct the engine without a mesh"
             )
+        if engine_cfg.max_blocks_per_seq > engine_cfg.num_blocks - 1:
+            # Block 0 is the reserved trash block, so only num_blocks-1 are
+            # allocatable. A config where one max-length sequence can never
+            # fit would livelock _admit() at the FCFS head forever.
+            raise ValueError(
+                f"max_model_len={engine_cfg.max_model_len} needs "
+                f"{engine_cfg.max_blocks_per_seq} KV blocks but the pool has "
+                f"only {engine_cfg.num_blocks - 1} allocatable "
+                f"(num_blocks={engine_cfg.num_blocks} minus the reserved "
+                f"trash block); raise num_blocks or lower max_model_len"
+            )
         self.cfg = engine_cfg
         self.model_cfg = model_cfg
         self.logger = get_logger()

@@ -189,15 +189,19 @@ class _Handler(BaseHTTPRequestHandler):
             return None
 
     def _params_from(self, body: dict) -> SamplingParams:
+        # Every client-supplied field is cast here, before the request
+        # reaches the engine stepper thread — a malformed value must fail
+        # this one request with a 400, not error out every in-flight one.
         d = self.cfg.default_params
-        stop_ids = tuple(body.get("stop_token_ids", ()))
+        stop_ids = tuple(int(t) for t in body.get("stop_token_ids", ()))
+        seed = body.get("seed")
         return SamplingParams(
             temperature=float(body.get("temperature", d.temperature)),
             top_k=int(body.get("top_k", d.top_k)),
             top_p=float(body.get("top_p", d.top_p)),
             max_tokens=int(body.get("max_tokens", d.max_tokens)),
             stop_token_ids=stop_ids,
-            seed=body.get("seed"),
+            seed=int(seed) if seed is not None else None,
             logprobs=bool(body.get("logprobs", False)),
         )
 
@@ -248,7 +252,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(400, "prompt must be a non-empty string")
 
         prompt_ids = tok.encode(prompt, add_bos=True)
-        params = self._params_from(body)
+        try:
+            params = self._params_from(body)
+        except (TypeError, ValueError) as e:
+            return self._error(400, f"invalid sampling parameter: {e}")
         max_len = self.async_engine.engine.cfg.max_model_len
         if len(prompt_ids) >= max_len:
             return self._error(400, f"prompt has {len(prompt_ids)} tokens; "

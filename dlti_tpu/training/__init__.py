@@ -3,3 +3,14 @@
 from dlti_tpu.training.optimizer import build_optimizer, build_schedule  # noqa: F401
 from dlti_tpu.training.state import TrainState, create_train_state  # noqa: F401
 from dlti_tpu.training.step import make_train_step, causal_lm_loss  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy re-export: trainer.py needs dlti_tpu.parallel, which imports
+    # training.state (and hence this package) — an eager import here would
+    # re-enter the half-initialized parallel package and cycle.
+    if name == "Trainer":
+        from dlti_tpu.training.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -23,7 +23,11 @@ import numpy as np
 
 from dlti_tpu.config import Config
 from dlti_tpu.models import LlamaForCausalLM, count_params
-from dlti_tpu.parallel import build_mesh, make_sharded_train_step, shard_train_state
+# Submodule imports (not the package) so that `dlti_tpu.parallel` ->
+# `training.state` -> `dlti_tpu.training` (which re-exports Trainer) does
+# not cycle back into the half-initialized parallel package.
+from dlti_tpu.parallel.mesh import build_mesh
+from dlti_tpu.parallel.sharding import make_sharded_train_step, shard_train_state
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
 from dlti_tpu.training.step import make_train_step
@@ -43,13 +47,16 @@ class Trainer:
     def __init__(self, cfg: Config, model: Optional[LlamaForCausalLM] = None):
         self.cfg = cfg
         self.logger = get_logger()
-        self.model = model or LlamaForCausalLM(
-            cfg.model, cfg.lora if cfg.lora.enabled else None
-        )
         self.tx = build_optimizer(cfg.optimizer)
         self.mesh = None
         if cfg.parallel.num_devices > 1:
             self.mesh = build_mesh(cfg.parallel)
+        # The model needs the mesh for sequence parallelism: with
+        # parallel.sequence > 1 attention runs the ring schedule
+        # (dlti_tpu.parallel.ring_attention) over the 'sequence' axis.
+        self.model = model or LlamaForCausalLM(
+            cfg.model, cfg.lora if cfg.lora.enabled else None, self.mesh
+        )
         self._step_fn = None
         self._ckpt_mgr = None
 
@@ -153,7 +160,7 @@ class Trainer:
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
                 if self.mesh is not None:
-                    from dlti_tpu.parallel import make_global_batch
+                    from dlti_tpu.parallel.sharding import make_global_batch
 
                     batch = make_global_batch(batch, cfg, self.mesh)
                 rng, step_rng = jax.random.split(rng)

@@ -1,0 +1,37 @@
+"""Import-order independence: every subpackage must import standalone.
+
+Regression guard for the training<->parallel cycle: ``dlti_tpu.parallel``
+imports ``training.state``, whose package re-exports ``Trainer``, which
+needs the parallel layer — safe only while trainer.py imports parallel
+*submodules*, not the package. A fresh interpreter per subpackage catches
+any ordering that only works because another module imported first.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+SUBPACKAGES = [
+    "dlti_tpu",
+    "dlti_tpu.parallel",
+    "dlti_tpu.training",
+    "dlti_tpu.models",
+    "dlti_tpu.data",
+    "dlti_tpu.serving",
+    "dlti_tpu.checkpoint",
+    "dlti_tpu.ops",
+    "dlti_tpu.benchmarks",
+    "dlti_tpu.utils",
+]
+
+
+@pytest.mark.parametrize("pkg", SUBPACKAGES)
+def test_subpackage_imports_standalone(pkg):
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         f"import {pkg}"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"import {pkg} failed:\n{proc.stderr[-2000:]}"

@@ -136,6 +136,16 @@ def test_error_paths(live_server):
                          {"prompt": "z" * 500, "max_tokens": 2})
     assert status == 400
     assert b"max_model_len" in data
+    # Malformed sampling params must 400 this request, not crash the
+    # engine stepper thread (which would error out every in-flight stream).
+    for bad in ({"seed": "abc"}, {"temperature": "hot"}, {"top_k": [1]}):
+        status, data = _post(host, port, "/v1/completions",
+                             {"prompt": "hi", **bad})
+        assert status == 400, (bad, data)
+    # Server still healthy after the bad requests.
+    status, out = _post(host, port, "/v1/completions",
+                        {"prompt": "hi", "max_tokens": 2, "seed": 1})
+    assert status == 200
 
 
 def test_llama2_chat_template():

@@ -251,6 +251,16 @@ def test_engine_preemption_under_memory_pressure(tiny_model_and_params):
     assert eng.block_manager.num_free == ec.num_blocks - 1
 
 
+def test_engine_rejects_unsatisfiable_pool(tiny_model_and_params):
+    """A pool that can never hold one max-length sequence would livelock
+    the FCFS head of _admit() forever — must fail at construction."""
+    _, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=8, max_model_len=64,
+                      cache_dtype="float32")
+    with pytest.raises(ValueError, match="num_blocks"):
+        InferenceEngine(CFG, params, ec)
+
+
 def test_engine_rejects_empty_prompt(engine):
     with pytest.raises(ValueError):
         engine.submit([])
