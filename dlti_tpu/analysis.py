@@ -1,0 +1,136 @@
+"""Training-run comparison: speedup/efficiency analysis + plots.
+
+Capability parity with the reference's ``scripts/compare_training.py``
+(SURVEY.md §3.5): consume the metrics CSV written by
+:func:`dlti_tpu.utils.metrics.save_training_metrics` (same schema as the
+reference's ``results/training_metrics.csv``), derive speedup and scaling
+efficiency against the baseline row, print a comparison table and key
+findings, and render a 2x2 panel figure (training time, speedup, peak
+memory per chip, scaling efficiency vs ideal).
+
+Derivations follow the reference's definitions
+(``compare_training.py:46-47``):
+
+* ``speedup = baseline_training_time / training_time``
+* ``efficiency_percent = speedup / num_chips * 100``
+
+with the same fallback when no ``baseline`` experiment row exists: the
+first row becomes the comparison anchor (``compare_training.py:37-42``).
+TPU-native additions: tokens/sec/chip and MFU columns ride along when
+present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import pandas as pd
+
+
+def load_and_calculate(csv_path: str) -> pd.DataFrame:
+    """Load the metrics CSV and add speedup/efficiency columns."""
+    df = pd.read_csv(csv_path)
+    if df.empty:
+        raise ValueError(f"{csv_path} has no rows")
+
+    base_rows = df[df["experiment"] == "baseline"]
+    if len(base_rows):
+        base_time = float(base_rows.iloc[0]["training_time_hours"])
+    else:
+        # No baseline recorded: anchor on the first row so relative numbers
+        # are still meaningful (reference fallback, compare_training.py:37-42).
+        base_time = float(df.iloc[0]["training_time_hours"])
+
+    times = df["training_time_hours"].astype(float).replace(0.0, float("nan"))
+    df["speedup"] = base_time / times
+    df["efficiency_percent"] = df["speedup"] / df["num_gpus"].astype(float) * 100.0
+    return df
+
+
+def print_comparison_table(df: pd.DataFrame) -> None:
+    cols = [c for c in (
+        "experiment", "num_gpus", "zero_stage", "strategy",
+        "training_time_hours", "samples_per_second", "peak_memory_gb",
+        "final_loss", "speedup", "efficiency_percent",
+        "tokens_per_second_per_chip", "mfu_percent",
+    ) if c in df.columns]
+    print("=" * 72)
+    print("TRAINING COMPARISON")
+    print("=" * 72)
+    print(df[cols].round(3).to_string(index=False))
+
+
+def print_key_findings(df: pd.DataFrame) -> None:
+    base = df[df["experiment"] == "baseline"]
+    anchor = base.iloc[0] if len(base) else df.iloc[0]
+    print("\nKEY FINDINGS (vs %s)" % anchor["experiment"])
+    print("-" * 72)
+    for _, row in df.iterrows():
+        if row["experiment"] == anchor["experiment"]:
+            continue
+        saved_h = float(anchor["training_time_hours"]) - float(row["training_time_hours"])
+        dmem = float(row["peak_memory_gb"]) - float(anchor["peak_memory_gb"])
+        print(
+            f"{row['experiment']:>16}: {row['speedup']:.2f}x speedup, "
+            f"{row['efficiency_percent']:.1f}% scaling efficiency, "
+            f"{saved_h:.2f}h saved, {dmem:+.2f} GB peak memory/chip"
+        )
+
+
+def create_plots(df: pd.DataFrame, output_path: str = "results/plots/training_comparison.png") -> str:
+    """2x2 panel: time, speedup, peak memory/chip, efficiency vs ideal."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(2, 2, figsize=(13, 9))
+    names = df["experiment"].tolist()
+    x = range(len(names))
+
+    ax = axes[0][0]
+    ax.bar(x, df["training_time_hours"], color="#4878cf")
+    ax.set_title("Training time")
+    ax.set_ylabel("hours")
+
+    ax = axes[0][1]
+    ax.bar(x, df["speedup"], color="#6acc65")
+    ax.axhline(1.0, ls="--", c="gray", lw=1, label="baseline")
+    ax.set_title("Speedup vs baseline")
+    ax.set_ylabel("x")
+    ax.legend()
+
+    ax = axes[1][0]
+    ax.bar(x, df["peak_memory_gb"], color="#d65f5f")
+    ax.set_title("Peak memory per chip")
+    ax.set_ylabel("GB")
+
+    ax = axes[1][1]
+    ax.plot(df["num_gpus"], df["efficiency_percent"], "o-", label="measured")
+    ax.axhline(100.0, ls="--", c="gray", lw=1, label="ideal")
+    ax.set_title("Scaling efficiency")
+    ax.set_xlabel("chips")
+    ax.set_ylabel("%")
+    ax.legend()
+
+    for ax in (axes[0][0], axes[0][1], axes[1][0]):
+        ax.set_xticks(list(x))
+        ax.set_xticklabels(names, rotation=30, ha="right", fontsize=8)
+
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+    fig.savefig(output_path, dpi=300)
+    plt.close(fig)
+    return output_path
+
+
+def compare(csv_path: str, plot_path: Optional[str] = None) -> pd.DataFrame:
+    """Full analysis flow: load -> table -> findings -> plots."""
+    df = load_and_calculate(csv_path)
+    print_comparison_table(df)
+    print_key_findings(df)
+    if plot_path is not None:
+        out = create_plots(df, plot_path)
+        print(f"\nplots -> {out}")
+    return df

@@ -129,8 +129,11 @@ async def _iter_body(reader, headers: dict, timeout_s: float):
 async def _http_post_sse(host: str, port: int, path: str, body: dict,
                          rec: RequestRecord, timeout_s: float) -> None:
     """POST; if the response is SSE, count data chunks and stamp TTFT."""
-    reader, writer = await asyncio.open_connection(host, port)
+    writer = None
     try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout_s
+        )
         payload = json.dumps(body).encode()
         req = (f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
                f"Content-Type: application/json\r\n"
@@ -200,11 +203,12 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
         rec.error = f"{type(e).__name__}: {e}"
     finally:
         rec.end = time.monotonic()
-        writer.close()
-        try:
-            await writer.wait_closed()
-        except OSError:
-            pass
+        if writer is not None:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except OSError:
+                pass
 
 
 def _build_body(cfg: LoadGenConfig, rng: random.Random) -> Tuple[str, dict]:

@@ -200,6 +200,9 @@ class TrainConfig:
     logging_steps: int = 10  # train_baseline.py:184
     seed: int = 42
     eval_steps: int = 0  # 0 = no eval
+    # Reference metrics contract: append one row per run
+    # (training/utils.py:51-69 -> results/training_metrics.csv).
+    metrics_csv: str = "results/training_metrics.csv"
 
 
 @dataclass(frozen=True)

@@ -23,3 +23,8 @@ from dlti_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
     Request,
 )
+from dlti_tpu.serving.server import (  # noqa: F401
+    ServerConfig,
+    make_server,
+    serve,
+)

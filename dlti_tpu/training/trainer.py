@@ -201,7 +201,7 @@ class Trainer:
         )
         if is_main_process():
             print_metrics_summary(record)
-            save_training_metrics(record)
+            save_training_metrics(record, csv_path=cfg.train.metrics_csv)
         return state, record
 
     # ------------------------------------------------------------------

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Serving load-test CLI — the Locust/AsyncIO benchmark leg.
+
+The reference pins ``locust``/``aiohttp`` and claims a benchmarking layer
+(``README.md:11,17``; ``requirements.txt:35-36``) with no code (SURVEY.md
+§0). This drives :mod:`dlti_tpu.benchmarks.loadgen` against any
+OpenAI-compatible endpoint and reports throughput + latency percentiles
+(+TTFT/TPOT in streaming mode).
+
+Usage:
+    python scripts/benchmark_serving.py --port 8000 --num-requests 128 \
+        --concurrency 16 --max-tokens 64
+    python scripts/benchmark_serving.py --qps 10 --no-stream --json-out results/serving.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlti_tpu.benchmarks import LoadGenConfig, run_load_test
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="async load generator",
+                                formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--num-requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--qps", type=float, default=None,
+                   help="open-loop Poisson arrival rate (default: closed loop)")
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--prompt", default="Write a function that reverses a linked list.")
+    p.add_argument("--chat", action="store_true", help="use /v1/chat/completions")
+    p.add_argument("--no-stream", action="store_true",
+                   help="non-streaming (usage-accurate token counts, no TTFT)")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", default=None, help="also write the report as JSON")
+    args = p.parse_args()
+
+    cfg = LoadGenConfig(
+        host=args.host, port=args.port, num_requests=args.num_requests,
+        concurrency=args.concurrency, qps=args.qps, stream=not args.no_stream,
+        max_tokens=args.max_tokens, temperature=args.temperature,
+        prompt=args.prompt, chat=args.chat, timeout_s=args.timeout,
+        seed=args.seed,
+    )
+    report = run_load_test(cfg)
+    d = report.to_dict()
+    print(json.dumps(d, indent=2))
+    if args.json_out:
+        os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
+        with open(args.json_out, "w") as f:
+            json.dump(d, f, indent=2)
+        print(f"report -> {args.json_out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Training comparison CLI — reference L3 parity (``scripts/compare_training.py``).
+
+Reads the metrics CSV written by training runs (same schema as the
+reference's ``results/training_metrics.csv``), prints the comparison table
+and key findings, and renders the 2x2 comparison figure.
+
+Usage:
+    python scripts/compare_training.py
+    python scripts/compare_training.py --csv results/training_metrics.csv --no-plots
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlti_tpu.analysis import compare
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="compare training runs",
+                                formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--csv", default="results/training_metrics.csv")
+    p.add_argument("--plot-out", default="results/plots/training_comparison.png")
+    p.add_argument("--no-plots", action="store_true")
+    args = p.parse_args()
+
+    if not os.path.isfile(args.csv):
+        raise SystemExit(
+            f"{args.csv} not found — run scripts/train.py first (it appends "
+            f"one row per run)"
+        )
+    compare(args.csv, plot_path=None if args.no_plots else args.plot_out)
+
+
+if __name__ == "__main__":
+    main()

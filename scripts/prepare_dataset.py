@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Dataset preparation CLI — reference L1 parity.
+
+Mirrors the reference's ``scripts/prepare_dataset.py`` surface
+(``prepare_glaive_dataset(num_samples, output_dir)`` + CLI,
+``prepare_dataset.py:28,124-155``): fetch/ingest {question, answer} pairs,
+map them through the exact Llama-2 chat contract
+``<s>[INST] q [/INST] a</s>`` (``prepare_dataset.py:12-25``), and write an
+on-disk dataset with a single ``text`` column.
+
+Sources (first match wins):
+
+* ``--input-json FILE`` — local JSON array or JSONL of
+  ``{"question", "answer"}`` records: the offline path.
+* ``--synthetic N``     — N deterministic synthetic code-QA pairs
+  (hermetic smokes; no network, no external deps).
+* default               — download ``glaiveai/glaive-code-assistant``
+  (train split) from the HF hub, like the reference (needs network).
+
+Output: HF ``save_to_disk`` directory when the ``datasets`` package is
+importable (what ``scripts/train.py`` and the reference's
+``load_from_disk`` consume), else a ``data.jsonl`` fallback that
+``scripts/train.py`` also accepts.
+
+Usage:
+    python scripts/prepare_dataset.py --num-samples 10000 --output-dir data/glaive_code_10k
+    python scripts/prepare_dataset.py --synthetic 512 --output-dir data/synth
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlti_tpu.data import format_conversation_for_llama2
+
+
+def _synthetic_pairs(n: int) -> list:
+    """Deterministic synthetic code-QA corpus for hermetic runs."""
+    topics = ["reverse a linked list", "binary search", "merge two sorted arrays",
+              "detect a cycle in a graph", "compute a moving average",
+              "parse a CSV line", "memoize a function", "flatten a nested list"]
+    langs = ["Python", "C++", "Go", "Rust", "JavaScript"]
+    pairs = []
+    for i in range(n):
+        t, l = topics[i % len(topics)], langs[(i // len(topics)) % len(langs)]
+        pairs.append({
+            "question": f"How do I {t} in {l}? (variant {i})",
+            "answer": f"Here is one way to {t} in {l}:\n\n"
+                      f"```\n# variant {i}\ndef solution(x):\n    return x\n```",
+        })
+    return pairs
+
+
+def _load_pairs(args) -> list:
+    if args.input_json:
+        with open(args.input_json) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                records = json.load(f)
+            else:
+                records = [json.loads(line) for line in f if line.strip()]
+        return [{"question": r["question"], "answer": r["answer"]} for r in records]
+    if args.synthetic:
+        return _synthetic_pairs(args.synthetic)
+    try:
+        from datasets import load_dataset
+    except ImportError as e:
+        raise SystemExit(
+            f"`datasets` not importable ({e}); use --input-json or --synthetic"
+        )
+    print("downloading glaiveai/glaive-code-assistant (train split)...")
+    ds = load_dataset("glaiveai/glaive-code-assistant", split="train")
+    return [{"question": r["question"], "answer": r["answer"]} for r in ds]
+
+
+def prepare_dataset(args) -> str:
+    t0 = time.time()
+    pairs = _load_pairs(args)
+    if args.num_samples and args.num_samples < len(pairs):
+        pairs = pairs[: args.num_samples]
+    texts = [format_conversation_for_llama2(p)["text"] for p in pairs]
+    rate = len(texts) / max(time.time() - t0, 1e-9)
+    print(f"formatted {len(texts)} examples ({rate:,.0f} examples/s)")
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    try:
+        from datasets import Dataset
+
+        Dataset.from_dict({"text": texts}).save_to_disk(args.output_dir)
+        out = args.output_dir
+    except ImportError:
+        out = os.path.join(args.output_dir, "data.jsonl")
+        with open(out, "w") as f:
+            for t in texts:
+                f.write(json.dumps({"text": t}) + "\n")
+    total_chars = sum(len(t) for t in texts)
+    print(f"saved -> {out}  ({len(texts)} rows, {total_chars / 1e6:.1f} MB of text)")
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[1],
+                                formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--num-samples", "--num_samples", type=int, default=None,
+                   help="subsample to N examples (default: all)")
+    p.add_argument("--output-dir", "--output_dir", default="data/glaive_code_full")
+    p.add_argument("--input-json", default=None,
+                   help="local JSON/JSONL with question/answer records (offline)")
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="generate N synthetic pairs instead of downloading")
+    prepare_dataset(p.parse_args())
+
+
+if __name__ == "__main__":
+    main()

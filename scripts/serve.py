@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Serving CLI — launch the OpenAI-compatible TPU inference server.
+
+The serving leg the reference claims (vLLM + TP, ``README.md:10,16``) but
+never ships (SURVEY.md §0): paged KV cache, continuous batching, streaming
+SSE, ``/v1/completions`` + ``/v1/chat/completions``.
+
+Usage:
+    # serve a consolidated export written by scripts/train.py --export-dir
+    python scripts/serve.py --model-dir exports/run1 \
+        --tokenizer meta-llama/Llama-2-7b-hf --port 8000
+
+    # hermetic smoke: random-weight tiny model + byte tokenizer
+    python scripts/serve.py --random-init llama_tiny --tokenizer byte
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor JAX_PLATFORMS even when a site hook re-forces another platform on
+# jax import (this image pins a TPU relay).
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="TPU-native LLM server",
+                                formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--model-dir", default=None,
+                   help="consolidated export dir (scripts/train.py --export-dir)")
+    p.add_argument("--random-init", default=None, metavar="PRESET",
+                   help="serve a random-weight model preset (smoke/bench)")
+    p.add_argument("--tokenizer", default="meta-llama/Llama-2-7b-hf")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-seqs", type=int, default=8, help="decode batch slots")
+    p.add_argument("--num-blocks", type=int, default=2048, help="KV pool blocks")
+    p.add_argument("--block-size", type=int, default=16, help="tokens per KV block")
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--max-tokens-default", type=int, default=256)
+    return p.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+    if not args.model_dir and not args.random_init:
+        raise SystemExit("need --model-dir or --random-init PRESET")
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlti_tpu.data import get_tokenizer
+    from dlti_tpu.serving import (
+        EngineConfig, InferenceEngine, SamplingParams, ServerConfig, serve,
+    )
+
+    tok = get_tokenizer(args.tokenizer)
+
+    if args.model_dir:
+        from dlti_tpu.checkpoint import load_exported_model
+
+        params, cfg = load_exported_model(args.model_dir)
+        model_cfg = cfg.model
+        lora_cfg = cfg.lora if cfg.lora.enabled else None
+        print(f"loaded export {args.model_dir} "
+              f"(layers={model_cfg.num_layers}, hidden={model_cfg.hidden_size})")
+    else:
+        from dlti_tpu.config import MODEL_PRESETS
+        from dlti_tpu.models import LlamaForCausalLM
+
+        model_cfg = MODEL_PRESETS[args.random_init]
+        lora_cfg = None
+        model = LlamaForCausalLM(model_cfg, None)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        print(f"random-initialized preset {args.random_init}")
+
+    ec = EngineConfig(
+        max_seqs=args.max_seqs, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_model_len=args.max_model_len,
+        eos_token_id=tok.eos_id,
+    )
+    engine = InferenceEngine(model_cfg, params, ec, lora_cfg)
+    sc = ServerConfig(host=args.host, port=args.port,
+                      default_params=SamplingParams(max_tokens=args.max_tokens_default))
+    print(f"serving on http://{args.host}:{args.port}  "
+          f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
+    serve(engine, tok, sc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Training CLI — one entry point for the reference's four trainer scripts.
+
+The reference ships one script per strategy (``training/train_baseline.py``,
+``train_deepspeed_zero{1,2,3}.py``) with drifting argparse defaults
+(SURVEY.md §5.6). Here a single CLI selects the strategy with ``--preset``
+and the mesh with ``--num-devices/--tensor/--sequence``; everything else is
+the shared typed config tree.
+
+Examples:
+
+    # reference baseline equivalent (1 chip, LoRA r=16, accum 16)
+    python scripts/train.py --preset baseline --dataset-path data/synth \
+        --model llama2_7b --tokenizer meta-llama/Llama-2-7b-hf
+
+    # ZeRO-3 over 8 chips with TP=2 (the `deepspeed --num_gpus=8` analog)
+    python scripts/train.py --preset zero3 --num-devices 4 --tensor 2 ...
+
+    # hermetic CPU smoke (virtual 8-device mesh)
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/train.py --preset zero1 --num-devices 8 --model llama_tiny \
+        --tokenizer byte --dataset-path data/synth --max-steps 3
+
+Reference flag mapping (``train_baseline.py:27-89``): ``--model-name`` ->
+``--model`` (a preset, since weights are trained from scratch or restored
+from our checkpoints), ``--per-device-batch-size`` and grad-accum/lr/lora-r
+keep the reference defaults (1, 16, 2e-4, 16).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor JAX_PLATFORMS even when a site hook re-forces another platform on
+# jax import (this image pins a TPU relay); config.update wins as long as
+# the backend is not initialized yet.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="TPU-native LLM trainer",
+                                formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--preset", default="baseline",
+                   help="strategy: baseline | zero1 | zero2 | zero3")
+    p.add_argument("--model", default="llama2_7b",
+                   help="model preset name (see dlti_tpu.config.MODEL_PRESETS)")
+    p.add_argument("--dataset-path", "--dataset_path", default="./data/glaive_code_full",
+                   help="HF save_to_disk dir, JSONL with a `text` field, or plain-text file")
+    p.add_argument("--output-dir", "--output_dir", default="./checkpoints/run")
+    p.add_argument("--tokenizer", default="meta-llama/Llama-2-7b-hf",
+                   help="HF tokenizer name/path, or 'byte' for the hermetic byte tokenizer")
+    # Reference training defaults (train_baseline.py:27-89).
+    p.add_argument("--num-train-epochs", type=int, default=1)
+    p.add_argument("--max-steps", type=int, default=0, help="0 = full epochs")
+    p.add_argument("--per-device-batch-size", type=int, default=1)
+    p.add_argument("--gradient-accumulation-steps", type=int, default=16)
+    p.add_argument("--learning-rate", type=float, default=2e-4)
+    p.add_argument("--warmup-steps", type=int, default=100)
+    p.add_argument("--lora-r", type=int, default=16, help="0 disables LoRA (full fine-tune)")
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--pack", action="store_true",
+                   help="pack sequences to fill seq_len (perf option; reference pads)")
+    # Mesh axes (the torchrun/deepspeed --num_gpus analog).
+    p.add_argument("--num-devices", type=int, default=0,
+                   help="DP/FSDP extent; 0 = all visible devices / (tensor*sequence)")
+    p.add_argument("--tensor", type=int, default=1, help="tensor-parallel extent")
+    p.add_argument("--sequence", type=int, default=1,
+                   help="sequence-parallel (ring attention) extent")
+    p.add_argument("--offload-optimizer", action="store_true",
+                   help="ZeRO-3 host-offload parity (ds_config_zero3.json:19-23)")
+    # Checkpointing (reference: save_steps=100, keep 3 — zero1:243-245).
+    p.add_argument("--save-strategy", default="steps", choices=["steps", "epoch", "no"])
+    p.add_argument("--save-steps", type=int, default=100)
+    p.add_argument("--save-total-limit", type=int, default=3)
+    p.add_argument("--no-resume", action="store_true",
+                   help="skip the scan-latest-and-resume pass")
+    p.add_argument("--export-dir", default=None,
+                   help="write a consolidated merged-LoRA export here after training")
+    p.add_argument("--metrics-csv", default="results/training_metrics.csv")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--logging-steps", type=int, default=10)
+    return p.parse_args()
+
+
+def load_texts(path: str) -> list:
+    """Dataset dir (HF save_to_disk), JSONL with `text`, or plain text lines."""
+    if os.path.isdir(path):
+        jsonl = os.path.join(path, "data.jsonl")
+        if os.path.isfile(jsonl):
+            path = jsonl
+        else:
+            from datasets import load_from_disk
+
+            return list(load_from_disk(path)["text"])
+    with open(path) as f:
+        first = f.readline()
+        f.seek(0)
+        if first.lstrip().startswith("{"):
+            return [json.loads(line)["text"] for line in f if line.strip()]
+        return [line.rstrip("\n") for line in f if line.strip()]
+
+
+def build_config(args):
+    import jax
+
+    from dlti_tpu.config import (
+        CheckpointConfig, DataConfig, LoRAConfig, OptimizerConfig,
+        TrainConfig, ZeROStage, preset,
+    )
+
+    cfg = preset(args.preset, model=args.model)
+    par = cfg.parallel
+    n = args.num_devices or max(
+        jax.device_count() // (args.tensor * args.sequence), 1
+    )
+    if int(par.zero_stage) == 3:
+        par = par.__class__(zero_stage=par.zero_stage, fsdp=n,
+                            tensor=args.tensor, sequence=args.sequence,
+                            offload_optimizer=args.offload_optimizer)
+    else:
+        par = par.__class__(zero_stage=par.zero_stage, data=n,
+                            tensor=args.tensor, sequence=args.sequence,
+                            offload_optimizer=args.offload_optimizer)
+
+    dp = par.data * par.fsdp
+    from dlti_tpu.utils.experiment import create_experiment_name
+
+    return cfg.replace(
+        parallel=par,
+        lora=LoRAConfig(enabled=args.lora_r > 0, r=max(args.lora_r, 1),
+                        alpha=2 * max(args.lora_r, 1)),
+        optimizer=OptimizerConfig(learning_rate=args.learning_rate,
+                                  warmup_steps=args.warmup_steps),
+        data=DataConfig(dataset_path=args.dataset_path, tokenizer=args.tokenizer,
+                        max_seq_len=args.max_seq_len, pack_sequences=args.pack),
+        checkpoint=CheckpointConfig(output_dir=args.output_dir,
+                                    save_strategy=args.save_strategy,
+                                    save_steps=args.save_steps,
+                                    save_total_limit=args.save_total_limit,
+                                    resume=not args.no_resume),
+        train=TrainConfig(num_epochs=args.num_train_epochs,
+                          max_steps=args.max_steps,
+                          micro_batch_size=args.per_device_batch_size * dp,
+                          grad_accum_steps=args.gradient_accumulation_steps,
+                          logging_steps=args.logging_steps, seed=args.seed,
+                          metrics_csv=args.metrics_csv),
+        experiment_name=create_experiment_name(
+            par.num_devices, int(par.zero_stage)),
+    )
+
+
+def main() -> None:
+    args = parse_args()
+    cfg = build_config(args)
+
+    from dlti_tpu.data import get_tokenizer, make_batches
+    from dlti_tpu.training import Trainer
+
+    print(f"experiment: {cfg.experiment_name}")
+    print(f"mesh: data={cfg.parallel.data} fsdp={cfg.parallel.fsdp} "
+          f"tensor={cfg.parallel.tensor} sequence={cfg.parallel.sequence}")
+
+    texts = load_texts(args.dataset_path)
+    print(f"dataset: {len(texts)} examples from {args.dataset_path}")
+    tok = get_tokenizer(cfg.data.tokenizer)
+    dataset = make_batches(
+        texts, tok,
+        seq_len=cfg.data.max_seq_len,
+        micro_batch_size=cfg.train.micro_batch_size,
+        grad_accum_steps=cfg.train.grad_accum_steps,
+        shuffle_seed=cfg.data.shuffle_seed,
+        pack=cfg.data.pack_sequences,
+    )
+    print(f"steps/epoch: {dataset.steps_per_epoch()}")
+
+    trainer = Trainer(cfg)
+    state, record = trainer.train(dataset=dataset)
+
+    if args.export_dir:
+        from dlti_tpu.checkpoint import export_merged_model
+
+        export_merged_model(args.export_dir, state.params, cfg)
+        print(f"merged export -> {args.export_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Analysis module: reference compare_training.py derivation parity."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dlti_tpu.analysis import create_plots, load_and_calculate
+from dlti_tpu.utils.metrics import MetricsRecord, save_training_metrics
+
+
+def _write_csv(path, rows):
+    for r in rows:
+        save_training_metrics(r, csv_path=str(path))
+
+
+def _record(exp, n, stage, hours, mem=10.0):
+    return MetricsRecord(
+        experiment=exp, num_gpus=n, zero_stage=stage,
+        strategy="baseline" if stage == 0 else f"zero{stage}",
+        training_time_hours=hours, samples_per_second=1.0 / hours,
+        peak_memory_gb=mem, final_loss=0.7,
+    )
+
+
+def test_speedup_and_efficiency_derivations(tmp_path):
+    """speedup = baseline_time/time; efficiency = speedup/chips*100
+    (compare_training.py:46-47)."""
+    csv = tmp_path / "m.csv"
+    _write_csv(csv, [
+        _record("baseline", 1, 0, 10.0),
+        _record("zero2_4dev", 4, 2, 3.0),
+    ])
+    df = load_and_calculate(str(csv))
+    row = df[df["experiment"] == "zero2_4dev"].iloc[0]
+    np.testing.assert_allclose(row["speedup"], 10.0 / 3.0)
+    np.testing.assert_allclose(row["efficiency_percent"], 10.0 / 3.0 / 4 * 100)
+    base = df[df["experiment"] == "baseline"].iloc[0]
+    np.testing.assert_allclose(base["speedup"], 1.0)
+
+
+def test_missing_baseline_falls_back_to_first_row(tmp_path):
+    """Reference fallback (compare_training.py:37-42)."""
+    csv = tmp_path / "m.csv"
+    _write_csv(csv, [
+        _record("zero1_2dev", 2, 1, 6.0),
+        _record("zero3_4dev", 4, 3, 3.0),
+    ])
+    df = load_and_calculate(str(csv))
+    np.testing.assert_allclose(
+        df[df["experiment"] == "zero3_4dev"].iloc[0]["speedup"], 2.0
+    )
+
+
+def test_empty_csv_raises(tmp_path):
+    csv = tmp_path / "m.csv"
+    pd.DataFrame(columns=["experiment", "num_gpus", "training_time_hours"]).to_csv(
+        csv, index=False
+    )
+    with pytest.raises(ValueError, match="no rows"):
+        load_and_calculate(str(csv))
+
+
+def test_create_plots_writes_png(tmp_path):
+    csv = tmp_path / "m.csv"
+    _write_csv(csv, [
+        _record("baseline", 1, 0, 10.0),
+        _record("zero1_2dev", 2, 1, 6.0),
+        _record("zero3_4dev", 4, 3, 3.0),
+    ])
+    df = load_and_calculate(str(csv))
+    out = create_plots(df, str(tmp_path / "plots" / "cmp.png"))
+    import os
+
+    assert os.path.isfile(out) and os.path.getsize(out) > 10_000
