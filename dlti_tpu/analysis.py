@@ -66,8 +66,8 @@ def print_key_findings(df: pd.DataFrame) -> None:
     anchor = base.iloc[0] if len(base) else df.iloc[0]
     print("\nKEY FINDINGS (vs %s)" % anchor["experiment"])
     print("-" * 72)
-    for _, row in df.iterrows():
-        if row["experiment"] == anchor["experiment"]:
+    for idx, row in df.iterrows():
+        if idx == anchor.name:
             continue
         saved_h = float(anchor["training_time_hours"]) - float(row["training_time_hours"])
         dmem = float(row["peak_memory_gb"]) - float(anchor["peak_memory_gb"])
@@ -107,7 +107,8 @@ def create_plots(df: pd.DataFrame, output_path: str = "results/plots/training_co
     ax.set_ylabel("GB")
 
     ax = axes[1][1]
-    ax.plot(df["num_gpus"], df["efficiency_percent"], "o-", label="measured")
+    eff = df.sort_values("num_gpus")
+    ax.plot(eff["num_gpus"], eff["efficiency_percent"], "o-", label="measured")
     ax.axhline(100.0, ls="--", c="gray", lw=1, label="ideal")
     ax.set_title("Scaling efficiency")
     ax.set_xlabel("chips")

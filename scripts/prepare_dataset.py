@@ -60,7 +60,7 @@ def _synthetic_pairs(n: int) -> list:
 def _load_pairs(args) -> list:
     if args.input_json:
         with open(args.input_json) as f:
-            head = f.read(1)
+            head = f.read(256).lstrip()[:1]
             f.seek(0)
             if head == "[":
                 records = json.load(f)
@@ -106,7 +106,7 @@ def prepare_dataset(args) -> str:
 
 
 def main() -> None:
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[1],
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0],
                                 formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     p.add_argument("--num-samples", "--num_samples", type=int, default=None,
                    help="subsample to N examples (default: all)")
