@@ -1,0 +1,187 @@
+"""Multi-process / multi-host launcher — the torchrun / `deepspeed` analog.
+
+The reference never launches processes itself; it leans on ``torchrun``
+(``train_deepspeed_zero1.py:10-12``: sets LOCAL_RANK/WORLD_SIZE) and the
+``deepspeed`` CLI (``train.ipynb:640-653``: spawns N ranks with
+``--master_addr=127.0.0.1 --master_port=29500``), with SLURM claimed but
+absent (``README.md:18``). This module is the in-tree replacement:
+
+* :func:`launch_local` — spawn N local worker processes, each with the
+  ``DLTI_*`` rendezvous env (coordinator address, world size, process id);
+  on the first failure the rest are terminated and the worst return code is
+  returned (the semantics of torchrun's sigkill_handler, visible in the
+  reference's recorded crash, ``train.ipynb:826-838``).
+* :func:`slurm_env` — derive the same rendezvous env from ``SLURM_*``
+  variables so one ``srun`` task per host self-configures.
+* :func:`maybe_initialize_from_env` — called by entry points
+  (``scripts/train.py``); a no-op unless the launcher env is present, in
+  which case it runs :func:`jax.distributed.initialize` before backend use.
+
+Rendezvous env contract (the LOCAL_RANK/WORLD_SIZE/MASTER_ADDR analog):
+
+==========================  =================================================
+``DLTI_COORDINATOR``        ``host:port`` of process 0
+``DLTI_NUM_PROCESSES``      world size
+``DLTI_PROCESS_ID``         this process's id (0-based)
+==========================  =================================================
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+ENV_COORDINATOR = "DLTI_COORDINATOR"
+ENV_NUM_PROCESSES = "DLTI_NUM_PROCESSES"
+ENV_PROCESS_ID = "DLTI_PROCESS_ID"
+
+DEFAULT_PORT = 29400
+
+
+def worker_env(coordinator: str, num_processes: int, process_id: int,
+               base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = dict(os.environ if base is None else base)
+    env[ENV_COORDINATOR] = coordinator
+    env[ENV_NUM_PROCESSES] = str(num_processes)
+    env[ENV_PROCESS_ID] = str(process_id)
+    return env
+
+
+def launch_local(command: Sequence[str], num_processes: int,
+                 port: int = DEFAULT_PORT,
+                 log_dir: Optional[str] = None) -> int:
+    """Spawn ``num_processes`` copies of ``command`` on this host.
+
+    Process i gets ``DLTI_PROCESS_ID=i``; all share a localhost coordinator.
+    Output is interleaved to our stdout/stderr unless ``log_dir`` is given
+    (then ``rank{i}.out``/``.err`` per process — the ``logs/*.out``/``.err``
+    layout the reference's ``.gitignore:36-37`` implies).
+
+    Returns the worst return code; terminates stragglers once any worker
+    fails so a crashed rank can't hang the job.
+    """
+    coordinator = f"127.0.0.1:{port}"
+    procs: List[subprocess.Popen] = []
+    files = []
+    try:
+        for i in range(num_processes):
+            env = worker_env(coordinator, num_processes, i)
+            stdout = stderr = None
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                stdout = open(os.path.join(log_dir, f"rank{i}.out"), "wb")
+                stderr = open(os.path.join(log_dir, f"rank{i}.err"), "wb")
+                files += [stdout, stderr]
+            procs.append(subprocess.Popen(list(command), env=env,
+                                          stdout=stdout, stderr=stderr))
+        rcs = [None] * num_processes
+        failed = False
+        while any(rc is None for rc in rcs) and not failed:
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    try:
+                        rcs[i] = p.wait(timeout=0.25)
+                    except subprocess.TimeoutExpired:
+                        continue
+                    if rcs[i] != 0:
+                        failed = True
+        if failed:
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    p.send_signal(signal.SIGTERM)
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    try:
+                        rcs[i] = p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        rcs[i] = p.wait()
+        return max(rc for rc in rcs if rc is not None)
+    finally:
+        for f in files:
+            f.close()
+
+
+def first_slurm_node(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist, without needing ``scontrol``.
+
+    Handles plain lists (``a,b``) and compressed ranges
+    (``tpu-host[003-006,009]`` -> ``tpu-host003``).
+    """
+    head = nodelist.split(",")[0]
+    m = re.match(r"^([^\[]+)\[([^\]\-,]+)", nodelist)
+    if m:
+        prefix, first = m.group(1), m.group(2)
+        return prefix + first
+    return head
+
+
+def slurm_env(environ: Optional[Dict[str, str]] = None,
+              port: int = DEFAULT_PORT) -> Dict[str, str]:
+    """Map ``SLURM_*`` vars to the ``DLTI_*`` rendezvous contract.
+
+    Raises KeyError outside a SLURM allocation.
+    """
+    e = os.environ if environ is None else environ
+    nodelist = e.get("SLURM_JOB_NODELIST") or e["SLURM_NODELIST"]
+    coordinator = f"{first_slurm_node(nodelist)}:{port}"
+    num = int(e.get("SLURM_NTASKS") or e["SLURM_NNODES"])
+    pid = int(e.get("SLURM_PROCID") or e["SLURM_NODEID"])
+    return worker_env(coordinator, num, pid, base=dict(e))
+
+
+def maybe_initialize_from_env() -> bool:
+    """Initialize jax.distributed from the launcher env; no-op without it.
+
+    Entry points call this exactly once, before any jax backend use. Returns
+    True if multi-process init ran.
+    """
+    num = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+    if num <= 1:
+        return False
+    from dlti_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=os.environ[ENV_COORDINATOR],
+        num_processes=num,
+        process_id=int(os.environ[ENV_PROCESS_ID]),
+    )
+    return True
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``launch.py [--num-processes N | --coordinator-from-slurm] -- cmd...``"""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Process launcher (torchrun/deepspeed-CLI analog)")
+    p.add_argument("--num-processes", type=int, default=0,
+                   help="spawn N local worker processes")
+    p.add_argument("--coordinator-from-slurm", action="store_true",
+                   help="derive rendezvous from SLURM_* env and exec the "
+                        "command in-place (one srun task per host)")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="-- command to run")
+    args = p.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (use: launch.py ... -- python scripts/train.py ...)")
+    if args.coordinator_from_slurm:
+        env = slurm_env(port=args.port)
+        os.execvpe(cmd[0], list(cmd), env)  # never returns
+    if args.num_processes <= 0:
+        p.error("--num-processes N or --coordinator-from-slurm required")
+    return launch_local(cmd, args.num_processes, port=args.port,
+                        log_dir=args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Experiment-matrix orchestration — the reference L4 layer, in code.
+
+The reference drives its experiment matrix from a notebook
+(``training/train.ipynb``: baseline + ZeRO-{1,2,3} x {1,2,3,4} GPUs via
+``%%bash`` + ``deepspeed --num_gpus=N``, cells 5-33) and *claims* SLURM
+orchestration (``README.md:18``) without shipping any SLURM code
+(SURVEY.md §0, §1 L4). This module replaces both:
+
+* :func:`plan_matrix` — strategy x device-count grid -> ordered specs
+  (baseline runs single-device only, like the reference's
+  ``train_baseline.py``).
+* :func:`build_command` — one spec -> the ``scripts/train.py`` argv (the
+  ``deepspeed --num_gpus=N train_deepspeed_zeroS.py`` analog).
+* :func:`run_matrix` — executes each cell in a fresh subprocess (the
+  notebook's process-per-cell semantics: a crashed run is recorded and the
+  matrix continues — the reference's own 2-GPU NCCL crash is preserved
+  in-notebook, ``train.ipynb:794-838``), then runs the comparison analysis
+  over the shared metrics CSV.
+* :func:`emit_slurm` — writes one ``sbatch`` script per experiment plus a
+  ``submit_all.sh``, closing the README's SLURM claim with real artifacts.
+
+Each subprocess gets its own JAX backend, so a CPU-simulated mesh
+(``--simulate-devices N``) or the real TPU work identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from dlti_tpu.utils.experiment import create_experiment_name
+
+STRATEGY_STAGE = {"baseline": 0, "zero1": 1, "zero2": 2, "zero3": 3}
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentSpec:
+    """One cell of the matrix: a strategy at a device count."""
+
+    strategy: str          # baseline | zero1 | zero2 | zero3
+    num_devices: int
+    tensor: int = 1
+    sequence: int = 1
+
+    @property
+    def name(self) -> str:
+        return create_experiment_name(self.num_devices,
+                                      STRATEGY_STAGE[self.strategy])
+
+
+def plan_matrix(strategies: Sequence[str],
+                device_counts: Sequence[int],
+                tensor: int = 1,
+                sequence: int = 1) -> List[ExperimentSpec]:
+    """Strategy x device grid, reference semantics.
+
+    The baseline strategy is inherently single-device
+    (``train_baseline.py:104-108`` warns and uses one GPU), so it appears
+    once regardless of ``device_counts``; ZeRO strategies fan out over all
+    counts (the notebook's ``--num_gpus={1,2,3,4}`` loop).
+    """
+    specs: List[ExperimentSpec] = []
+    for strat in strategies:
+        if strat not in STRATEGY_STAGE:
+            raise ValueError(
+                f"unknown strategy {strat!r}; choose from {sorted(STRATEGY_STAGE)}")
+        if strat == "baseline":
+            specs.append(ExperimentSpec("baseline", 1))
+            continue
+        for n in device_counts:
+            specs.append(ExperimentSpec(strat, n, tensor=tensor,
+                                        sequence=sequence))
+    return specs
+
+
+def build_command(spec: ExperimentSpec,
+                  train_args: Dict[str, object],
+                  python: str = sys.executable,
+                  train_script: Optional[str] = None) -> List[str]:
+    """Spec -> argv for one training run.
+
+    ``train_args`` are passed through as ``--key value`` flags (underscores
+    become dashes); booleans become bare flags when true.
+    """
+    if train_script is None:
+        train_script = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "train.py")
+    cmd = [python, train_script,
+           "--preset", spec.strategy,
+           "--num-devices", str(spec.num_devices)]
+    if spec.tensor > 1:
+        cmd += ["--tensor", str(spec.tensor)]
+    if spec.sequence > 1:
+        cmd += ["--sequence", str(spec.sequence)]
+    for key, val in train_args.items():
+        flag = "--" + key.replace("_", "-")
+        if isinstance(val, bool):
+            if val:
+                cmd.append(flag)
+        elif val is not None:
+            cmd += [flag, str(val)]
+    return cmd
+
+
+def _subprocess_env(spec: ExperimentSpec,
+                    simulate_devices: int = 0) -> Dict[str, str]:
+    env = dict(os.environ)
+    if simulate_devices:
+        n = max(simulate_devices,
+                spec.num_devices * spec.tensor * spec.sequence)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}")
+    return env
+
+
+def run_matrix(specs: Sequence[ExperimentSpec],
+               train_args: Dict[str, object],
+               metrics_csv: str = "results/training_metrics.csv",
+               simulate_devices: int = 0,
+               output_root: str = "checkpoints",
+               analyze: bool = True,
+               plot_path: Optional[str] = "results/plots/training_comparison.png",
+               dry_run: bool = False,
+               log_dir: Optional[str] = "logs",
+               train_script: Optional[str] = None) -> List[dict]:
+    """Run every cell; record outcomes; never abort the matrix on one failure.
+
+    Returns one record per spec: ``{name, returncode, seconds, command}``.
+    Per-run stdout/stderr go to ``{log_dir}/{name}.out`` / ``.err`` — the
+    layout the reference's ``.gitignore:36-37`` implies its SLURM jobs used.
+    """
+    results: List[dict] = []
+    for spec in specs:
+        args = dict(train_args)
+        args.setdefault("metrics_csv", metrics_csv)
+        args["output_dir"] = os.path.join(output_root, spec.name)
+        cmd = build_command(spec, args, train_script=train_script)
+        if dry_run:
+            print(shlex.join(cmd))
+            results.append({"name": spec.name, "returncode": None,
+                            "seconds": 0.0, "command": cmd})
+            continue
+        env = _subprocess_env(spec, simulate_devices)
+        print(f"=== {spec.name}: {shlex.join(cmd)}", flush=True)
+        t0 = time.perf_counter()
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            with open(os.path.join(log_dir, f"{spec.name}.out"), "wb") as out, \
+                 open(os.path.join(log_dir, f"{spec.name}.err"), "wb") as err:
+                proc = subprocess.run(cmd, env=env, stdout=out, stderr=err)
+        else:
+            proc = subprocess.run(cmd, env=env)
+        dt = time.perf_counter() - t0
+        status = "ok" if proc.returncode == 0 else f"FAILED rc={proc.returncode}"
+        print(f"=== {spec.name}: {status} in {dt:.1f}s", flush=True)
+        results.append({"name": spec.name, "returncode": proc.returncode,
+                        "seconds": dt, "command": cmd})
+
+    if analyze and not dry_run and os.path.isfile(metrics_csv):
+        from dlti_tpu.analysis import compare
+
+        compare(metrics_csv, plot_path)
+    return results
+
+
+SBATCH_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={name}
+#SBATCH --nodes={nodes}
+#SBATCH --ntasks-per-node=1
+#SBATCH --output=logs/{name}.out
+#SBATCH --error=logs/{name}.err
+{extra_directives}
+# One task per host; every host runs the same program and discovers its
+# process id / coordinator from the launcher env (scripts/launch.py —
+# jax.distributed.initialize). This replaces the reference's claimed-but-
+# absent SLURM layer (README.md:18) and its torchrun/deepspeed launchers.
+srun {python} {launch} --coordinator-from-slurm -- {train_cmd}
+"""
+
+
+def emit_slurm(specs: Sequence[ExperimentSpec],
+               train_args: Dict[str, object],
+               out_dir: str = "slurm",
+               hosts_per_pod: int = 1,
+               partition: Optional[str] = None,
+               time_limit: Optional[str] = None) -> List[str]:
+    """Write one sbatch per spec + submit_all.sh; return the script paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    launch = os.path.join(repo, "scripts", "launch.py")
+    paths: List[str] = []
+    extra = ""
+    if partition:
+        extra += f"#SBATCH --partition={partition}\n"
+    if time_limit:
+        extra += f"#SBATCH --time={time_limit}\n"
+    for spec in specs:
+        args = dict(train_args)
+        args["output_dir"] = os.path.join("checkpoints", spec.name)
+        cmd = build_command(spec, args, python="python")
+        body = SBATCH_TEMPLATE.format(
+            name=spec.name, nodes=hosts_per_pod, extra_directives=extra,
+            python="python", launch=launch,
+            train_cmd=shlex.join(cmd[1:]))  # drop the python argv[0]
+        path = os.path.join(out_dir, f"{spec.name}.sbatch")
+        with open(path, "w") as f:
+            f.write(body)
+        paths.append(path)
+    submit = os.path.join(out_dir, "submit_all.sh")
+    with open(submit, "w") as f:
+        f.write("#!/bin/bash\nset -e\n")
+        for p in paths:
+            f.write(f"sbatch {os.path.basename(p)}\n")
+    os.chmod(submit, 0o755)
+    return paths

@@ -37,10 +37,22 @@ def build_mesh(cfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
         devices = jax.devices()
     shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence)
     n = int(np.prod(shape))
-    if n != len(devices):
+    if n > len(devices):
         raise ValueError(
             f"mesh shape {shape} needs {n} devices, have {len(devices)}"
         )
+    if n < len(devices):
+        # Single-process only: use the first n visible devices — the
+        # `deepspeed --num_gpus=N` analog of an N-wide job on a larger host
+        # (train.ipynb cells 5-33). Multi-process meshes must span every
+        # process's local devices, so there the exact count is required.
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices but {len(devices)} are "
+                f"visible across {jax.process_count()} processes; a "
+                f"multi-process mesh must use all devices"
+            )
+        devices = list(devices)[:n]
     if devices[0].platform == "tpu":
         dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     else:

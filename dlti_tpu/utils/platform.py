@@ -1,0 +1,44 @@
+"""JAX platform selection that survives this image's site hook.
+
+Exporting ``JAX_PLATFORMS`` is normally enough to pick a backend, but a
+site hook here re-forces the TPU relay plugin on jax import, so entry
+points must also win the race via ``jax.config.update`` — which only works
+before the backend initializes. Every CLI / dry-run entry point funnels
+through these helpers instead of hand-rolling the dance.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment (no-op if unset).
+
+    Call before any jax backend use in an entry point.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # backend already up; the env var had its chance
+
+
+def force_host_platform(n_devices: int) -> None:
+    """Force the CPU backend with ``n_devices`` virtual devices.
+
+    For mesh simulation (tests, dry runs). Must run before the backend
+    initializes in this process; silently loses the race otherwise, after
+    which the caller's device-count check reports the failure.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    honor_platform_env()

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Process launcher CLI — the torchrun / `deepspeed` / srun-glue analog.
+
+Examples:
+
+    # 4 local worker processes (the `torchrun --nproc_per_node=4` analog)
+    python scripts/launch.py --num-processes 4 -- \
+        python scripts/train.py --preset zero2 ...
+
+    # inside an sbatch (one srun task per host; see dlti_tpu.orchestration.emit_slurm)
+    srun python scripts/launch.py --coordinator-from-slurm -- \
+        python scripts/train.py --preset zero3 ...
+
+Workers receive DLTI_COORDINATOR / DLTI_NUM_PROCESSES / DLTI_PROCESS_ID and
+entry points pick them up via dlti_tpu.launcher.maybe_initialize_from_env().
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlti_tpu.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Experiment-matrix CLI — the reference notebook (L4), as a script.
+
+The reference drives baseline + ZeRO-{1,2,3} x {1,2,3,4} GPUs from
+``training/train.ipynb`` ``%%bash`` cells; this runs the same matrix as
+fresh subprocesses, appends every run to the shared metrics CSV, and ends
+with the comparison analysis (the ``scripts/compare_training.py`` step).
+
+Examples:
+
+    # hermetic CPU-simulated matrix (tiny model, 3 steps per cell)
+    python scripts/run_experiments.py --simulate-devices 8 \
+        --strategies baseline,zero1,zero2,zero3 --device-counts 1,2,4 \
+        --model llama_tiny --tokenizer byte --dataset-path data/synth \
+        --max-steps 3
+
+    # real-chip run of the flagship matrix
+    python scripts/run_experiments.py --strategies baseline,zero3 \
+        --device-counts 1 --model llama2_7b --dataset-path data/glaive_code_full
+
+    # emit SLURM sbatch scripts instead of running (README.md:18 parity)
+    python scripts/run_experiments.py --emit-slurm slurm/ --hosts-per-pod 4 ...
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlti_tpu.orchestration import emit_slurm, plan_matrix, run_matrix
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--strategies", default="baseline,zero1,zero2,zero3")
+    p.add_argument("--device-counts", default="1,2,4")
+    p.add_argument("--tensor", type=int, default=1)
+    p.add_argument("--sequence", type=int, default=1)
+    p.add_argument("--model", default="llama2_7b")
+    p.add_argument("--tokenizer", default="meta-llama/Llama-2-7b-hf")
+    p.add_argument("--dataset-path", default="./data/glaive_code_full")
+    p.add_argument("--max-steps", type=int, default=0)
+    p.add_argument("--num-train-epochs", type=int, default=1)
+    p.add_argument("--per-device-batch-size", type=int, default=1)
+    p.add_argument("--gradient-accumulation-steps", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--lora-r", type=int, default=16)
+    p.add_argument("--metrics-csv", default="results/training_metrics.csv")
+    p.add_argument("--output-root", default="checkpoints")
+    p.add_argument("--log-dir", default="logs")
+    p.add_argument("--simulate-devices", type=int, default=0,
+                   help="N>0: run each cell on an N-device virtual CPU mesh")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the commands without running")
+    p.add_argument("--no-analyze", action="store_true")
+    p.add_argument("--emit-slurm", default=None, metavar="DIR",
+                   help="write sbatch scripts to DIR instead of running")
+    p.add_argument("--hosts-per-pod", type=int, default=1)
+    p.add_argument("--partition", default=None)
+    p.add_argument("--time-limit", default=None)
+    args = p.parse_args()
+
+    specs = plan_matrix(
+        [s.strip() for s in args.strategies.split(",") if s.strip()],
+        [int(n) for n in args.device_counts.split(",")],
+        tensor=args.tensor, sequence=args.sequence)
+    train_args = {
+        "model": args.model,
+        "tokenizer": args.tokenizer,
+        "dataset_path": args.dataset_path,
+        "max_steps": args.max_steps,
+        "num_train_epochs": args.num_train_epochs,
+        "per_device_batch_size": args.per_device_batch_size,
+        "gradient_accumulation_steps": args.gradient_accumulation_steps,
+        "max_seq_len": args.max_seq_len,
+        "lora_r": args.lora_r,
+    }
+
+    if args.emit_slurm:
+        paths = emit_slurm(specs, train_args, out_dir=args.emit_slurm,
+                           hosts_per_pod=args.hosts_per_pod,
+                           partition=args.partition,
+                           time_limit=args.time_limit)
+        for path in paths:
+            print(path)
+        return
+
+    results = run_matrix(
+        specs, train_args, metrics_csv=args.metrics_csv,
+        simulate_devices=args.simulate_devices,
+        output_root=args.output_root, analyze=not args.no_analyze,
+        dry_run=args.dry_run, log_dir=args.log_dir)
+    failures = [r for r in results if r["returncode"] not in (0, None)]
+    if failures:
+        print(f"{len(failures)}/{len(results)} runs failed: "
+              + ", ".join(r["name"] for r in failures))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,12 +22,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Honor JAX_PLATFORMS even when a site hook re-forces another platform on
-# jax import (this image pins a TPU relay).
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from dlti_tpu.utils.platform import honor_platform_env
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_platform_env()
 
 
 def parse_args():

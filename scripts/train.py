@@ -36,13 +36,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Honor JAX_PLATFORMS even when a site hook re-forces another platform on
-# jax import (this image pins a TPU relay); config.update wins as long as
-# the backend is not initialized yet.
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from dlti_tpu.utils.platform import honor_platform_env
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_platform_env()
 
 
 def parse_args():
@@ -159,6 +155,13 @@ def build_config(args):
 
 def main() -> None:
     args = parse_args()
+
+    # Multi-host rendezvous when spawned by scripts/launch.py (the
+    # LOCAL_RANK/WORLD_SIZE contract analog); no-op single-process.
+    from dlti_tpu.launcher import maybe_initialize_from_env
+
+    maybe_initialize_from_env()
+
     cfg = build_config(args)
 
     from dlti_tpu.data import get_tokenizer, make_batches

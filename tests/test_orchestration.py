@@ -1,0 +1,167 @@
+"""Orchestration (experiment matrix, SLURM emission) + launcher tests.
+
+Reference analog: the L4 notebook matrix (``train.ipynb`` cells 5-33) and
+the torchrun/deepspeed launcher contract (SURVEY.md §2d) — which the
+reference never tests at all (§4).
+"""
+
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlti_tpu.launcher import (
+    ENV_COORDINATOR, ENV_NUM_PROCESSES, ENV_PROCESS_ID,
+    first_slurm_node, launch_local, slurm_env,
+)
+from dlti_tpu.orchestration import (
+    ExperimentSpec, build_command, emit_slurm, plan_matrix, run_matrix,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- matrix plan
+
+def test_plan_matrix_baseline_is_single_device():
+    specs = plan_matrix(["baseline", "zero2"], [1, 2, 4])
+    names = [s.name for s in specs]
+    # baseline appears once (reference train_baseline.py is 1-GPU only);
+    # zero2 fans out over every device count (the notebook's --num_gpus loop).
+    assert names == ["baseline", "zero2_1dev", "zero2_2dev", "zero2_4dev"]
+
+
+def test_plan_matrix_rejects_unknown_strategy():
+    with pytest.raises(ValueError, match="unknown strategy"):
+        plan_matrix(["zero9"], [1])
+
+
+def test_build_command_flag_mapping():
+    cmd = build_command(
+        ExperimentSpec("zero3", 4, tensor=2),
+        {"max_steps": 3, "pack": True, "no_resume": False, "model": "llama_tiny"},
+        python="PY", train_script="train.py")
+    assert cmd[:2] == ["PY", "train.py"]
+    assert ("--preset", "zero3") == (cmd[2], cmd[3])
+    assert ("--num-devices", "4") == (cmd[4], cmd[5])
+    assert "--tensor" in cmd and cmd[cmd.index("--tensor") + 1] == "2"
+    assert "--sequence" not in cmd          # extent 1 is elided
+    assert cmd[cmd.index("--max-steps") + 1] == "3"
+    assert "--pack" in cmd                  # true boolean -> bare flag
+    assert "--no-resume" not in cmd         # false boolean -> omitted
+    assert cmd[cmd.index("--model") + 1] == "llama_tiny"
+
+
+def test_run_matrix_dry_run_executes_nothing(tmp_path, capsys):
+    specs = plan_matrix(["zero1"], [2])
+    results = run_matrix(specs, {"model": "llama_tiny"}, dry_run=True,
+                         metrics_csv=str(tmp_path / "m.csv"),
+                         output_root=str(tmp_path), log_dir=None)
+    assert results[0]["returncode"] is None
+    assert "zero1" in capsys.readouterr().out
+    assert not (tmp_path / "m.csv").exists()
+
+
+def test_run_matrix_records_failure_and_continues(tmp_path):
+    """A crashed cell is recorded and the matrix keeps going — the
+    notebook's own semantics (its 2-GPU NCCL crash is preserved in-tree,
+    train.ipynb:794-838, and later cells still ran)."""
+    ok = tmp_path / "ok.py"
+    ok.write_text("import sys; sys.exit(0)\n")
+    specs = [ExperimentSpec("zero1", 1), ExperimentSpec("zero2", 1)]
+
+    # The fake trainer crashes only for the zero1 run (sniffs --preset).
+    script = tmp_path / "fake_train.py"
+    script.write_text(
+        "import sys\n"
+        "sys.exit(7 if 'zero1' in sys.argv[sys.argv.index('--preset')+1] else 0)\n")
+    results = run_matrix(specs, {}, metrics_csv=str(tmp_path / "m.csv"),
+                         output_root=str(tmp_path / "ckpt"),
+                         log_dir=str(tmp_path / "logs"), analyze=False,
+                         train_script=str(script))
+    assert [r["returncode"] for r in results] == [7, 0]
+    # per-run log files in the reference's logs/*.out|err layout
+    assert (tmp_path / "logs" / "zero1_1dev.out").exists()
+    assert (tmp_path / "logs" / "zero2_1dev.err").exists()
+
+
+# ---------------------------------------------------------------- slurm emit
+
+def test_emit_slurm_writes_sbatch_and_submit(tmp_path):
+    specs = plan_matrix(["zero3"], [8])
+    paths = emit_slurm(specs, {"model": "llama2_7b"},
+                       out_dir=str(tmp_path / "slurm"), hosts_per_pod=4,
+                       partition="tpu", time_limit="04:00:00")
+    assert len(paths) == 1
+    body = open(paths[0]).read()
+    assert "#SBATCH --job-name=zero3_8dev" in body
+    assert "#SBATCH --nodes=4" in body
+    assert "#SBATCH --partition=tpu" in body
+    assert "#SBATCH --time=04:00:00" in body
+    assert "srun" in body and "--coordinator-from-slurm" in body
+    assert "--preset zero3" in body and "--num-devices 8" in body
+    submit = tmp_path / "slurm" / "submit_all.sh"
+    assert submit.exists()
+    assert stat.S_IXUSR & os.stat(submit).st_mode
+    assert "sbatch zero3_8dev.sbatch" in submit.read_text()
+
+
+# ------------------------------------------------------------------ launcher
+
+def test_launch_local_env_contract(tmp_path):
+    """Every rank sees the rendezvous env (the LOCAL_RANK/WORLD_SIZE analog)."""
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os, pathlib\n"
+        f"d = {str(tmp_path)!r}\n"
+        "pid = os.environ['DLTI_PROCESS_ID']\n"
+        "pathlib.Path(d, 'rank'+pid).write_text(\n"
+        "    os.environ['DLTI_COORDINATOR'] + ' ' + os.environ['DLTI_NUM_PROCESSES'])\n")
+    rc = launch_local([sys.executable, str(probe)], 3, port=29555)
+    assert rc == 0
+    for i in range(3):
+        assert (tmp_path / f"rank{i}").read_text() == "127.0.0.1:29555 3"
+
+
+def test_launch_local_failure_kills_stragglers(tmp_path):
+    """First failing rank terminates the rest (torchrun sigkill semantics)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['DLTI_PROCESS_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(60)\n")
+    t0 = time.perf_counter()
+    rc = launch_local([sys.executable, str(script)], 2)
+    assert rc == 3
+    assert time.perf_counter() - t0 < 30  # did not wait out the sleep(60)
+
+
+def test_first_slurm_node_parsing():
+    assert first_slurm_node("hosta,hostb") == "hosta"
+    assert first_slurm_node("tpu-host[003-006,009]") == "tpu-host003"
+    assert first_slurm_node("nid[07,09-12]") == "nid07"
+    assert first_slurm_node("single") == "single"
+
+
+def test_slurm_env_mapping():
+    env = slurm_env({"SLURM_JOB_NODELIST": "tpu[01-04]", "SLURM_NTASKS": "4",
+                     "SLURM_PROCID": "2"}, port=1234)
+    assert env[ENV_COORDINATOR] == "tpu01:1234"
+    assert env[ENV_NUM_PROCESSES] == "4"
+    assert env[ENV_PROCESS_ID] == "2"
+
+
+def test_run_experiments_cli_dry_run():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_experiments.py"),
+         "--dry-run", "--strategies", "baseline,zero3", "--device-counts", "2",
+         "--model", "llama_tiny", "--tokenizer", "byte",
+         "--dataset-path", "ds", "--max-steps", "2", "--log-dir", ""],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "--preset baseline" in out.stdout
+    assert "--preset zero3" in out.stdout and "--num-devices 2" in out.stdout
