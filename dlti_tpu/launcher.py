@@ -79,17 +79,17 @@ def launch_local(command: Sequence[str], num_processes: int,
             procs.append(subprocess.Popen(list(command), env=env,
                                           stdout=stdout, stderr=stderr))
         rcs = [None] * num_processes
-        failed = False
-        while any(rc is None for rc in rcs) and not failed:
+        first_bad_rc = None
+        while any(rc is None for rc in rcs) and first_bad_rc is None:
             for i, p in enumerate(procs):
                 if rcs[i] is None:
                     try:
                         rcs[i] = p.wait(timeout=0.25)
                     except subprocess.TimeoutExpired:
                         continue
-                    if rcs[i] != 0:
-                        failed = True
-        if failed:
+                    if rcs[i] != 0 and first_bad_rc is None:
+                        first_bad_rc = rcs[i]
+        if first_bad_rc is not None:
             for i, p in enumerate(procs):
                 if rcs[i] is None:
                     p.send_signal(signal.SIGTERM)
@@ -100,8 +100,15 @@ def launch_local(command: Sequence[str], num_processes: int,
                     except subprocess.TimeoutExpired:
                         p.kill()
                         rcs[i] = p.wait()
-        return max(rc for rc in rcs if rc is not None)
+            # The rc that *triggered* teardown, not the -15s from our own
+            # SIGTERMs — and never max(), which masks signal codes (-11)
+            # behind a clean 0 from an already-finished rank.
+            return first_bad_rc
+        return next((rc for rc in rcs if rc), 0)
     finally:
+        for p in procs:
+            if p.poll() is None:  # spawn-loop exception / interrupt: no orphans
+                p.kill()
         for f in files:
             f.close()
 
@@ -109,14 +116,24 @@ def launch_local(command: Sequence[str], num_processes: int,
 def first_slurm_node(nodelist: str) -> str:
     """First hostname of a SLURM nodelist, without needing ``scontrol``.
 
-    Handles plain lists (``a,b``) and compressed ranges
-    (``tpu-host[003-006,009]`` -> ``tpu-host003``).
+    Handles plain lists (``a,b``), compressed ranges
+    (``tpu-host[003-006,009]`` -> ``tpu-host003``), and mixes of both
+    (``alpha,tpu[01-04]`` -> ``alpha``): the first entry ends at the first
+    top-level comma (commas inside ``[...]`` don't split entries).
     """
-    head = nodelist.split(",")[0]
-    m = re.match(r"^([^\[]+)\[([^\]\-,]+)", nodelist)
+    depth = 0
+    head = nodelist
+    for i, ch in enumerate(nodelist):
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            head = nodelist[:i]
+            break
+    m = re.match(r"^([^\[]+)\[([^\]\-,]+)", head)
     if m:
-        prefix, first = m.group(1), m.group(2)
-        return prefix + first
+        return m.group(1) + m.group(2)
     return head
 
 

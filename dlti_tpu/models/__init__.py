@@ -7,3 +7,14 @@ from dlti_tpu.models.lora import (  # noqa: F401
     merge_lora_params,
     count_params,
 )
+from dlti_tpu.models.hf_interop import (  # noqa: F401
+    config_from_hf,
+    config_to_hf,
+    graft_base_params,
+    load_hf_checkpoint,
+    load_peft_adapter,
+    params_from_hf_state_dict,
+    hf_state_dict_from_params,
+    save_hf_checkpoint,
+    save_peft_adapter,
+)

@@ -112,13 +112,11 @@ def _subprocess_env(spec: ExperimentSpec,
                     simulate_devices: int = 0) -> Dict[str, str]:
     env = dict(os.environ)
     if simulate_devices:
+        from dlti_tpu.utils.platform import host_platform_env
+
         n = max(simulate_devices,
                 spec.num_devices * spec.tensor * spec.sequence)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = env.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={n}")
+        host_platform_env(n, env)
     return env
 
 
@@ -207,10 +205,12 @@ def emit_slurm(specs: Sequence[ExperimentSpec],
         args = dict(train_args)
         args["output_dir"] = os.path.join("checkpoints", spec.name)
         cmd = build_command(spec, args, python="python")
+        # Keep the interpreter in the exec'd command: launch.py execvpe's
+        # argv[0], and train.py itself carries no exec bit.
         body = SBATCH_TEMPLATE.format(
             name=spec.name, nodes=hosts_per_pod, extra_directives=extra,
             python="python", launch=launch,
-            train_cmd=shlex.join(cmd[1:]))  # drop the python argv[0]
+            train_cmd=shlex.join(cmd))
         path = os.path.join(out_dir, f"{spec.name}.sbatch")
         with open(path, "w") as f:
             f.write(body)

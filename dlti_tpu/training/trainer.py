@@ -44,9 +44,13 @@ from dlti_tpu.utils.metrics import (
 
 
 class Trainer:
-    def __init__(self, cfg: Config, model: Optional[LlamaForCausalLM] = None):
+    def __init__(self, cfg: Config, model: Optional[LlamaForCausalLM] = None,
+                 base_params: Optional[dict] = None):
         self.cfg = cfg
         self.logger = get_logger()
+        # Pretrained base weights (e.g. from models.load_hf_checkpoint) to
+        # overlay onto the initialized tree — the from_pretrained analog.
+        self.base_params = base_params
         self.tx = build_optimizer(cfg.optimizer)
         self.mesh = None
         if cfg.parallel.num_devices > 1:
@@ -70,6 +74,11 @@ class Trainer:
             (self.cfg.train.micro_batch_size, self.cfg.data.max_seq_len),
             lora_enabled=self.cfg.lora.enabled,
         )
+        if self.base_params is not None:
+            from dlti_tpu.models import graft_base_params
+
+            state = state.replace(
+                params=graft_base_params(state.params, self.base_params))
         if self.mesh is not None:
             state = shard_train_state(state, self.cfg, self.mesh)
         return state

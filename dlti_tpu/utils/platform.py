@@ -28,6 +28,23 @@ def honor_platform_env() -> None:
         pass  # backend already up; the env var had its chance
 
 
+def host_platform_env(n_devices: int, env: dict) -> dict:
+    """Set the CPU-backend-with-``n_devices``-virtual-devices vars on ``env``.
+
+    The single source of truth for the env half of the dance — used both for
+    this process (:func:`force_host_platform`) and for child-process env
+    dicts (orchestration subprocesses), which additionally rely on the child
+    entry point calling :func:`honor_platform_env` to win the site-hook race.
+    """
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def force_host_platform(n_devices: int) -> None:
     """Force the CPU backend with ``n_devices`` virtual devices.
 
@@ -35,10 +52,5 @@ def force_host_platform(n_devices: int) -> None:
     initializes in this process; silently loses the race otherwise, after
     which the caller's device-count check reports the failure.
     """
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        )
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    host_platform_env(n_devices, os.environ)
     honor_platform_env()

@@ -80,6 +80,13 @@ def parse_args():
                    help="skip the scan-latest-and-resume pass")
     p.add_argument("--export-dir", default=None,
                    help="write a consolidated merged-LoRA export here after training")
+    p.add_argument("--init-from-hf", default=None, metavar="DIR",
+                   help="initialize base weights from an HF Llama checkpoint dir "
+                        "(config.json + safetensors); overrides --model's arch")
+    p.add_argument("--export-hf", default=None, metavar="DIR",
+                   help="write the merged model as an HF-layout checkpoint after training")
+    p.add_argument("--export-peft", default=None, metavar="DIR",
+                   help="write the LoRA factors as a PEFT adapter after training")
     p.add_argument("--metrics-csv", default="results/training_metrics.csv")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logging-steps", type=int, default=10)
@@ -164,6 +171,27 @@ def main() -> None:
 
     cfg = build_config(args)
 
+    base_params = None
+    if args.init_from_hf:
+        from dlti_tpu.models import load_hf_checkpoint
+
+        # config.json supplies the architecture; the preset keeps the
+        # performance fields (bf16 dtypes, remat, attention impl, seq len) —
+        # an fp32 checkpoint must not silently flip training to fp32.
+        perf_fields = dict(
+            dtype=cfg.model.dtype, param_dtype=cfg.model.param_dtype,
+            remat=cfg.model.remat, remat_policy=cfg.model.remat_policy,
+            attention_impl=cfg.model.attention_impl,
+            flash_block_q=cfg.model.flash_block_q,
+            flash_block_kv=cfg.model.flash_block_kv,
+        )
+        base_params, hf_model_cfg = load_hf_checkpoint(
+            args.init_from_hf, **perf_fields)
+        if hf_model_cfg != cfg.model:
+            print(f"model arch from {args.init_from_hf}/config.json "
+                  f"(overrides --model={args.model})")
+            cfg = cfg.replace(model=hf_model_cfg)
+
     from dlti_tpu.data import get_tokenizer, make_batches
     from dlti_tpu.training import Trainer
 
@@ -184,7 +212,7 @@ def main() -> None:
     )
     print(f"steps/epoch: {dataset.steps_per_epoch()}")
 
-    trainer = Trainer(cfg)
+    trainer = Trainer(cfg, base_params=base_params)
     state, record = trainer.train(dataset=dataset)
 
     if args.export_dir:
@@ -192,6 +220,21 @@ def main() -> None:
 
         export_merged_model(args.export_dir, state.params, cfg)
         print(f"merged export -> {args.export_dir}")
+    if args.export_peft:
+        import jax
+
+        from dlti_tpu.models import save_peft_adapter
+
+        save_peft_adapter(args.export_peft, jax.device_get(state.params), cfg.lora)
+        print(f"PEFT adapter -> {args.export_peft}")
+    if args.export_hf:
+        import jax
+
+        from dlti_tpu.models import merge_lora_params, save_hf_checkpoint
+
+        merged = merge_lora_params(jax.device_get(state.params), alpha=cfg.lora.alpha)
+        save_hf_checkpoint(args.export_hf, merged, cfg.model)
+        print(f"HF checkpoint -> {args.export_hf}")
 
 
 if __name__ == "__main__":
