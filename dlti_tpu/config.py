@@ -69,6 +69,11 @@ class ModelConfig:
     attention_impl: str = "auto"  # "auto" | "reference" | "flash"
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    # Serving decode over the paged cache: "auto" uses the Pallas in-place
+    # block-table kernel on TPU and the XLA gather path elsewhere;
+    # "kernel" forces the kernel (interpreted off-TPU, for tests);
+    # "gather" forces the XLA path.
+    paged_attention_impl: str = "auto"
 
     @property
     def resolved_head_dim(self) -> int:

@@ -34,6 +34,7 @@ HF key                                                ours (under ``params``)
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Any, Dict, Mapping, Optional, Tuple
@@ -45,6 +46,12 @@ from dlti_tpu.config import LoRAConfig, ModelConfig
 
 _ATTN_PROJS = ("q_proj", "k_proj", "v_proj", "o_proj")
 _MLP_PROJS = ("gate_proj", "up_proj", "down_proj")
+
+
+def _unwrap(params: Mapping[str, Any]) -> Mapping[str, Any]:
+    """Accept either the Flax variables dict (``{"params": tree}``) or the
+    bare param tree."""
+    return params["params"] if "params" in params and "model" not in params else params
 
 
 def _dtype(name: str):
@@ -82,13 +89,15 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
     if hf.get("sliding_window"):
         kw["sliding_window"] = int(hf["sliding_window"])
     kw.update(overrides)
-    try:
-        return ModelConfig(**kw)
-    except TypeError:
-        # Older ModelConfig without the optional family fields.
-        kw.pop("attention_bias", None)
-        kw.pop("sliding_window", None)
-        return ModelConfig(**kw)
+    known = {f.name for f in dataclasses.fields(ModelConfig)}
+    unsupported = sorted(set(kw) - known)
+    if unsupported:
+        # Never drop architecture features silently (a Qwen2 checkpoint
+        # without its q/k/v biases would load and be quietly wrong).
+        raise NotImplementedError(
+            f"checkpoint needs ModelConfig fields not yet supported: "
+            f"{unsupported}")
+    return ModelConfig(**kw)
 
 
 def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
@@ -179,7 +188,7 @@ def params_from_hf_state_dict(
 def hf_state_dict_from_params(params: Mapping[str, Any],
                               cfg: ModelConfig) -> Dict[str, jnp.ndarray]:
     """Our (merged, LoRA-free) param tree -> HF Llama state dict."""
-    p = params["params"] if "params" in params and "model" not in params else params
+    p = _unwrap(params)
     model = p["model"]
     sd: Dict[str, jnp.ndarray] = {
         "model.embed_tokens.weight": jnp.asarray(model["embed_tokens"]),
@@ -212,11 +221,14 @@ def graft_base_params(params: Dict[str, Any], base: Mapping[str, Any]) -> Dict[s
     """Overlay loaded base weights onto a freshly-initialized param tree.
 
     Leaves present in ``base`` replace the initialized values (with a shape
-    check); leaves only in ``params`` (``lora_a``/``lora_b`` factors, biases
-    a checkpoint omits) keep their initialization — the PEFT
-    ``get_peft_model``-on-pretrained semantics
-    (``training/train_baseline.py:122-140``).
+    check); leaves only in ``params`` (``lora_a``/``lora_b`` factors) keep
+    their initialization — the PEFT ``get_peft_model``-on-pretrained
+    semantics (``training/train_baseline.py:122-140``). Base leaves with no
+    counterpart in the model tree are an architecture mismatch and raise
+    (mirroring :func:`params_from_hf_state_dict`'s unconsumed-key check).
     """
+    dropped: list = []
+
     def _graft(p, b, path):
         if not isinstance(p, Mapping):
             if hasattr(b, "shape") and tuple(b.shape) != tuple(p.shape):
@@ -224,10 +236,19 @@ def graft_base_params(params: Dict[str, Any], base: Mapping[str, Any]) -> Dict[s
                     f"{'.'.join(path)}: checkpoint shape {tuple(b.shape)} != "
                     f"model shape {tuple(p.shape)} (wrong ModelConfig?)")
             return jnp.asarray(b).astype(p.dtype)
+        for k in b:
+            if k not in p:
+                dropped.append(".".join(path + (k,)))
         return {k: _graft(v, b[k], path + (k,)) if k in b else v
                 for k, v in p.items()}
 
-    return _graft(params, base, ())
+    out = _graft(params, base, ())
+    if dropped:
+        raise ValueError(
+            f"base checkpoint has weights the model tree lacks (architecture "
+            f"mismatch?): {dropped[:8]}" +
+            (f" (+{len(dropped) - 8} more)" if len(dropped) > 8 else ""))
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -266,7 +287,12 @@ def load_hf_checkpoint(
 
     ``cfg`` overrides config.json entirely; ``config_overrides`` tweak
     individual fields (e.g. ``max_seq_len=512``, ``dtype="bfloat16"``).
+    The two are mutually exclusive.
     """
+    if cfg is not None and config_overrides:
+        raise ValueError(
+            f"pass either cfg or config overrides, not both (got cfg plus "
+            f"{sorted(config_overrides)})")
     if cfg is None:
         cfg_path = os.path.join(directory, "config.json")
         with open(cfg_path) as f:
@@ -338,7 +364,7 @@ def save_peft_adapter(directory: str, params: Mapping[str, Any],
     """
     from safetensors.flax import save_file
 
-    p = params["params"] if "params" in params and "model" not in params else params
+    p = _unwrap(params)
     sd: Dict[str, jnp.ndarray] = {}
 
     def walk(tree, path):
@@ -378,7 +404,7 @@ def load_peft_adapter(directory: str, params: Dict[str, Any]) -> Dict[str, Any]:
                    framework="flax") as f:
         sd = {k: f.get_tensor(k) for k in f.keys()}
 
-    p = params["params"] if "params" in params and "model" not in params else params
+    p = _unwrap(params)
     for key, w in sd.items():
         stripped = key[len(_PEFT_PREFIX):] if key.startswith(_PEFT_PREFIX) else key
         stripped = stripped.removesuffix(".weight")

@@ -115,11 +115,29 @@ class LlamaAttention(nn.Module):
             nb, blk_size = cache["k"].shape[0], cache["k"].shape[1]
             slots = slot_mapping(cache["block_tables"], positions, blk_size, nb)
             new_cache = paged_update(cache, k, v, slots)
-            ck, cv = paged_gather(new_cache, cache["block_tables"])
-            out = reference_attention(
-                q, ck.astype(q.dtype), cv.astype(q.dtype),
-                causal=True, q_positions=positions,
+            impl = getattr(cfg, "paged_attention_impl", "auto")
+            use_kernel = s == 1 and (
+                impl == "kernel"
+                or (impl == "auto" and jax.default_backend() == "tpu")
             )
+            if use_kernel:
+                # Pallas kernel: reads K/V blocks in place via the block
+                # table (no O(batch*max_len) gather); decode steps only.
+                from dlti_tpu.ops.pallas.paged_attention import (
+                    paged_decode_attention,
+                )
+
+                out = paged_decode_attention(
+                    q, new_cache["k"], new_cache["v"],
+                    cache["block_tables"], positions[:, 0] + 1,
+                    interpret=jax.default_backend() != "tpu",
+                ).astype(q.dtype)
+            else:
+                ck, cv = paged_gather(new_cache, cache["block_tables"])
+                out = reference_attention(
+                    q, ck.astype(q.dtype), cv.astype(q.dtype),
+                    causal=True, q_positions=positions,
+                )
         elif cache is not None:
             # Fixed-capacity cache: (b, max_len, kv_heads, hd). `index` is the
             # write offset (same for the whole batch in the engine's design —

@@ -1,0 +1,118 @@
+"""Pallas paged decode attention vs the XLA gather reference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.ops.attention import reference_attention
+from dlti_tpu.ops.kv_cache import init_paged_cache, paged_gather
+from dlti_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+
+def _random_paged_setup(rng_seed, batch, num_heads, kv_heads, head_dim,
+                        block_size, num_blocks, max_blocks, seq_lens):
+    """Build a pool + disjoint random block tables with live data."""
+    rng = np.random.default_rng(rng_seed)
+    k_pool = rng.standard_normal(
+        (num_blocks, block_size, kv_heads, head_dim)).astype(np.float32)
+    v_pool = rng.standard_normal(
+        (num_blocks, block_size, kv_heads, head_dim)).astype(np.float32)
+    # Disjoint physical blocks per sequence (as the allocator guarantees).
+    perm = rng.permutation(num_blocks)
+    tables = np.full((batch, max_blocks), -1, np.int32)
+    next_free = 0
+    for b in range(batch):
+        need = -(-seq_lens[b] // block_size)
+        tables[b, :need] = perm[next_free:next_free + need]
+        next_free += need
+    return jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.asarray(tables)
+
+
+def _reference_decode(q, k_pool, v_pool, tables, seq_lens):
+    """The engine's XLA path: gather the logical window, masked attention."""
+    cache = {"k": k_pool, "v": v_pool}
+    ck, cv = paged_gather(cache, jnp.maximum(tables, 0))
+    # Query sits at position seq_len-1; positions >= seq_len are stale.
+    q_pos = (seq_lens - 1)[:, None]
+    return reference_attention(q, ck, cv, causal=True, q_positions=q_pos)
+
+
+@pytest.mark.parametrize("num_heads,kv_heads", [(8, 8), (8, 2), (4, 1)])
+def test_matches_gather_reference(num_heads, kv_heads):
+    batch, head_dim, block_size = 3, 64, 16
+    seq_lens = np.array([5, 37, 16], np.int32)  # partial / multi / exact block
+    max_blocks = 4
+    k_pool, v_pool, tables = _random_paged_setup(
+        0, batch, num_heads, kv_heads, head_dim, block_size,
+        num_blocks=16, max_blocks=max_blocks, seq_lens=seq_lens)
+    q = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (batch, 1, num_heads, head_dim)).astype(np.float32))
+
+    got = paged_decode_attention(q, k_pool, v_pool, tables,
+                                 jnp.asarray(seq_lens), interpret=True)
+    want = _reference_decode(q, k_pool, v_pool, tables, jnp.asarray(seq_lens))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_stale_pool_rows_never_leak():
+    """Poison every block not in a sequence's table with huge values."""
+    batch, num_heads, kv_heads, head_dim, block_size = 2, 4, 2, 32, 8
+    seq_lens = np.array([3, 9], np.int32)
+    k_pool, v_pool, tables = _random_paged_setup(
+        2, batch, num_heads, kv_heads, head_dim, block_size,
+        num_blocks=8, max_blocks=2, seq_lens=seq_lens)
+    used = set(np.asarray(tables)[np.asarray(tables) >= 0].tolist())
+    poison = np.asarray(k_pool).copy()
+    vpoison = np.asarray(v_pool).copy()
+    for blk in range(8):
+        if blk not in used:
+            poison[blk] = 1e9
+            vpoison[blk] = 1e9
+    # Also poison the *tail* of the last live block beyond seq_len.
+    for b in range(batch):
+        last_logical = (seq_lens[b] - 1) // block_size
+        phys = int(np.asarray(tables)[b, last_logical])
+        vpoison[phys, seq_lens[b] % block_size or block_size:] = 1e9
+
+    q = jnp.asarray(np.random.default_rng(3).standard_normal(
+        (batch, 1, num_heads, head_dim)).astype(np.float32))
+    got = paged_decode_attention(q, jnp.asarray(poison), jnp.asarray(vpoison),
+                                 tables, jnp.asarray(seq_lens), interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    assert np.abs(np.asarray(got)).max() < 1e4
+
+
+def test_bf16_pool_fp32_accumulation():
+    batch, num_heads, kv_heads, head_dim, block_size = 2, 4, 4, 64, 16
+    seq_lens = np.array([30, 17], np.int32)
+    k_pool, v_pool, tables = _random_paged_setup(
+        4, batch, num_heads, kv_heads, head_dim, block_size,
+        num_blocks=8, max_blocks=2, seq_lens=seq_lens)
+    q = jnp.asarray(np.random.default_rng(5).standard_normal(
+        (batch, 1, num_heads, head_dim)))
+    got = paged_decode_attention(
+        q.astype(jnp.bfloat16), k_pool.astype(jnp.bfloat16),
+        v_pool.astype(jnp.bfloat16), tables, jnp.asarray(seq_lens),
+        interpret=True)
+    want = _reference_decode(q.astype(jnp.float32), k_pool, v_pool, tables,
+                             jnp.asarray(seq_lens))
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want), rtol=0.05, atol=0.05)
+
+
+def test_jit_and_grid_edge():
+    """Jits cleanly; seq_len filling every block exactly works."""
+    batch, num_heads, kv_heads, head_dim, block_size = 1, 2, 2, 32, 8
+    seq_lens = np.array([16], np.int32)  # == max_blocks * block_size
+    k_pool, v_pool, tables = _random_paged_setup(
+        6, batch, num_heads, kv_heads, head_dim, block_size,
+        num_blocks=4, max_blocks=2, seq_lens=seq_lens)
+    q = jnp.asarray(np.random.default_rng(7).standard_normal(
+        (batch, 1, num_heads, head_dim)).astype(np.float32))
+    fn = jax.jit(lambda *a: paged_decode_attention(*a, interpret=True))
+    got = fn(q, k_pool, v_pool, tables, jnp.asarray(seq_lens))
+    want = _reference_decode(q, k_pool, v_pool, tables, jnp.asarray(seq_lens))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -291,3 +291,21 @@ def test_engine_stop_tokens(engine, tiny_model_and_params):
         temperature=0.0, max_tokens=10, stop_token_ids=(first,)))
     assert res.output_token_ids == [first]
     assert res.finish_reason == "stop"
+
+
+def test_engine_decode_with_pallas_kernel_matches_gather(tiny_model_and_params):
+    """Forcing the Pallas paged-decode kernel (interpreted on CPU) produces
+    the same greedy tokens as the XLA gather path."""
+    import dataclasses
+
+    model, params = tiny_model_and_params
+    cfg_kernel = dataclasses.replace(CFG, paged_attention_impl="kernel")
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    prompts = [[3, 1, 4, 1, 5, 9], [2, 7, 1, 8, 2, 8, 1, 8, 2]]
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    want = InferenceEngine(CFG, params, ec).generate(prompts, sp)
+    got = InferenceEngine(cfg_kernel, params, ec).generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
