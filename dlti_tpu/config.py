@@ -62,6 +62,11 @@ class ModelConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Family knobs beyond Llama-2 (the reference is HF AutoModel-generic,
+    # ``training/train_baseline.py:122``, so sibling families must load):
+    attention_bias: bool = False        # Qwen2: bias on q/k/v (never o)
+    sliding_window: Optional[int] = None  # Mistral: local attention window
+    mlp_activation: str = "silu"        # "silu" | "gelu_tanh" | "gelu_exact"
     dtype: str = "bfloat16"  # compute dtype (MXU-friendly)
     param_dtype: str = "bfloat16"  # storage dtype of (frozen) base params
     remat: bool = True  # jax.checkpoint each block (grad-ckpt parity)
@@ -316,6 +321,18 @@ MODEL_PRESETS: dict = {
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
         num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
         rope_theta=500000.0,
+    ),
+    # Mistral-7B-v0.1: GQA + sliding-window local attention.
+    "mistral_7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        sliding_window=4096,
+    ),
+    # Qwen2-7B: biased q/k/v projections, big vocab, long RoPE period.
+    "qwen2_7b": ModelConfig(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, max_seq_len=32768,
+        rope_theta=1000000.0, attention_bias=True,
     ),
 }
 

@@ -84,10 +84,26 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
         kw["param_dtype"] = torch_dtype
         if torch_dtype == "float32":
             kw["dtype"] = "float32"
-    if hf.get("attention_bias") or hf.get("model_type") == "qwen2":
+    model_type = hf.get("model_type", "llama")
+    if model_type not in ("llama", "mistral", "qwen2"):
+        # A family we haven't verified forward-pass parity for (e.g. Gemma
+        # needs (1+w) RMSNorm and embedding scaling) must fail loudly, not
+        # import as a subtly different model.
+        raise NotImplementedError(
+            f"model_type {model_type!r} not supported (llama/mistral/qwen2)")
+    if hf.get("attention_bias") or model_type == "qwen2":
         kw["attention_bias"] = True
-    if hf.get("sliding_window"):
+    # Qwen2 configs ship a sliding_window value with use_sliding_window
+    # false, meaning full attention — honor the flag.
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
         kw["sliding_window"] = int(hf["sliding_window"])
+    act = hf.get("hidden_act", "silu")
+    kw["mlp_activation"] = {
+        "silu": "silu", "gelu": "gelu_exact",
+        "gelu_pytorch_tanh": "gelu_tanh", "gelu_new": "gelu_tanh",
+    }.get(act)
+    if kw["mlp_activation"] is None:
+        raise NotImplementedError(f"unsupported hidden_act {act!r}")
     kw.update(overrides)
     known = {f.name for f in dataclasses.fields(ModelConfig)}
     unsupported = sorted(set(kw) - known)
@@ -101,10 +117,19 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
 
 
 def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
-    """Emit an HF-Llama-style ``config.json`` dict for :func:`save`."""
+    """Emit an HF-style ``config.json`` dict for :func:`save_hf_checkpoint`.
+
+    The model_type tracks the family features so transformers picks a class
+    that honors them (qwen2: q/k/v bias; mistral: sliding window)."""
+    if cfg.attention_bias:
+        model_type, arch = "qwen2", "Qwen2ForCausalLM"
+    elif cfg.sliding_window:
+        model_type, arch = "mistral", "MistralForCausalLM"
+    else:
+        model_type, arch = "llama", "LlamaForCausalLM"
     out = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": [arch],
+        "model_type": model_type,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": cfg.intermediate_size,
@@ -116,14 +141,17 @@ def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "rope_theta": cfg.rope_theta,
         "rms_norm_eps": cfg.rms_norm_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
-        "hidden_act": "silu",
+        "hidden_act": {"silu": "silu", "gelu_exact": "gelu",
+                       "gelu_tanh": "gelu_pytorch_tanh"}[cfg.mlp_activation],
         "torch_dtype": {"bfloat16": "bfloat16", "float16": "float16",
                         "float32": "float32"}[cfg.param_dtype],
     }
-    if getattr(cfg, "attention_bias", False):
+    if cfg.attention_bias:
         out["attention_bias"] = True
-    if getattr(cfg, "sliding_window", None):
+    if cfg.sliding_window:
         out["sliding_window"] = cfg.sliding_window
+        # Qwen2 ignores sliding_window unless this flag is set.
+        out["use_sliding_window"] = True
     return out
 
 
@@ -155,9 +183,12 @@ def params_from_hf_state_dict(
         attn: Dict[str, Any] = {}
         for p in _ATTN_PROJS:
             attn[p] = {"kernel": take(f"{hf_l}.self_attn.{p}.weight", transpose=True)}
-            bias_key = f"{hf_l}.self_attn.{p}.bias"
-            if bias_key in sd:
-                attn[p]["bias"] = take(bias_key)
+            # q/k/v biases load iff the config declares them (KeyError when
+            # declared-but-absent; declared-absent-but-present falls through
+            # to the unconsumed-keys check) — bias/config mismatches are
+            # never silent. o_proj is biasless in every supported family.
+            if cfg.attention_bias and p != "o_proj":
+                attn[p]["bias"] = take(f"{hf_l}.self_attn.{p}.bias")
         mlp = {p: {"kernel": take(f"{hf_l}.mlp.{p}.weight", transpose=True)}
                for p in _MLP_PROJS}
         model[f"layers_{i}"] = {

@@ -87,15 +87,17 @@ class LlamaAttention(nn.Module):
         b, s, _ = x.shape
         hd = cfg.resolved_head_dim
 
-        def proj(name: str, features: int):
+        def proj(name: str, features: int, use_bias: bool = False):
             return LoRADense(
-                features=features, use_bias=False, dtype=dtype, param_dtype=pdtype,
+                features=features, use_bias=use_bias, dtype=dtype, param_dtype=pdtype,
                 name=name, **_lora_kwargs(cfg, self.lora, name),
             )
 
-        q = proj("q_proj", cfg.num_heads * hd)(x, deterministic)
-        k = proj("k_proj", cfg.num_kv_heads * hd)(x, deterministic)
-        v = proj("v_proj", cfg.num_kv_heads * hd)(x, deterministic)
+        # Qwen2-style bias on q/k/v only, never o (config.attention_bias).
+        qkv_bias = cfg.attention_bias
+        q = proj("q_proj", cfg.num_heads * hd, qkv_bias)(x, deterministic)
+        k = proj("k_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic)
+        v = proj("v_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic)
 
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
@@ -130,6 +132,7 @@ class LlamaAttention(nn.Module):
                 out = paged_decode_attention(
                     q, new_cache["k"], new_cache["v"],
                     cache["block_tables"], positions[:, 0] + 1,
+                    window=cfg.sliding_window,
                     interpret=jax.default_backend() != "tpu",
                 ).astype(q.dtype)
             else:
@@ -137,6 +140,7 @@ class LlamaAttention(nn.Module):
                 out = reference_attention(
                     q, ck.astype(q.dtype), cv.astype(q.dtype),
                     causal=True, q_positions=positions,
+                    window=cfg.sliding_window,
                 )
         elif cache is not None:
             # Fixed-capacity cache: (b, max_len, kv_heads, hd). `index` is the
@@ -152,6 +156,7 @@ class LlamaAttention(nn.Module):
             out = reference_attention(
                 q, ck.astype(q.dtype), cv.astype(q.dtype),
                 causal=True, q_positions=positions,
+                window=cfg.sliding_window,
             )
         elif (self.mesh is not None and "sequence" in self.mesh.shape
               and self.mesh.shape["sequence"] > 1 and segment_ids is None):
@@ -162,6 +167,11 @@ class LlamaAttention(nn.Module):
             # and rejected at config level (make_sharded_train_step).
             from dlti_tpu.parallel.ring_attention import ring_attention
 
+            if cfg.sliding_window:
+                raise NotImplementedError(
+                    "sliding-window attention is not supported with "
+                    "sequence parallelism (ring attention) yet; set "
+                    "parallel.sequence=1 for sliding-window models")
             out = ring_attention(q, k, v, self.mesh, positions=positions,
                                  causal=True)
         else:
@@ -172,17 +182,27 @@ class LlamaAttention(nn.Module):
                     q, k, v, causal=True, segment_ids=segment_ids,
                     impl=cfg.attention_impl,
                     block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
+                    window=cfg.sliding_window,
                 )
             else:
-                out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids)
+                out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids,
+                                          window=cfg.sliding_window)
 
         out = out.reshape(b, s, cfg.num_heads * hd)
         out = proj("o_proj", cfg.hidden_size)(out, deterministic)
         return out, new_cache
 
 
+_MLP_ACTIVATIONS = {
+    "silu": nn.silu,
+    "gelu_tanh": nn.gelu,  # flax default: tanh approximation
+    "gelu_exact": lambda x: nn.gelu(x, approximate=False),
+}
+
+
 class LlamaMLP(nn.Module):
-    """SwiGLU: down(silu(gate(x)) * up(x))."""
+    """Gated MLP: down(act(gate(x)) * up(x)); act is SwiGLU's silu for the
+    Llama/Mistral/Qwen2 families, gelu_tanh for Gemma-style configs."""
 
     cfg: ModelConfig
     lora: Optional[LoRAConfig] = None
@@ -192,6 +212,7 @@ class LlamaMLP(nn.Module):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         pdtype = _dtype(cfg.param_dtype)
+        act = _MLP_ACTIVATIONS[cfg.mlp_activation]
 
         def proj(name: str, features: int):
             return LoRADense(
@@ -201,7 +222,7 @@ class LlamaMLP(nn.Module):
 
         gate = proj("gate_proj", cfg.intermediate_size)(x, deterministic)
         up = proj("up_proj", cfg.intermediate_size)(x, deterministic)
-        return proj("down_proj", cfg.hidden_size)(nn.silu(gate) * up, deterministic)
+        return proj("down_proj", cfg.hidden_size)(act(gate) * up, deterministic)
 
 
 class LlamaBlock(nn.Module):

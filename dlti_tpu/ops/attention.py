@@ -30,15 +30,20 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
-def make_causal_mask(q_len: int, kv_len: int, dtype=jnp.float32) -> jnp.ndarray:
+def make_causal_mask(q_len: int, kv_len: int, dtype=jnp.float32,
+                     window: int | None = None) -> jnp.ndarray:
     """Additive causal mask of shape (1, 1, q_len, kv_len).
 
     Supports q_len < kv_len (decode with cache): query i attends to
-    kv positions <= (kv_len - q_len + i).
+    kv positions <= (kv_len - q_len + i). ``window`` adds Mistral-style
+    sliding-window locality: only the last ``window`` positions (query
+    included) stay visible.
     """
     q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
     kv_pos = jnp.arange(kv_len)[None, :]
     allowed = kv_pos <= q_pos
+    if window is not None:
+        allowed &= kv_pos > q_pos - window
     return jnp.where(allowed, 0.0, jnp.finfo(dtype).min)[None, None, :, :].astype(dtype)
 
 
@@ -52,6 +57,7 @@ def reference_attention(
     kv_segment_ids: jnp.ndarray | None = None,
     q_positions: jnp.ndarray | None = None,
     kv_positions: jnp.ndarray | None = None,
+    window: int | None = None,
     softmax_dtype=jnp.float32,
 ) -> jnp.ndarray:
     """Plain XLA attention. q: (b, sq, h, d); k/v: (b, skv, h_kv, d).
@@ -62,6 +68,7 @@ def reference_attention(
     ``q_positions``/``kv_positions`` (b, s) give explicit token positions for
     causal masking — required for KV-cached decode where the cache capacity
     exceeds the written region (slot index == position by construction).
+    ``window`` is Mistral-style sliding-window locality (needs ``causal``).
     """
     b, sq, num_heads, head_dim = q.shape
     num_kv = k.shape[2]
@@ -79,11 +86,14 @@ def reference_attention(
             kv_pos = (kv_positions if kv_positions is not None
                       else jnp.broadcast_to(jnp.arange(skv)[None, :], (b, skv)))
             allowed = kv_pos[:, None, :] <= q_positions[:, :, None]
+            if window is not None:
+                allowed &= kv_pos[:, None, :] > q_positions[:, :, None] - window
             scores = scores + jnp.where(
                 allowed, 0.0, jnp.finfo(softmax_dtype).min
             )[:, None, :, :].astype(softmax_dtype)
         else:
-            scores = scores + make_causal_mask(sq, skv, softmax_dtype)
+            scores = scores + make_causal_mask(sq, skv, softmax_dtype,
+                                               window=window)
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         same = (segment_ids[:, :, None] == kv_seg[:, None, :]) & (kv_seg[:, None, :] != 0)
@@ -96,7 +106,7 @@ def reference_attention(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv")
+    jax.jit, static_argnames=("causal", "impl", "block_q", "block_kv", "window")
 )
 def multi_head_attention(
     q: jnp.ndarray,
@@ -108,11 +118,14 @@ def multi_head_attention(
     impl: str = "auto",
     block_q: int = 512,
     block_kv: int = 512,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Dispatching attention entry point used by the model.
 
     impl: "reference" | "flash" | "auto". "auto" picks flash on TPU for
     tile-aligned self-attention shapes without packing, else reference.
+    Sliding ``window`` works on both paths (flash skips whole blocks
+    outside the band).
     """
     use_flash = False
     if impl == "flash":
@@ -128,6 +141,7 @@ def multi_head_attention(
 
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, window=window,
         )
-    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                               window=window)

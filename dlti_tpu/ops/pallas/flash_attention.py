@@ -31,7 +31,8 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
-                *, scale: float, block_q: int, block_kv: int, causal: bool):
+                *, scale: float, block_q: int, block_kv: int, causal: bool,
+                window: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -42,10 +43,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    # Causal: process only kv blocks whose start <= q block's end.
+    # Causal: process only kv blocks whose start <= q block's end; with a
+    # sliding window, also skip blocks entirely below every query's window.
     run = True
     if causal:
         run = ki * block_kv <= qi * block_q + (block_q - 1)
+        if window:
+            run = jnp.logical_and(
+                run, ki * block_kv + (block_kv - 1) > qi * block_q - window)
 
     @pl.when(run)
     def _body():
@@ -63,7 +68,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
             k_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1
             )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            allowed = k_pos <= q_pos
+            if window:
+                allowed &= k_pos > q_pos - window
+            s = jnp.where(allowed, s, NEG_INF)
 
         m_prev = m_scratch[:]  # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -88,7 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
         o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, interpret):
+def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, window, interpret):
     """q,k,v: (bh, seq, d) -> o: (bh, seq, d)."""
     bh, sq, d = q.shape
     skv = k.shape[1]
@@ -97,7 +105,8 @@ def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, interpret):
     grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal
+        _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+        causal=causal, window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -124,9 +133,9 @@ def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, interpret):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
-def _flash_attention_core(q, k, v, causal, block_q, block_kv, interpret):
+def _flash_attention_core(q, k, v, causal, block_q, block_kv, window, interpret):
     """(b, s, h, d) attention with GQA via head repetition at the caller."""
     b, sq, h, d = q.shape
     scale = d ** -0.5
@@ -134,16 +143,17 @@ def _flash_attention_core(q, k, v, causal, block_q, block_kv, interpret):
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
     o = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q, block_kv=block_kv,
-                   causal=causal, interpret=interpret)
+                   causal=causal, window=window, interpret=interpret)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
-def _core_fwd(q, k, v, causal, block_q, block_kv, interpret):
-    out = _flash_attention_core(q, k, v, causal, block_q, block_kv, interpret)
+def _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret):
+    out = _flash_attention_core(q, k, v, causal, block_q, block_kv, window,
+                                interpret)
     return out, (q, k, v)
 
 
-def _core_bwd(causal, block_q, block_kv, interpret, res, g):
+def _core_bwd(causal, block_q, block_kv, window, interpret, res, g):
     """Recompute-based backward through the XLA reference implementation.
 
     Correct and XLA-fused; a Pallas flash backward replaces this for
@@ -154,7 +164,8 @@ def _core_bwd(causal, block_q, block_kv, interpret, res, g):
     q, k, v = res
 
     def ref(q_, k_, v_):
-        return reference_attention(q_, k_, v_, causal=causal)
+        return reference_attention(q_, k_, v_, causal=causal,
+                                   window=window or None)
 
     _, vjp = jax.vjp(ref, q, k, v)
     return vjp(g)
@@ -172,22 +183,26 @@ def flash_attention(
     segment_ids=None,
     block_q: int = 512,
     block_kv: int = 512,
+    window: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Flash attention entry. q: (b, sq, h, d); k/v: (b, skv, h_kv, d).
 
     GQA is handled by repeating kv heads (the MXU cost is in the matmuls,
-    which are unchanged). Segment masking falls back to the reference
-    implementation for now.
+    which are unchanged). ``window`` enables Mistral-style sliding-window
+    attention with whole-block skipping outside the band. Segment masking
+    falls back to the reference implementation for now.
     """
     if segment_ids is not None:
         from dlti_tpu.ops.attention import reference_attention
 
-        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                   window=window)
 
     h, h_kv = q.shape[2], k.shape[2]
     if h != h_kv:
         rep = h // h_kv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    return _flash_attention_core(q, k, v, causal, block_q, block_kv, interpret)
+    return _flash_attention_core(q, k, v, causal, block_q, block_kv,
+                                 window or 0, interpret)

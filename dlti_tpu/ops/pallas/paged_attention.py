@@ -43,7 +43,7 @@ NEG_INF = -1e30
 
 def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scratch, l_scratch, acc_scratch,
-                   *, scale: float, block_size: int):
+                   *, scale: float, block_size: int, window: int):
     b = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -55,7 +55,12 @@ def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    @pl.when(j * block_size < seq_len)
+    run = j * block_size < seq_len
+    if window:
+        # Sliding window: skip blocks wholly below [seq_len - window, seq_len).
+        run = jnp.logical_and(run, (j + 1) * block_size > seq_len - window)
+
+    @pl.when(run)
     def _body():
         q = q_ref[0].astype(jnp.float32)                   # (kvh, hpg, d)
         k = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)  # (kvh, bs, d)
@@ -67,7 +72,10 @@ def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
 
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 2)
-        s = jnp.where(k_pos < seq_len, s, NEG_INF)
+        valid = k_pos < seq_len
+        if window:
+            valid &= k_pos >= seq_len - window
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scratch[:]                              # (kvh, hpg, 1)
         m_cur = jnp.max(s, axis=2, keepdims=True)
@@ -95,6 +103,7 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,
     seq_lens: jnp.ndarray,
     *,
+    window: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """One-token-per-sequence attention over the paged KV pool.
@@ -107,6 +116,8 @@ def paged_decode_attention(
         masked, never read into the result).
       seq_lens: ``(batch,)`` int32 — tokens valid per sequence *including*
         the current one (i.e. query position + 1).
+      window: Mistral-style sliding window — only the last ``window``
+        positions stay visible; whole blocks outside the band are skipped.
 
     Returns ``(batch, 1, num_heads, head_dim)``.
     """
@@ -133,7 +144,7 @@ def paged_decode_attention(
         return (bt_ref[b, j], 0, 0, 0)
 
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               block_size=block_size)
+                               block_size=block_size, window=window or 0)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

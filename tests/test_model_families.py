@@ -1,0 +1,186 @@
+"""Model-family widening: Qwen2 (qkv bias), Mistral (sliding window),
+Gemma-style gelu MLP — logits parity with transformers + window semantics.
+
+The reference loads models through HF Auto classes
+(``training/train_baseline.py:122``), so sibling Llama-family checkpoints
+are in its capability surface; these tests pin ours.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.config import ModelConfig
+from dlti_tpu.models import LlamaForCausalLM, params_from_hf_state_dict
+from dlti_tpu.ops.attention import reference_attention
+from dlti_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _sd_numpy(model):
+    return {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+
+
+def _assert_logits_match(our_cfg, hf_model, seq=16, tol=3e-4):
+    torch = pytest.importorskip("torch")
+    params = params_from_hf_state_dict(_sd_numpy(hf_model), our_cfg)
+    ids = np.random.default_rng(0).integers(0, our_cfg.vocab_size, (2, seq))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got, _ = LlamaForCausalLM(our_cfg).apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=tol, atol=tol)
+
+
+def test_qwen2_logits_match_transformers():
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=False, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(0)
+    hf_model = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=64, attention_bias=True,
+        rms_norm_eps=1e-6, dtype="float32", param_dtype="float32", remat=False,
+        attention_impl="reference",
+    )
+    _assert_logits_match(cfg, hf_model)
+
+
+def test_mistral_sliding_window_logits_match_transformers():
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    window = 6
+    hf_cfg = MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=window,
+        tie_word_embeddings=False, attn_implementation="eager",
+        rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(0)
+    hf_model = MistralForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=64, sliding_window=window,
+        rms_norm_eps=1e-6, dtype="float32", param_dtype="float32", remat=False,
+        attention_impl="reference",
+    )
+    _assert_logits_match(cfg, hf_model, seq=24)
+
+
+def test_gelu_mlp_variant_runs():
+    cfg = ModelConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=1,
+        num_heads=2, num_kv_heads=2, max_seq_len=32, mlp_activation="gelu_tanh",
+        dtype="float32", param_dtype="float32", remat=False,
+        attention_impl="reference",
+    )
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits, _ = model.apply({"params": params}, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+# ----------------------------------------------------------------------
+# Sliding-window attention op semantics
+# ----------------------------------------------------------------------
+
+def _dense_window_attention(q, k, v, window):
+    """O(s^2) masked softmax ground truth."""
+    b, s, h, d = q.shape
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    qpos = np.arange(s)[:, None]
+    kpos = np.arange(s)[None, :]
+    allowed = (kpos <= qpos) & (kpos > qpos - window)
+    scores = np.where(allowed[None, None], scores, -1e30)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def test_reference_attention_window():
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+    k = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+    got = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=True, window=5)
+    want = _dense_window_attention(q, k, v, 5)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_window_matches_reference():
+    rng = np.random.default_rng(1)
+    s, w = 64, 20
+    q = rng.standard_normal((1, s, 4, 32)).astype(np.float32)
+    k = rng.standard_normal((1, s, 4, 32)).astype(np.float32)
+    v = rng.standard_normal((1, s, 4, 32)).astype(np.float32)
+    got = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=True, window=w, block_q=16, block_kv=16,
+                          interpret=True)
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                               causal=True, window=w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_window_gradients_match():
+    rng = np.random.default_rng(2)
+    s, w = 32, 9
+    q = jnp.asarray(rng.standard_normal((1, s, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, s, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, s, 2, 16)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, window=w, block_q=8,
+                               block_kv=8, interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True, window=w).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_paged_decode_window_matches_reference():
+    from dlti_tpu.ops.kv_cache import paged_gather
+    from dlti_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(3)
+    batch, H, KVH, D, BS, NB, MB = 2, 4, 2, 32, 8, 16, 4
+    seq_lens = np.array([13, 29], np.int32)
+    window = 10
+    k_pool = rng.standard_normal((NB, BS, KVH, D)).astype(np.float32)
+    v_pool = rng.standard_normal((NB, BS, KVH, D)).astype(np.float32)
+    perm = rng.permutation(NB)
+    tables = np.full((batch, MB), -1, np.int32)
+    nf = 0
+    for b in range(batch):
+        need = -(-seq_lens[b] // BS)
+        tables[b, :need] = perm[nf:nf + need]
+        nf += need
+    q = rng.standard_normal((batch, 1, H, D)).astype(np.float32)
+
+    got = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(tables), jnp.asarray(seq_lens), window=window,
+        interpret=True)
+    ck, cv = paged_gather({"k": jnp.asarray(k_pool), "v": jnp.asarray(v_pool)},
+                          jnp.maximum(jnp.asarray(tables), 0))
+    want = reference_attention(
+        jnp.asarray(q), ck, cv, causal=True,
+        q_positions=jnp.asarray(seq_lens)[:, None] - 1, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
