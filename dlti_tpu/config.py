@@ -150,8 +150,9 @@ class ParallelConfig:
     sequence: int = 1
     # ZeRO-3 host offload parity (configs/ds_config_zero3.json:19-27).
     # offload_optimizer places optimizer state in pinned host memory (wired
-    # in opt_state_shardings); offload_params is reserved for param paging
-    # (not yet wired — setting it raises in build_mesh-consuming paths).
+    # in opt_state_shardings); offload_params places the frozen base params
+    # in pinned host memory and streams them to HBM inside the step
+    # (param_shardings + the frozen_fetch hook in the train step).
     offload_optimizer: bool = False
     offload_params: bool = False
 
@@ -213,6 +214,16 @@ class TrainConfig:
     # Reference metrics contract: append one row per run
     # (training/utils.py:51-69 -> results/training_metrics.csv).
     metrics_csv: str = "results/training_metrics.csv"
+    # fp16 dynamic loss scaling — parity with the reference's DeepSpeed fp16
+    # block (configs/ds_config_zero1.json:25-32: loss_scale 0 = dynamic,
+    # initial 2^16, window 1000, hysteresis 2, min_loss_scale 1). bf16 (the
+    # TPU default) needs none of this; enable only for fp16 parity runs
+    # (pair with ModelConfig dtype="float16").
+    fp16: bool = False
+    fp16_initial_scale_power: int = 16
+    fp16_scale_window: int = 1000
+    fp16_hysteresis: int = 2
+    fp16_min_scale: float = 1.0
 
 
 @dataclass(frozen=True)

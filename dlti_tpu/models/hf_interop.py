@@ -93,10 +93,20 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
             f"model_type {model_type!r} not supported (llama/mistral/qwen2)")
     if hf.get("attention_bias") or model_type == "qwen2":
         kw["attention_bias"] = True
-    # Qwen2 configs ship a sliding_window value with use_sliding_window
-    # false, meaning full attention — honor the flag.
-    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
-        kw["sliding_window"] = int(hf["sliding_window"])
+    if hf.get("sliding_window"):
+        if model_type == "qwen2":
+            # Qwen2 ships sliding_window with use_sliding_window defaulting
+            # to *false* (full attention), and when enabled applies it only
+            # to layers >= max_window_layers — we support all-or-nothing.
+            if hf.get("use_sliding_window", False):
+                mwl = hf.get("max_window_layers", kw["num_layers"])
+                if mwl not in (0, None):
+                    raise NotImplementedError(
+                        "per-layer sliding window (qwen2 max_window_layers="
+                        f"{mwl}) is not supported; only uniform windows")
+                kw["sliding_window"] = int(hf["sliding_window"])
+        elif hf.get("use_sliding_window", True):
+            kw["sliding_window"] = int(hf["sliding_window"])
     act = hf.get("hidden_act", "silu")
     kw["mlp_activation"] = {
         "silu": "silu", "gelu": "gelu_exact",
@@ -150,8 +160,11 @@ def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         out["attention_bias"] = True
     if cfg.sliding_window:
         out["sliding_window"] = cfg.sliding_window
-        # Qwen2 ignores sliding_window unless this flag is set.
+        # Qwen2 ignores sliding_window unless the flag is set, and applies
+        # it only to layers >= max_window_layers — 0 means every layer,
+        # matching our uniform window.
         out["use_sliding_window"] = True
+        out["max_window_layers"] = 0
     return out
 
 

@@ -120,16 +120,41 @@ def _zero_opt_leaf_pspec(shape: tuple, axis: str, size: int) -> P:
     return P(*spec)
 
 
+def _host_memory_kind(mesh: Mesh) -> Optional[str]:
+    """"pinned_host" when the backend exposes it, else None (no offload)."""
+    try:
+        kinds = {m.kind for m in mesh.devices.flat[0].addressable_memories()}
+        return "pinned_host" if "pinned_host" in kinds else None
+    except Exception:
+        return None
+
+
 def param_shardings(params: Any, cfg: Config, mesh: Mesh) -> Any:
-    """Pytree of NamedShardings for the full param tree."""
+    """Pytree of NamedShardings for the full param tree.
+
+    With ``offload_params`` (ZeRO-3 CPU-offload parity,
+    ``ds_config_zero3.json:24-27``) the frozen base params live in pinned
+    host memory; the train step streams them to HBM via its
+    ``frozen_fetch`` hook. Trainable (LoRA) leaves always stay on device —
+    they are updated every step.
+    """
+    host_kind = None
     if cfg.parallel.offload_params:
-        raise NotImplementedError(
-            "offload_params (ZeRO-3 param paging to host) is not wired yet; "
-            "use offload_optimizer for the ds_config_zero3 offload parity"
-        )
-    return jax.tree_util.tree_map_with_path(
-        lambda path, v: NamedSharding(mesh, param_pspec(path, v, cfg, mesh)), params
-    )
+        if not cfg.lora.enabled:
+            raise ValueError(
+                "offload_params currently requires LoRA (it offloads the "
+                "frozen base params; a full fine-tune has none)")
+        host_kind = _host_memory_kind(mesh)
+
+    def leaf(path, v):
+        path_s = _path_str(path)
+        kind = host_kind
+        if kind is not None and ("lora_a" in path_s or "lora_b" in path_s):
+            kind = None  # trainable leaves stay in HBM
+        return NamedSharding(mesh, param_pspec(path, v, cfg, mesh),
+                             memory_kind=kind)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
 
 
 def opt_state_shardings(opt_state: Any, cfg: Config, mesh: Mesh) -> Any:
@@ -152,18 +177,16 @@ def opt_state_shardings(opt_state: Any, cfg: Config, mesh: Mesh) -> Any:
     # optimizer state in host memory; XLA streams it in for the update.
     memory_kind = None
     if cfg.parallel.offload_optimizer:
-        try:
-            kinds = {m.kind for m in mesh.devices.flat[0].addressable_memories()}
-            if "pinned_host" in kinds:
-                memory_kind = "pinned_host"
-        except Exception:
-            memory_kind = None
+        memory_kind = _host_memory_kind(mesh)
 
     def leaf(v):
         if not hasattr(v, "shape"):
             return NamedSharding(mesh, P())
+        # Scalars (step counts) stay on device: offloading them buys nothing
+        # and scalar host-placement trips the SPMD partitioner.
+        kind = memory_kind if len(v.shape) >= 1 else None
         return NamedSharding(
-            mesh, _zero_opt_leaf_pspec(v.shape, axis, size), memory_kind=memory_kind
+            mesh, _zero_opt_leaf_pspec(v.shape, axis, size), memory_kind=kind
         )
 
     return jax.tree_util.tree_map(leaf, opt_state)
@@ -196,8 +219,11 @@ def state_shardings(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings."""
     p_sh = param_shardings(state.params, cfg, mesh)
     o_sh = opt_state_shardings(state.opt_state, cfg, mesh)
+    repl = NamedSharding(mesh, P())
+    scaler_sh = (jax.tree_util.tree_map(lambda _: repl, state.scaler)
+                 if state.scaler is not None else None)
     return state.replace(
-        step=NamedSharding(mesh, P()), params=p_sh, opt_state=o_sh
+        step=repl, params=p_sh, opt_state=o_sh, scaler=scaler_sh
     )
 
 
@@ -272,13 +298,55 @@ def make_sharded_train_step(
         accum_steps=accum_steps,
         sharding_constraint=activation_constraint,
         grad_constraint=grad_constraint,
+        fp16_scale_window=cfg.train.fp16_scale_window,
+        fp16_min_scale=cfg.train.fp16_min_scale,
+        fp16_hysteresis=cfg.train.fp16_hysteresis,
     )
+
+    # Host offload (ds_config_zero3.json:19-27 parity): the state *rests*
+    # in pinned host memory (st_sh carries memory kinds); the jit itself is
+    # all-device — in-jit memory-kind streaming breaks XLA's SPMD
+    # partitioner on replicated outputs in current jax, so transfers happen
+    # at the step boundary instead. HBM thus holds the offloaded tensors
+    # only for the duration of a step, freeing it between steps (and for
+    # anything colocated); per-layer streaming inside the step is a planned
+    # upgrade once the partitioner handles placement annotations.
+    has_offload = any(
+        getattr(s, "memory_kind", None) == "pinned_host"
+        for s in jax.tree_util.tree_leaves(st_sh))
+    st_sh_dev = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s.spec) if isinstance(s, NamedSharding) else s,
+        st_sh)
 
     # Every batch field (input_ids/loss_mask/segment_ids/positions) shares
     # the (accum, batch, seq) layout; a prefix pytree applies b_sh to all.
-    return jax.jit(
+    jitted = jax.jit(
         step_fn,
-        in_shardings=(st_sh, b_sh, rng_sh),
-        out_shardings=(st_sh, NamedSharding(mesh, P())),
+        in_shardings=(st_sh_dev, b_sh, rng_sh),
+        out_shardings=(st_sh_dev, NamedSharding(mesh, P())),
         donate_argnums=(0,) if donate else (),
     )
+    if not has_offload:
+        return jitted
+
+    frozen_offloaded = (cfg.parallel.offload_params
+                        and _host_memory_kind(mesh) is not None)
+
+    def step_with_offload(state, batch, rng):
+        host_state = state
+        dev_state = jax.device_put(state, st_sh_dev)   # host -> HBM
+        new_state, metrics = jitted(dev_state, batch, rng)
+        if frozen_offloaded:
+            # Frozen base params never change: splice the still-valid host
+            # copies back in so device_put below doesn't re-transfer them
+            # HBM -> host every step (half the offload DMA traffic for a
+            # LoRA run).
+            from dlti_tpu.training.state import combine_params, partition_params
+
+            t_new, _ = partition_params(new_state.params, cfg.lora.enabled)
+            _, f_host = partition_params(host_state.params, cfg.lora.enabled)
+            new_state = new_state.replace(params=combine_params(t_new, f_host))
+        new_state = jax.device_put(new_state, st_sh)   # changed leaves -> host
+        return new_state, metrics
+
+    return step_with_offload

@@ -44,6 +44,10 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any  # optax state over the trainable flat subset
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     lora_enabled: bool = struct.field(pytree_node=False)
+    # fp16 dynamic loss scaling state (None when training in bf16/fp32):
+    # {scale: f32, good_steps: i32, hysteresis_left: i32} — the DeepSpeed
+    # dynamic scaler's state (configs/ds_config_zero1.json:25-32).
+    scaler: Any = None
 
     def trainable_and_frozen(self) -> tuple:
         return partition_params(self.params, self.lora_enabled)
@@ -56,11 +60,14 @@ def create_train_state(
     example_batch_shape: tuple,
     lora_enabled: bool = True,
     init_fn: Callable | None = None,
+    fp16_initial_scale: float | None = None,
+    fp16_hysteresis: int = 2,
 ) -> TrainState:
     """Initialize params + optimizer state.
 
     ``example_batch_shape`` is (micro_batch, seq_len). ``init_fn`` overrides
     model.init for tests / loading pre-trained weights.
+    ``fp16_initial_scale`` (e.g. 2**16) enables the dynamic loss scaler.
     """
     dummy = jnp.zeros(example_batch_shape, dtype=jnp.int32)
     if init_fn is None:
@@ -74,10 +81,18 @@ def create_train_state(
         raise ValueError("no trainable params found (LoRA enabled but no adapters grafted)")
     # Master copies of trainable params in fp32 (bf16 base stays bf16).
     opt_state = tx.init(trainable)
+    scaler = None
+    if fp16_initial_scale is not None:
+        scaler = {
+            "scale": jnp.array(fp16_initial_scale, jnp.float32),
+            "good_steps": jnp.array(0, jnp.int32),
+            "hysteresis_left": jnp.array(fp16_hysteresis, jnp.int32),
+        }
     return TrainState(
         step=jnp.array(0, dtype=jnp.int32),
         params=params,
         opt_state=opt_state,
         tx=tx,
         lora_enabled=lora_enabled,
+        scaler=scaler,
     )

@@ -60,6 +60,9 @@ def make_train_step(
     accum_steps: int = 1,
     sharding_constraint: Optional[Callable] = None,
     grad_constraint: Optional[Callable] = None,
+    fp16_scale_window: int = 1000,
+    fp16_min_scale: float = 1.0,
+    fp16_hysteresis: int = 2,
 ) -> Callable:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -69,6 +72,19 @@ def make_train_step(
     layer to pin activations to the mesh). ``grad_constraint`` pins the
     accumulated grads to the optimizer-state sharding — the ZeRO-2
     reduce-scatter semantics (``configs/ds_config_zero1.json:40``).
+    Host offload (``configs/ds_config_zero3.json:19-27``) happens *outside*
+    this function: the sharded-step wrapper moves host-resident state to
+    HBM before invoking the jitted step and back after (see
+    ``make_sharded_train_step``) — in-jit streaming via memory-kind
+    annotations trips XLA's SPMD partitioner on replicated outputs in the
+    current jax.
+
+    When ``state.scaler`` is set (fp16 training), the loss is multiplied by
+    the dynamic scale before backward, grads are unscaled, and non-finite
+    grads skip the update and shrink the scale — DeepSpeed's dynamic loss
+    scaler (``configs/ds_config_zero1.json:25-32``): halve on overflow once
+    ``hysteresis`` overflows have been absorbed, double after
+    ``fp16_scale_window`` consecutive good steps.
     """
 
     def microbatch_loss(trainable, frozen, micro, rng):
@@ -89,13 +105,21 @@ def make_train_step(
 
     def train_step(state: TrainState, batch: dict, rng: jax.Array):
         trainable, frozen = state.trainable_and_frozen()
+        opt_state = state.opt_state
+        loss_scale = (state.scaler["scale"] if state.scaler is not None
+                      else jnp.float32(1.0))
 
         def accum_body(carry, micro_with_rng):
             # One fused fwd+bwd per microbatch via value_and_grad.
             grads_acc, loss_acc, tok_acc = carry
             micro, micro_rng = micro_with_rng
-            (loss_sum, n_tok), grads = jax.value_and_grad(
-                microbatch_loss, argnums=0, has_aux=True
+
+            def scaled_loss(trainable, frozen, micro, rng):
+                loss_sum, n_tok = microbatch_loss(trainable, frozen, micro, rng)
+                return loss_sum * loss_scale, (loss_sum, n_tok)
+
+            (_, (loss_sum, n_tok)), grads = jax.value_and_grad(
+                scaled_loss, argnums=0, has_aux=True
             )(trainable, frozen, micro, micro_rng)
             grads_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
@@ -119,16 +143,16 @@ def make_train_step(
             )
 
         # Mean over all tokens in the global batch (matches HF Trainer's
-        # token-mean loss under grad accumulation).
+        # token-mean loss under grad accumulation). Grads also unscale the
+        # fp16 loss scale here (no-op at scale 1).
         n_tok = jnp.maximum(n_tok, 1.0)
-        grads = jax.tree_util.tree_map(lambda g: g / n_tok, grads)
+        grads = jax.tree_util.tree_map(lambda g: g / (n_tok * loss_scale), grads)
         loss = loss_sum / n_tok
         if grad_constraint is not None:
             grads = grad_constraint(grads)
 
-        updates, new_opt_state = state.tx.update(grads, state.opt_state, trainable)
+        updates, new_opt_state = state.tx.update(grads, opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)
-        new_params = combine_params(new_trainable, frozen)
 
         grad_norm = optax.global_norm(grads)
         metrics = {
@@ -136,8 +160,43 @@ def make_train_step(
             "grad_norm": grad_norm,
             "num_tokens": n_tok,
         }
+
+        new_scaler = state.scaler
+        if state.scaler is not None:
+            finite = jnp.isfinite(grad_norm)
+            # Skip the update on overflow (params/opt state keep old values).
+            new_trainable = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(finite, new, old),
+                new_trainable, trainable)
+            new_opt_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(finite, new, old)
+                if hasattr(new, "shape") else new,
+                new_opt_state, opt_state)
+
+            s = state.scaler
+            # Overflow: absorb into hysteresis first, then halve the scale.
+            hyst_after = jnp.where(finite, s["hysteresis_left"],
+                                   jnp.maximum(s["hysteresis_left"] - 1, 0))
+            shrink = (~finite) & (s["hysteresis_left"] <= 1)
+            scale_after = jnp.where(
+                shrink, jnp.maximum(s["scale"] * 0.5, fp16_min_scale), s["scale"])
+            good_after = jnp.where(finite, s["good_steps"] + 1, 0)
+            # Growth: double after fp16_scale_window consecutive good steps.
+            grow = good_after >= fp16_scale_window
+            new_scaler = {
+                "scale": jnp.where(grow, scale_after * 2.0, scale_after),
+                "good_steps": jnp.where(grow, 0, good_after),
+                # Any scale change re-arms the hysteresis budget.
+                "hysteresis_left": jnp.where(
+                    shrink | grow, jnp.int32(fp16_hysteresis), hyst_after),
+            }
+            metrics["loss_scale"] = new_scaler["scale"]
+            metrics["overflow"] = (~finite).astype(jnp.float32)
+
+        new_params = combine_params(new_trainable, frozen)
         new_state = state.replace(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
+            step=state.step + 1, params=new_params, opt_state=new_opt_state,
+            scaler=new_scaler,
         )
         return new_state, metrics
 

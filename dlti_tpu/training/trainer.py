@@ -73,6 +73,10 @@ class Trainer:
             self.tx,
             (self.cfg.train.micro_batch_size, self.cfg.data.max_seq_len),
             lora_enabled=self.cfg.lora.enabled,
+            fp16_initial_scale=(
+                float(2 ** self.cfg.train.fp16_initial_scale_power)
+                if self.cfg.train.fp16 else None),
+            fp16_hysteresis=self.cfg.train.fp16_hysteresis,
         )
         if self.base_params is not None:
             from dlti_tpu.models import graft_base_params
@@ -90,7 +94,12 @@ class Trainer:
                 accum_steps=self.cfg.train.grad_accum_steps,
             )
         return jax.jit(
-            make_train_step(self.model, accum_steps=self.cfg.train.grad_accum_steps),
+            make_train_step(
+                self.model, accum_steps=self.cfg.train.grad_accum_steps,
+                fp16_scale_window=self.cfg.train.fp16_scale_window,
+                fp16_min_scale=self.cfg.train.fp16_min_scale,
+                fp16_hysteresis=self.cfg.train.fp16_hysteresis,
+            ),
             donate_argnums=(0,),
         )
 

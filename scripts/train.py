@@ -72,6 +72,11 @@ def parse_args():
                    help="sequence-parallel (ring attention) extent")
     p.add_argument("--offload-optimizer", action="store_true",
                    help="ZeRO-3 host-offload parity (ds_config_zero3.json:19-23)")
+    p.add_argument("--offload-params", action="store_true",
+                   help="ZeRO-3 param host-offload parity (ds_config_zero3.json:24-27)")
+    p.add_argument("--fp16", action="store_true",
+                   help="fp16 + dynamic loss scaling parity mode (TPU default is "
+                        "bf16, which needs no scaler — ds_config fp16 block)")
     # Checkpointing (reference: save_steps=100, keep 3 — zero1:243-245).
     p.add_argument("--save-strategy", default="steps", choices=["steps", "epoch", "no"])
     p.add_argument("--save-steps", type=int, default=100)
@@ -127,16 +132,28 @@ def build_config(args):
     if int(par.zero_stage) == 3:
         par = par.__class__(zero_stage=par.zero_stage, fsdp=n,
                             tensor=args.tensor, sequence=args.sequence,
-                            offload_optimizer=args.offload_optimizer)
+                            offload_optimizer=args.offload_optimizer,
+                            offload_params=args.offload_params)
     else:
         par = par.__class__(zero_stage=par.zero_stage, data=n,
                             tensor=args.tensor, sequence=args.sequence,
-                            offload_optimizer=args.offload_optimizer)
+                            offload_optimizer=args.offload_optimizer,
+                            offload_params=args.offload_params)
 
     dp = par.data * par.fsdp
     from dlti_tpu.utils.experiment import create_experiment_name
 
+    model_cfg = cfg.model
+    if args.fp16:
+        import dataclasses
+
+        # fp16 parity mode: compute and store in fp16 (the scaler handles
+        # overflow); without --fp16 the TPU default bf16 stays.
+        model_cfg = dataclasses.replace(model_cfg, dtype="float16",
+                                        param_dtype="float16")
+
     return cfg.replace(
+        model=model_cfg,
         parallel=par,
         lora=LoRAConfig(enabled=args.lora_r > 0, r=max(args.lora_r, 1),
                         alpha=2 * max(args.lora_r, 1)),
@@ -154,7 +171,7 @@ def build_config(args):
                           micro_batch_size=args.per_device_batch_size * dp,
                           grad_accum_steps=args.gradient_accumulation_steps,
                           logging_steps=args.logging_steps, seed=args.seed,
-                          metrics_csv=args.metrics_csv),
+                          metrics_csv=args.metrics_csv, fp16=args.fp16),
         experiment_name=create_experiment_name(
             par.num_devices, int(par.zero_stage)),
     )

@@ -1,0 +1,179 @@
+"""fp16 dynamic loss scaling + ZeRO-3 param host offload.
+
+DeepSpeed parity targets: the fp16 block of ``configs/ds_config_zero1.json:25-32``
+(dynamic scale, initial 2^16, window, hysteresis, min scale) and the ZeRO-3
+param/optimizer CPU offload of ``configs/ds_config_zero3.json:19-27``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.config import (
+    CheckpointConfig, Config, DataConfig, LoRAConfig, MODEL_PRESETS,
+    OptimizerConfig, ParallelConfig, TrainConfig, ZeROStage,
+)
+from dlti_tpu.models import LlamaForCausalLM
+from dlti_tpu.parallel import build_mesh, make_sharded_train_step, shard_train_state
+from dlti_tpu.training import build_optimizer, create_train_state, make_train_step
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+def _mk_state(fp16_scale=None, lora=True):
+    model = LlamaForCausalLM(CFG, LoRAConfig(r=4, alpha=8, dropout=0.0) if lora else None)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))  # lr > 0 at step 1
+    return model, create_train_state(
+        jax.random.PRNGKey(0), model, tx, (2, 16), lora_enabled=lora,
+        fp16_initial_scale=fp16_scale)
+
+
+def _batch(rng, accum=1, bs=2, seq=16):
+    return {
+        "input_ids": jax.random.randint(rng, (accum, bs, seq), 0, CFG.vocab_size),
+        "loss_mask": jnp.ones((accum, bs, seq), jnp.int32),
+    }
+
+
+def test_scaler_state_initialized():
+    _, state = _mk_state(fp16_scale=2.0 ** 16)
+    assert float(state.scaler["scale"]) == 65536.0
+    assert int(state.scaler["hysteresis_left"]) == 2
+    _, state = _mk_state(fp16_scale=None)
+    assert state.scaler is None
+
+
+def test_fp16_step_trains_and_reports_scale(rng):
+    model, state = _mk_state(fp16_scale=2.0 ** 4)
+    step = jax.jit(make_train_step(model, accum_steps=1, fp16_scale_window=2))
+
+    def lora_b(s):
+        # lora_b gets nonzero grads at step 1 (lora_a's are zero while B=0).
+        return np.asarray(
+            s.params["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+
+    before = lora_b(state)
+    state, m = step(state, _batch(rng), rng)
+    assert float(m["overflow"]) == 0.0
+    assert float(m["loss_scale"]) == 16.0
+    assert not np.allclose(before, lora_b(state))
+    # Window of consecutive good steps doubles the scale.
+    state, m = step(state, _batch(rng), rng)
+    assert float(m["loss_scale"]) == 32.0
+    assert int(state.scaler["good_steps"]) == 0
+
+
+def test_fp16_overflow_skips_update_and_shrinks_after_hysteresis(rng):
+    model, state = _mk_state(fp16_scale=2.0 ** 8)
+    step = jax.jit(make_train_step(model, accum_steps=1, fp16_hysteresis=2,
+                                   fp16_scale_window=1000))
+    bad = _batch(rng)
+    # Poison one LoRA factor so grads are NaN.
+    params = state.params
+    params["model"]["layers_0"]["attn"]["q_proj"]["lora_a"] = (
+        params["model"]["layers_0"]["attn"]["q_proj"]["lora_a"].at[0, 0].set(jnp.nan))
+    state = state.replace(params=params)
+    opt_before = jax.tree_util.tree_leaves(state.opt_state)
+    state, m = step(state, bad, rng)
+    assert float(m["overflow"]) == 1.0
+    # First overflow: hysteresis absorbs it, scale unchanged.
+    assert float(m["loss_scale"]) == 256.0
+    assert int(state.scaler["hysteresis_left"]) == 1
+    state, m = step(state, bad, rng)
+    # Second overflow: scale halves, hysteresis re-arms.
+    assert float(m["loss_scale"]) == 128.0
+    assert int(state.scaler["hysteresis_left"]) == 2
+    # Optimizer state was never touched by the skipped updates.
+    opt_after = jax.tree_util.tree_leaves(state.opt_state)
+    for a, b in zip(opt_before, opt_after):
+        if hasattr(a, "shape") and a.dtype.kind == "f":
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fp16_matches_fp32_step_when_no_overflow(rng):
+    """At moderate scale with fp32 params, the scaled step equals the
+    unscaled one (scaling is numerically transparent)."""
+    model, s16 = _mk_state(fp16_scale=2.0 ** 6)
+    _, s32 = _mk_state(fp16_scale=None)
+    step16 = jax.jit(make_train_step(model, accum_steps=2))
+    step32 = jax.jit(make_train_step(model, accum_steps=2))
+    b = _batch(rng, accum=2)
+    s16, m16 = step16(s16, b, rng)
+    s32, m32 = step32(s32, b, rng)
+    np.testing.assert_allclose(float(m16["loss"]), float(m32["loss"]), rtol=1e-6)
+    a = jax.tree_util.tree_leaves(s16.trainable_and_frozen()[0])
+    bb = jax.tree_util.tree_leaves(s32.trainable_and_frozen()[0])
+    for x, y in zip(a, bb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-5,
+                                   atol=1e-7)
+
+
+# ----------------------------------------------------------------------
+# ZeRO-3 param host offload
+# ----------------------------------------------------------------------
+
+def _offload_cfg(offload_params=True):
+    return Config(
+        model=CFG,
+        lora=LoRAConfig(r=4, alpha=8, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=1),
+        parallel=ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4,
+                                offload_params=offload_params,
+                                offload_optimizer=True),
+        data=DataConfig(max_seq_len=16),
+        train=TrainConfig(micro_batch_size=4, grad_accum_steps=1),
+        checkpoint=CheckpointConfig(save_strategy="no"),
+    )
+
+
+def test_param_offload_places_frozen_on_host(rng):
+    cfg = _offload_cfg()
+    mesh = build_mesh(cfg.parallel)
+    model = LlamaForCausalLM(cfg.model, cfg.lora, mesh)
+    tx = build_optimizer(cfg.optimizer)
+    state = create_train_state(rng, model, tx, (4, 16), lora_enabled=True)
+    state = shard_train_state(state, cfg, mesh)
+
+    kernel = state.params["model"]["layers_0"]["attn"]["q_proj"]["kernel"]
+    lora_a = state.params["model"]["layers_0"]["attn"]["q_proj"]["lora_a"]
+    assert kernel.sharding.memory_kind == "pinned_host"
+    assert lora_a.sharding.memory_kind in (None, "device")
+
+
+def test_param_offload_step_matches_unoffloaded(rng):
+    """One ZeRO-3 step with host-offloaded base params == same step with
+    everything in device memory."""
+    results = []
+    for offload in (True, False):
+        cfg = _offload_cfg(offload_params=offload)
+        mesh = build_mesh(cfg.parallel)
+        model = LlamaForCausalLM(cfg.model, cfg.lora, mesh)
+        tx = build_optimizer(cfg.optimizer)
+        state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                   lora_enabled=True)
+        state = shard_train_state(state, cfg, mesh)
+        step = make_sharded_train_step(model, state, cfg, mesh, accum_steps=2)
+        batch = {
+            "input_ids": jax.random.randint(
+                jax.random.PRNGKey(1), (2, 4, 16), 0, cfg.model.vocab_size),
+            "loss_mask": jnp.ones((2, 4, 16), jnp.int32),
+        }
+        state, m = step(state, batch, jax.random.PRNGKey(2))
+        results.append((float(m["loss"]),
+                        np.asarray(jax.device_get(
+                            state.params["model"]["layers_0"]["attn"]["q_proj"]["lora_b"]))))
+    assert results[0][0] == pytest.approx(results[1][0], rel=1e-6)
+    np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-5, atol=1e-7)
+
+
+def test_param_offload_requires_lora():
+    cfg = _offload_cfg()
+    cfg = cfg.replace(lora=LoRAConfig(enabled=False))
+    mesh = build_mesh(cfg.parallel)
+    model = LlamaForCausalLM(cfg.model, None, mesh)
+    tx = build_optimizer(cfg.optimizer)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                               lora_enabled=False)
+    with pytest.raises(ValueError, match="offload_params"):
+        shard_train_state(state, cfg, mesh)
