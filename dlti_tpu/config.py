@@ -148,6 +148,9 @@ class ParallelConfig:
     fsdp: int = 1
     tensor: int = 1
     sequence: int = 1
+    # Pipeline parallelism: the layer stack is split into `pipe` stages and
+    # microbatches flow through a GPipe schedule (dlti_tpu.parallel.pipeline).
+    pipe: int = 1
     # ZeRO-3 host offload parity (configs/ds_config_zero3.json:19-27).
     # offload_optimizer places optimizer state in pinned host memory (wired
     # in opt_state_shardings); offload_params places the frozen base params
@@ -158,7 +161,7 @@ class ParallelConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.sequence
+        return self.data * self.fsdp * self.tensor * self.sequence * self.pipe
 
     @property
     def dp_like_size(self) -> int:

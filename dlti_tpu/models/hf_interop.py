@@ -97,14 +97,19 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
         if model_type == "qwen2":
             # Qwen2 ships sliding_window with use_sliding_window defaulting
             # to *false* (full attention), and when enabled applies it only
-            # to layers >= max_window_layers — we support all-or-nothing.
+            # to layers >= max_window_layers — we support all-or-nothing:
+            # mwl <= 0 windows every layer; mwl >= num_layers windows none
+            # (common shipped configs set mwl == num_hidden_layers).
             if hf.get("use_sliding_window", False):
                 mwl = hf.get("max_window_layers", kw["num_layers"])
-                if mwl not in (0, None):
+                if mwl is None or mwl <= 0:
+                    kw["sliding_window"] = int(hf["sliding_window"])
+                elif mwl < kw["num_layers"]:
                     raise NotImplementedError(
                         "per-layer sliding window (qwen2 max_window_layers="
-                        f"{mwl}) is not supported; only uniform windows")
-                kw["sliding_window"] = int(hf["sliding_window"])
+                        f"{mwl} of {kw['num_layers']}) is not supported; "
+                        "only uniform windows")
+                # else: no layer is windowed -> full attention, nothing to set
         elif hf.get("use_sliding_window", True):
             kw["sliding_window"] = int(hf["sliding_window"])
     act = hf.get("hidden_act", "silu")

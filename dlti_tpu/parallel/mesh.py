@@ -28,14 +28,14 @@ from jax.sharding import Mesh
 
 from dlti_tpu.config import ParallelConfig
 
-MESH_AXES = ("data", "fsdp", "tensor", "sequence")
+MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipe")
 
 
 def build_mesh(cfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a 4-axis mesh of shape (data, fsdp, tensor, sequence)."""
+    """Build a 5-axis mesh of shape (data, fsdp, tensor, sequence, pipe)."""
     if devices is None:
         devices = jax.devices()
-    shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence)
+    shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe)
     n = int(np.prod(shape))
     if n > len(devices):
         raise ValueError(

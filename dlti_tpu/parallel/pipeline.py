@@ -1,0 +1,273 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pipe`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2c: "PP: No"); this
+is the TPU-native extension: the transformer block stack is split into
+``pipe`` contiguous stages, each device holds ``num_layers / pipe`` layers,
+and microbatches flow through the stages with ``lax.ppermute`` moving
+activations stage-to-stage over ICI — the collective-permute pipelining
+pattern (scaling-book) rather than host-driven stage processes.
+
+Layout: the per-layer param subtrees of the standard model tree
+(``model.layers_{i}``) are stacked into one tree with a leading layer dim
+(:func:`to_pipeline_params`), sharded over ``pipe``. Embeddings / final
+norm / LM head are replicated and applied outside the pipelined region
+(they are a few percent of FLOPs; sharding them rides the ``tensor`` axis
+when combined with TP).
+
+Schedule (plain GPipe): with ``P`` stages and ``M`` microbatches, run
+``M + P - 1`` ticks; at tick ``t`` stage 0 ingests microbatch ``t`` (while
+``t < M``), every stage applies its local layers, and activations
+ppermute to the next stage. The last stage's outputs for ticks
+``P-1 .. M+P-2`` are microbatch ``0 .. M-1``. Bubble fraction is
+``(P-1)/(M+P-1)`` — pick ``M >= 4*P`` for >80% utilization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlti_tpu.config import Config, LoRAConfig, ModelConfig
+from dlti_tpu.models.llama import LlamaBlock, RMSNorm, _dtype
+from dlti_tpu.ops.rope import rope_frequencies
+
+
+# ----------------------------------------------------------------------
+# Param layout: standard tree <-> pipeline (stacked-layer) tree
+# ----------------------------------------------------------------------
+
+def to_pipeline_params(params: dict, num_layers: int) -> dict:
+    """Standard param tree -> pipeline layout.
+
+    ``model.layers_{i}`` subtrees stack into ``layers`` with a leading
+    layer dim; embed/final-norm/lm-head stay as-is.
+    """
+    model = params["model"]
+    layer_trees = [model[f"layers_{i}"] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layer_trees)
+    out = {
+        "embed_tokens": model["embed_tokens"],
+        "layers": stacked,
+        "final_norm": model["final_norm"],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = params["lm_head"]
+    return out
+
+
+def from_pipeline_params(pparams: dict, num_layers: int) -> dict:
+    """Inverse of :func:`to_pipeline_params`."""
+    model: dict = {
+        "embed_tokens": pparams["embed_tokens"],
+        "final_norm": pparams["final_norm"],
+    }
+    for i in range(num_layers):
+        model[f"layers_{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], pparams["layers"])
+    out = {"model": model}
+    if "lm_head" in pparams:
+        out["lm_head"] = pparams["lm_head"]
+    return out
+
+
+def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
+    """Stacked layers sharded over ``pipe`` on the layer dim; rest replicated."""
+    def leaf_layers(v):
+        spec = [None] * v.ndim
+        spec[0] = "pipe"
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        k: (jax.tree_util.tree_map(leaf_layers, v) if k == "layers"
+            else jax.tree_util.tree_map(
+                lambda x: NamedSharding(mesh, P()), v))
+        for k, v in pparams.items()
+    }
+
+
+# ----------------------------------------------------------------------
+# Pipelined forward
+# ----------------------------------------------------------------------
+
+def pipeline_forward(
+    pparams: dict,
+    input_ids: jnp.ndarray,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    *,
+    lora: Optional[LoRAConfig] = None,
+    num_microbatches: int = 4,
+    positions: Optional[jnp.ndarray] = None,
+    deterministic: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Run the full model with the block stack pipelined over ``pipe``.
+
+    ``input_ids``: (batch, seq); batch must divide by ``num_microbatches``.
+    Returns float32 logits (batch, seq, vocab) — the same function as
+    ``LlamaForCausalLM.apply`` on the equivalent unstacked params.
+    """
+    num_stages = mesh.shape["pipe"]
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"num_layers={cfg.num_layers} must divide into "
+                         f"pipe={num_stages} stages")
+    b, s = input_ids.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch={b} must divide by microbatches={num_microbatches}")
+    mb = b // num_microbatches
+    dtype = _dtype(cfg.dtype)
+
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    cos, sin = rope_frequencies(cfg.resolved_head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    # Embed outside the pipelined region (replicated).
+    x = jnp.take(pparams["embed_tokens"], input_ids, axis=0).astype(dtype)
+    x_mb = x.reshape(num_microbatches, mb, s, -1)
+    pos_mb = positions.reshape(num_microbatches, mb, s)
+
+    block = LlamaBlock(cfg, lora)
+
+    def apply_stage(layer_params, x, pos, rng):
+        """Apply this stage's local layers (leading dim = layers/stage)."""
+        def body(carry, one_layer):
+            h = carry
+            rngs = {"dropout": rng} if not deterministic else None
+            out, _ = block.apply({"params": one_layer}, h, cos, sin, pos,
+                                 None, None, deterministic, rngs=rngs)
+            return out, None
+
+        fn = jax.checkpoint(body) if cfg.remat else body
+        x, _ = jax.lax.scan(fn, x, layer_params)
+        return x
+
+    num_ticks = num_microbatches + num_stages - 1
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pipe"), pparams["layers"]),
+                  P(), P(), P()),
+        out_specs=P(),
+    )
+    def run_pipeline(local_layers, x_mb, pos_mb, rng):
+        # Inside: one pipeline stage per device along 'pipe'.
+        local_layers = jax.tree_util.tree_map(lambda v: v, local_layers)
+        stage = jax.lax.axis_index("pipe")
+        # Initial carries must be device-varying for the scan's carry type
+        # to be stable (they become varying after the first ppermute).
+        buf = jax.lax.pvary(jnp.zeros_like(x_mb[0]), "pipe")
+        outputs = jax.lax.pvary(jnp.zeros_like(x_mb), "pipe")
+
+        def tick(carry, t):
+            buf, outputs = carry
+            m_in = jnp.clip(t, 0, num_microbatches - 1)
+            inp = jnp.where(stage == 0, x_mb[m_in], buf)
+            # Positions for the microbatch this stage is processing at tick
+            # t: stage k works on microbatch t - k.
+            m_here = jnp.clip(t - stage, 0, num_microbatches - 1)
+            pos = pos_mb[m_here]
+            out = apply_stage(local_layers, inp, pos,
+                              jax.random.fold_in(rng, t))
+            # Last stage finished microbatch t - (P-1) at this tick.
+            m_out = t - (num_stages - 1)
+            write = (stage == num_stages - 1) & (m_out >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.maximum(m_out, 0), 0)
+            outputs = jnp.where(write, updated, outputs)
+            buf = jax.lax.ppermute(out, "pipe", perm)
+            return (buf, outputs), None
+
+        (buf, outputs), _ = jax.lax.scan(
+            tick, (buf, outputs), jnp.arange(num_ticks))
+        # Only the last stage holds real outputs; broadcast to every stage
+        # (psum over the one-hot mask — a pipe-axis all-reduce on ICI).
+        mask = (stage == num_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, "pipe")
+
+    rng_arg = (dropout_rng if dropout_rng is not None
+               else jax.random.PRNGKey(0))  # unused when deterministic
+    y = run_pipeline(pparams["layers"], x_mb, pos_mb, rng_arg)
+    y = y.reshape(b, s, -1)
+
+    # Final norm + head outside the pipeline (replicated).
+    norm = RMSNorm(cfg.rms_norm_eps)
+    y = norm.apply({"params": pparams["final_norm"]}, y)
+    if cfg.tie_embeddings or "lm_head" not in pparams:
+        logits = jnp.einsum("bsh,vh->bsv", y.astype(jnp.float32),
+                            pparams["embed_tokens"].astype(jnp.float32))
+    else:
+        logits = jnp.dot(y, pparams["lm_head"].astype(y.dtype),
+                         preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32)
+
+
+def to_pipeline_state(state, num_layers: int):
+    """Convert a fresh TrainState to pipeline layout.
+
+    Re-initializes optimizer state over the stacked trainable tree, so use
+    at step 0 (converting mid-run would discard Adam moments).
+    """
+    from dlti_tpu.training.state import partition_params
+
+    pparams = to_pipeline_params(state.params, num_layers)
+    trainable, _ = partition_params(pparams, state.lora_enabled)
+    return state.replace(params=pparams, opt_state=state.tx.init(trainable))
+
+
+# ----------------------------------------------------------------------
+# Pipelined train step
+# ----------------------------------------------------------------------
+
+def make_pipeline_train_step(
+    cfg: Config,
+    tx,
+    mesh: Mesh,
+    *,
+    num_microbatches: int = 4,
+) -> Callable:
+    """Build ``step(state, batch, rng) -> (state, metrics)`` where
+    ``state.params`` is in *pipeline layout* (see :func:`to_pipeline_params`).
+
+    The loss/optimizer semantics match ``make_train_step`` (token-mean
+    causal-LM loss, trainable-subset grads); grad accumulation happens
+    through the microbatch schedule itself.
+    """
+    import optax
+
+    from dlti_tpu.training.state import combine_params, partition_params
+    from dlti_tpu.training.step import causal_lm_loss
+
+    lora = cfg.lora if cfg.lora.enabled else None
+
+    def loss_fn(trainable, frozen, batch, rng):
+        pparams = combine_params(trainable, frozen)
+        logits = pipeline_forward(
+            pparams, batch["input_ids"], cfg.model, mesh, lora=lora,
+            num_microbatches=num_microbatches,
+            deterministic=False, dropout_rng=rng,
+        )
+        loss_sum, n_tok = causal_lm_loss(
+            logits, batch["input_ids"], batch.get("loss_mask"))
+        return loss_sum / jnp.maximum(n_tok, 1.0), n_tok
+
+    def step(state, batch, rng):
+        trainable, frozen = state.trainable_and_frozen()
+        (loss, n_tok), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            trainable, frozen, batch, rng)
+        updates, new_opt = state.tx.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   "num_tokens": n_tok}
+        return state.replace(
+            step=state.step + 1,
+            params=combine_params(new_trainable, frozen),
+            opt_state=new_opt,
+        ), metrics
+
+    return jax.jit(step, donate_argnums=(0,))

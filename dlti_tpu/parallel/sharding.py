@@ -253,6 +253,14 @@ def make_sharded_train_step(
     """
     from dlti_tpu.training.step import make_train_step
 
+    if cfg.parallel.pipe > 1:
+        raise ValueError(
+            "make_sharded_train_step does not implement pipeline "
+            "parallelism; with parallel.pipe > 1 use "
+            "dlti_tpu.parallel.pipeline.make_pipeline_train_step (the GPipe "
+            "schedule) — running this step on a pipe mesh would silently "
+            "replicate all work across the pipe axis"
+        )
     if cfg.parallel.sequence > 1 and cfg.data.pack_sequences:
         raise ValueError(
             "sequence parallelism (parallel.sequence > 1) does not compose "
@@ -329,8 +337,11 @@ def make_sharded_train_step(
     if not has_offload:
         return jitted
 
-    frozen_offloaded = (cfg.parallel.offload_params
-                        and _host_memory_kind(mesh) is not None)
+    # Derived from the actual param shardings (single source of truth with
+    # param_shardings' offload policy).
+    frozen_offloaded = any(
+        getattr(s, "memory_kind", None) == "pinned_host"
+        for s in jax.tree_util.tree_leaves(st_sh.params))
 
     def step_with_offload(state, batch, rng):
         host_state = state
