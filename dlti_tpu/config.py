@@ -67,6 +67,13 @@ class ModelConfig:
     attention_bias: bool = False        # Qwen2: bias on q/k/v (never o)
     sliding_window: Optional[int] = None  # Mistral: local attention window
     mlp_activation: str = "silu"        # "silu" | "gelu_tanh" | "gelu_exact"
+    # Mixture of Experts (Mixtral family): 0 experts = dense MLP. When > 0
+    # every block's MLP is a top-k routed expert layer
+    # (dlti_tpu.models.moe.MoEMLP) with GShard capacity dispatch.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
     dtype: str = "bfloat16"  # compute dtype (MXU-friendly)
     param_dtype: str = "bfloat16"  # storage dtype of (frozen) base params
     remat: bool = True  # jax.checkpoint each block (grad-ckpt parity)
@@ -86,13 +93,29 @@ class ModelConfig:
 
     def num_params(self, include_lm_head: bool = True) -> int:
         """Analytic parameter count (for MFU and reporting)."""
+        return self._count_params(include_lm_head, active_only=False)
+
+    def num_active_params(self, include_lm_head: bool = True) -> int:
+        """Params touched per token — equals :meth:`num_params` for dense
+        models; for MoE, k routed experts instead of all E (the count that
+        drives FLOPs/token and MFU)."""
+        return self._count_params(include_lm_head, active_only=True)
+
+    def _count_params(self, include_lm_head: bool, active_only: bool) -> int:
         h, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
         hd = self.resolved_head_dim
         q = h * self.num_heads * hd
         kv = 2 * h * self.num_kv_heads * hd
         o = self.num_heads * hd * h
         attn = q + kv + o
-        mlp = 3 * h * m
+        if self.attention_bias:
+            attn += (self.num_heads + 2 * self.num_kv_heads) * hd
+        if self.num_experts > 0:
+            n_ffn = (self.num_experts_per_tok if active_only
+                     else self.num_experts)
+            mlp = n_ffn * 3 * h * m + h * self.num_experts  # experts + router
+        else:
+            mlp = 3 * h * m
         norms = 2 * h
         per_layer = attn + mlp + norms
         total = v * h + self.num_layers * per_layer + h  # embed + layers + final norm
@@ -151,6 +174,9 @@ class ParallelConfig:
     # Pipeline parallelism: the layer stack is split into `pipe` stages and
     # microbatches flow through a GPipe schedule (dlti_tpu.parallel.pipeline).
     pipe: int = 1
+    # Expert parallelism: MoE expert weights and buffers shard over this
+    # axis (all-to-all dispatch inserted by GSPMD).
+    expert: int = 1
     # ZeRO-3 host offload parity (configs/ds_config_zero3.json:19-27).
     # offload_optimizer places optimizer state in pinned host memory (wired
     # in opt_state_shardings); offload_params places the frozen base params
@@ -161,7 +187,8 @@ class ParallelConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.sequence * self.pipe
+        return (self.data * self.fsdp * self.tensor * self.sequence
+                * self.pipe * self.expert)
 
     @property
     def dp_like_size(self) -> int:
@@ -347,6 +374,19 @@ MODEL_PRESETS: dict = {
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, max_seq_len=32768,
         rope_theta=1000000.0, attention_bias=True,
+    ),
+    # Mixtral-8x7B: sparse MoE (8 experts, top-2) on the Mistral base.
+    "mixtral_8x7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2,
+    ),
+    # Test-scale MoE (structurally Mixtral: GQA + top-2 of 4 experts).
+    "mixtral_tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128, remat=False,
+        dtype="float32", param_dtype="float32", num_experts=4,
+        num_experts_per_tok=2,
     ),
 }
 

@@ -232,16 +232,28 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, segment_ids=None, cache=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, token_mask=None):
         cfg = self.cfg
         attn_out, new_cache = LlamaAttention(cfg, self.lora, self.mesh, name="attn")(
             RMSNorm(cfg.rms_norm_eps, name="input_norm")(x),
             cos, sin, positions, segment_ids, cache, deterministic,
         )
         x = x + attn_out
-        mlp_out = LlamaMLP(cfg, self.lora, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(x), deterministic
-        )
+        normed = RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(x)
+        if cfg.num_experts > 0:
+            from dlti_tpu.models.moe import MoEMLP
+
+            if self.lora is not None and any(
+                    t in ("gate_proj", "up_proj", "down_proj")
+                    for t in self.lora.target_modules):
+                raise NotImplementedError(
+                    "LoRA on MLP projections is not supported for MoE "
+                    "models (experts have no adapter branch); target "
+                    "attention projections only")
+            mlp_out = MoEMLP(cfg, self.mesh, name="mlp")(
+                normed, deterministic, token_mask)
+        else:
+            mlp_out = LlamaMLP(cfg, self.lora, name="mlp")(normed, deterministic)
         return x + mlp_out, new_cache
 
 
@@ -264,11 +276,13 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, token_mask=None):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         pdtype = _dtype(cfg.param_dtype)
         b, s = input_ids.shape
+        if token_mask is None and segment_ids is not None:
+            token_mask = (segment_ids != 0).astype(jnp.int32)  # packed: 0 = pad
 
         embed = self.param(
             "embed_tokens",
@@ -303,7 +317,8 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
             x, layer_new_cache = block_cls(cfg, self.lora, self.mesh, name=f"layers_{i}")(
-                x, cos, sin, positions, segment_ids, layer_cache, deterministic
+                x, cos, sin, positions, segment_ids, layer_cache, deterministic,
+                token_mask,
             )
             if cache is not None:
                 new_caches.append(layer_new_cache)
@@ -321,11 +336,11 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, token_mask=None):
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         x, new_cache = LlamaModel(cfg, self.lora, self.mesh, name="model")(
-            input_ids, positions, segment_ids, cache, deterministic
+            input_ids, positions, segment_ids, cache, deterministic, token_mask
         )
         if cfg.tie_embeddings:
             embed = self.variables["params"]["model"]["embed_tokens"]

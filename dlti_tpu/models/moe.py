@@ -1,0 +1,139 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Mixtral-style top-k routed SwiGLU experts, expressed the TPU way: instead
+of per-token Python dispatch (host control flow XLA can't compile), tokens
+are packed into fixed-capacity per-expert buffers with one-hot dispatch /
+combine einsums (the GShard/Switch formulation). All shapes are static;
+the only data-dependent effect is token dropping when an expert
+overflows its capacity — controlled by ``moe_capacity_factor``.
+
+Expert parallelism rides a dedicated ``expert`` mesh axis: the stacked
+expert weights ``(E, ...)`` shard on dim 0, the dispatched activations
+``(E, C, h)`` shard on their expert dim, and GSPMD inserts the
+all-to-all between the token-sharded and expert-sharded layouts.
+
+The router's load-balance auxiliary loss (Switch §2.2 / Mixtral) is
+recorded via ``self.sow("intermediates", "router_aux_loss", ...)``; the
+train step collects it when ``ModelConfig.num_experts > 0``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dlti_tpu.config import ModelConfig
+from dlti_tpu.models.llama import _dtype
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert SwiGLU MLP (drop-in for LlamaMLP)."""
+
+    cfg: ModelConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True,
+                 token_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """``token_mask`` (b, s): 1 for real tokens, 0 for padding. Padding
+        tokens are excluded from routing — they'd otherwise consume expert
+        capacity (displacing real tokens of later sequences in the batch)
+        and bias the load-balance statistics."""
+        cfg = self.cfg
+        dtype = _dtype(cfg.dtype)
+        pdtype = _dtype(cfg.param_dtype)
+        b, s, h = x.shape
+        E = cfg.num_experts
+        k = cfg.num_experts_per_tok
+        m = cfg.intermediate_size
+        T = b * s
+        valid = (jnp.ones((T,), jnp.float32) if token_mask is None
+                 else token_mask.reshape(T).astype(jnp.float32))
+
+        # Router in fp32 for stable softmax/top-k.
+        router_kernel = self.param(
+            "router", nn.initializers.lecun_normal(), (h, E), jnp.float32)
+        xt = x.reshape(T, h)
+        logits = jnp.dot(xt.astype(jnp.float32), router_kernel)          # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_w, topk_idx = jax.lax.top_k(probs, k)                        # (T, k)
+        topk_w = topk_w / jnp.maximum(
+            jnp.sum(topk_w, axis=-1, keepdims=True), 1e-9)  # Mixtral renorm
+        topk_w = topk_w * valid[:, None]
+
+        # Fixed expert capacity (static shape): each expert accepts at most
+        # C of the T*k routed slots; overflow tokens are dropped for that
+        # expert (their combine weight is zeroed).
+        C = max(int(cfg.moe_capacity_factor * T * k / E), 1)
+
+        # Position of each (token, slot) within its expert's buffer,
+        # counted over slots-major order so slot 0 (highest router weight)
+        # wins buffer space first.
+        flat_e = topk_idx.T.reshape(-1)                                   # (k*T,)
+        flat_valid = jnp.tile(valid, k).astype(jnp.int32)
+        # Padding tokens take no buffer rank and never dispatch.
+        onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32) * flat_valid[:, None]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot                # rank in expert
+        pos = jnp.sum(pos, axis=-1)                                       # (k*T,)
+        keep = (pos < C) & (flat_valid > 0)
+
+        slot_w = topk_w.T.reshape(-1) * keep                              # (k*T,)
+        # dispatch[t, e, c]: token t occupies slot c of expert e.
+        disp = (jax.nn.one_hot(flat_e, E, dtype=jnp.float32)[:, :, None]
+                * jax.nn.one_hot(jnp.where(keep, pos, C), C + 1,
+                                 dtype=jnp.float32)[:, None, :C])          # (kT,E,C)
+        combine = disp * slot_w[:, None, None]
+        # Fold the k slots back onto tokens.
+        disp = disp.reshape(k, T, E, C).sum(0)
+        combine = combine.reshape(k, T, E, C).sum(0)
+
+        expert_in = jnp.einsum("tec,th->ech", disp.astype(dtype),
+                               xt.astype(dtype))                          # (E,C,h)
+        expert_in = self._expert_constraint(expert_in)
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (E, h, m), pdtype)
+        w3 = self.param("w3", nn.initializers.lecun_normal(), (E, h, m), pdtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (E, m, h), pdtype)
+
+        hidden = (nn.silu(jnp.einsum("ech,ehm->ecm", expert_in, w1.astype(dtype)))
+                  * jnp.einsum("ech,ehm->ecm", expert_in, w3.astype(dtype)))
+        out_e = jnp.einsum("ecm,emh->ech", hidden, w2.astype(dtype))
+        out_e = self._expert_constraint(out_e)
+
+        y = jnp.einsum("tec,ech->th", combine.astype(dtype), out_e)       # (T,h)
+
+        # Load-balance aux loss (Switch Transformers eq. 4, Mixtral's k
+        # normalization): E * sum_e f_e * P_e with f_e = fraction of routed
+        # *assignments* landing on expert e, P_e = mean router prob.
+        # Equals 1 at perfect balance, its minimum.
+        n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+        frac = (jnp.sum(
+            jax.nn.one_hot(topk_idx, E, dtype=jnp.float32).sum(1)
+            * valid[:, None], axis=0) / (n_valid * k))
+        mean_prob = jnp.sum(probs * valid[:, None], axis=0) / n_valid     # (E,)
+        aux = E * jnp.sum(frac * mean_prob)
+        self.sow("intermediates", "router_aux_loss", aux)
+
+        return y.reshape(b, s, h)
+
+    def _expert_constraint(self, v: jnp.ndarray) -> jnp.ndarray:
+        """Pin the expert dim to the 'expert' mesh axis (GSPMD then places
+        the all-to-all between token- and expert-sharded layouts)."""
+        if (self.mesh is not None and "expert" in self.mesh.shape
+                and self.mesh.shape["expert"] > 1):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(self.mesh, P("expert", None, None)))
+        return v
+
+
+def collect_aux_loss(intermediates: dict) -> jnp.ndarray:
+    """Sum every sown ``router_aux_loss`` scalar (one per MoE layer)."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(intermediates):
+        total = total + jnp.sum(leaf)
+    return total

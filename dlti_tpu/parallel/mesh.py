@@ -28,14 +28,15 @@ from jax.sharding import Mesh
 
 from dlti_tpu.config import ParallelConfig
 
-MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipe")
+MESH_AXES = ("data", "fsdp", "tensor", "sequence", "pipe", "expert")
 
 
 def build_mesh(cfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
-    """Build a 5-axis mesh of shape (data, fsdp, tensor, sequence, pipe)."""
+    """Build a 6-axis mesh (data, fsdp, tensor, sequence, pipe, expert)."""
     if devices is None:
         devices = jax.devices()
-    shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe)
+    shape = (cfg.data, cfg.fsdp, cfg.tensor, cfg.sequence, cfg.pipe,
+             cfg.expert)
     n = int(np.prod(shape))
     if n > len(devices):
         raise ValueError(

@@ -116,6 +116,11 @@ def pipeline_forward(
     if cfg.num_layers % num_stages != 0:
         raise ValueError(f"num_layers={cfg.num_layers} must divide into "
                          f"pipe={num_stages} stages")
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "MoE models are not supported under pipeline parallelism yet "
+            "(the router load-balance aux loss sown inside the pipelined "
+            "region is not collected); use data/fsdp/tensor/expert axes")
     b, s = input_ids.shape
     if b % num_microbatches != 0:
         raise ValueError(f"batch={b} must divide by microbatches={num_microbatches}")
@@ -133,17 +138,24 @@ def pipeline_forward(
 
     block = LlamaBlock(cfg, lora)
 
+    layers_per_stage = cfg.num_layers // num_stages
+
     def apply_stage(layer_params, x, pos, rng):
         """Apply this stage's local layers (leading dim = layers/stage)."""
-        def body(carry, one_layer):
+        def body(carry, layer_with_idx):
             h = carry
-            rngs = {"dropout": rng} if not deterministic else None
+            one_layer, layer_idx = layer_with_idx
+            # Distinct dropout mask per layer (the unpipelined model's
+            # layers_{i} module paths fold distinct keys).
+            rngs = ({"dropout": jax.random.fold_in(rng, layer_idx)}
+                    if not deterministic else None)
             out, _ = block.apply({"params": one_layer}, h, cos, sin, pos,
                                  None, None, deterministic, rngs=rngs)
             return out, None
 
         fn = jax.checkpoint(body) if cfg.remat else body
-        x, _ = jax.lax.scan(fn, x, layer_params)
+        x, _ = jax.lax.scan(
+            fn, x, (layer_params, jnp.arange(layers_per_stage)))
         return x
 
     num_ticks = num_microbatches + num_stages - 1
@@ -157,7 +169,6 @@ def pipeline_forward(
     )
     def run_pipeline(local_layers, x_mb, pos_mb, rng):
         # Inside: one pipeline stage per device along 'pipe'.
-        local_layers = jax.tree_util.tree_map(lambda v: v, local_layers)
         stage = jax.lax.axis_index("pipe")
         # Initial carries must be device-varying for the scan's carry type
         # to be stable (they become varying after the first ppermute).
@@ -172,8 +183,11 @@ def pipeline_forward(
             # t: stage k works on microbatch t - k.
             m_here = jnp.clip(t - stage, 0, num_microbatches - 1)
             pos = pos_mb[m_here]
+            # Fold the stage in as well: stage k's layers are globally
+            # layers k*K..(k+1)*K-1, so masks differ across stages too.
             out = apply_stage(local_layers, inp, pos,
-                              jax.random.fold_in(rng, t))
+                              jax.random.fold_in(
+                                  jax.random.fold_in(rng, t), stage))
             # Last stage finished microbatch t - (P-1) at this tick.
             m_out = t - (num_stages - 1)
             write = (stage == num_stages - 1) & (m_out >= 0)

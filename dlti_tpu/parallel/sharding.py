@@ -49,7 +49,12 @@ _TP_RULES = [
     (r".*down_proj/lora_a$", 0),
     (r".*embed_tokens$", 0),                     # shard vocab rows
     (r".*lm_head$", 1),                          # shard vocab cols
+    (r".*mlp/(w1|w3)$", 2),                      # expert ffn hidden (E,h,m)
+    (r".*mlp/w2$", 1),                           # (E,m,h) row-parallel
 ]
+
+# Expert-parallel rule: stacked expert weights shard dim 0 over 'expert'.
+_EP_PATTERN = re.compile(r".*mlp/(w1|w2|w3)$")
 
 
 def _path_str(path: tuple) -> str:
@@ -71,11 +76,13 @@ def _tp_dim(path_s: str) -> Optional[int]:
     return None
 
 
-def _largest_divisible_dim(shape: tuple, size: int, taken: Optional[int] = None) -> Optional[int]:
-    """Pick the largest dim divisible by ``size`` (excluding ``taken``)."""
+def _largest_divisible_dim(shape: tuple, size: int, taken=()) -> Optional[int]:
+    """Pick the largest dim divisible by ``size`` (excluding ``taken`` dims)."""
+    if taken is None or isinstance(taken, int):
+        taken = (taken,)
     best, best_len = None, 0
     for d, n in enumerate(shape):
-        if d == taken:
+        if d in taken:
             continue
         if n % size == 0 and n > best_len:
             best, best_len = d, n
@@ -90,9 +97,15 @@ def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     path_s = _path_str(path)
     spec: list = [None] * len(shape)
 
+    ep_d = None
+    ep_size = mesh.shape.get("expert", 1)
+    if ep_size > 1 and _EP_PATTERN.match(path_s) and shape[0] % ep_size == 0:
+        spec[0] = "expert"
+        ep_d = 0
+
     tp_size = mesh.shape["tensor"]
     tp_d = _tp_dim(path_s) if tp_size > 1 else None
-    if tp_d is not None and shape[tp_d] % tp_size == 0:
+    if tp_d is not None and tp_d != ep_d and shape[tp_d] % tp_size == 0:
         spec[tp_d] = "tensor"
     else:
         tp_d = None
@@ -100,7 +113,7 @@ def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     if cfg.parallel.zero_stage == ZeROStage.ZERO3:
         fsdp_size = mesh.shape["fsdp"]
         if fsdp_size > 1:
-            d = _largest_divisible_dim(shape, fsdp_size, taken=tp_d)
+            d = _largest_divisible_dim(shape, fsdp_size, taken=(tp_d, ep_d))
             # Don't FSDP-shard tiny params (norm scales, LoRA factors with
             # dim < 1024): the all-gather latency outweighs memory savings.
             if d is not None and shape[d] >= 1024:

@@ -87,21 +87,42 @@ def make_train_step(
     ``fp16_scale_window`` consecutive good steps.
     """
 
+    model_cfg = getattr(model, "cfg", None)
+    moe_coef = (model_cfg.router_aux_loss_coef
+                if model_cfg is not None and model_cfg.num_experts > 0 else 0.0)
+
     def microbatch_loss(trainable, frozen, micro, rng):
         params = combine_params(trainable, frozen)
         input_ids = micro["input_ids"]
         loss_mask = micro.get("loss_mask")
         if sharding_constraint is not None:
             input_ids = sharding_constraint(input_ids)
-        logits, _ = model.apply(
-            {"params": params}, input_ids,
+        apply_kwargs = dict(
             positions=micro.get("positions"),  # packed: per-doc RoPE restart
             segment_ids=micro.get("segment_ids"),  # packed: intra-doc attention
             deterministic=False,
             rngs={"dropout": rng},
         )
+        if moe_coef and loss_mask is not None:
+            # Keep padding tokens out of expert capacity/aux statistics.
+            apply_kwargs["token_mask"] = loss_mask
+        if moe_coef:
+            # MoE: collect the sown per-layer router load-balance losses
+            # (dlti_tpu.models.moe.MoEMLP) alongside the LM loss.
+            ((logits, _), variables) = model.apply(
+                {"params": params}, input_ids,
+                mutable=["intermediates"], **apply_kwargs,
+            )
+            from dlti_tpu.models.moe import collect_aux_loss
+
+            aux = collect_aux_loss(variables.get("intermediates", {}))
+        else:
+            logits, _ = model.apply({"params": params}, input_ids, **apply_kwargs)
+            aux = 0.0
         loss_sum, n_tok = causal_lm_loss(logits, input_ids, loss_mask)
-        return loss_sum, n_tok
+        # Weight the (per-microbatch mean) aux loss by tokens so the final
+        # /n_tok gives ce_mean + coef * token-weighted-mean(aux).
+        return loss_sum + moe_coef * aux * n_tok, n_tok
 
     def train_step(state: TrainState, batch: dict, rng: jax.Array):
         trainable, frozen = state.trainable_and_frozen()

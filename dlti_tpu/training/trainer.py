@@ -262,7 +262,11 @@ class Trainer:
             timer.steps_per_second * tokens_per_step / max(jax.device_count(), 1)
         )
         peak_flops = detect_chip_peak_flops()
-        mfu = compute_mfu(tok_s_chip, total, peak_flops, trainable_params=trainable)
+        # MoE: FLOPs/token follow the k *routed* experts, not all E.
+        n_for_flops = (cfg.model.num_active_params()
+                       if cfg.model.num_experts > 0 else total)
+        mfu = compute_mfu(tok_s_chip, n_for_flops, peak_flops,
+                          trainable_params=trainable)
         return MetricsRecord(
             experiment=experiment_name_from_config(cfg),
             num_gpus=cfg.parallel.num_devices,
