@@ -54,6 +54,11 @@ class EngineConfig:
     prefill_buckets: Sequence[int] = ()  # default: powers of 2 up to max_model_len
     cache_dtype: str = "bfloat16"
     eos_token_id: int = 2          # Llama-2 </s>
+    # Automatic prefix caching (dlti_tpu.serving.prefix_cache): retired
+    # sequences' full KV blocks are kept content-addressed and reused by
+    # later requests sharing a prompt prefix; unreferenced blocks are
+    # evicted LRU under pool pressure.
+    enable_prefix_caching: bool = False
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -163,6 +168,11 @@ class InferenceEngine:
             model_cfg.num_kv_heads, model_cfg.resolved_head_dim, dtype,
         )
         self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
+        self.prefix_cache = None
+        if ec.enable_prefix_caching:
+            from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
+
+            self.prefix_cache = PrefixCachingAllocator(self.block_manager)
         self.slots = [_Slot(i) for i in range(ec.max_seqs)]
         self.waiting: collections.deque[Request] = collections.deque()
         # Recently-finished requests, for observability only (results are
@@ -190,7 +200,8 @@ class InferenceEngine:
 
         # Aggregate stats for the /stats endpoint and load reports.
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
-                      "preemptions": 0, "decode_steps": 0}
+                      "preemptions": 0, "decode_steps": 0,
+                      "prefix_cached_tokens": 0}
 
     # ------------------------------------------------------------------
     # Compiled programs
@@ -298,28 +309,56 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # Scheduling internals
     # ------------------------------------------------------------------
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate blocks, evicting LRU cached prefixes under pressure."""
+        if self.prefix_cache is not None:
+            return self.prefix_cache.allocate(n)
+        return self.block_manager.allocate(n)
+
     def _admit(self) -> None:
         """Admit waiting requests into free slots via bucketed prefill."""
         for slot in self.slots:
             if not self.waiting or not slot.free:
                 continue
             req = self.waiting[0]
-            n_prompt = len(req.prompt_token_ids) + len(req.output_token_ids)
-            need = self.block_manager.blocks_needed(n_prompt + 1)
-            blocks = self.block_manager.allocate(need)
+            tokens = req.prompt_token_ids + req.output_token_ids
+            cached_blocks: List[int] = []
+            n_cached = 0
+            if self.prefix_cache is not None:
+                cached_blocks, n_cached = self.prefix_cache.match_prefix(tokens)
+                # Pin the matched blocks BEFORE allocating the suffix —
+                # otherwise the allocation's own eviction could reclaim them.
+                self.prefix_cache.acquire(cached_blocks)
+            need = (self.block_manager.blocks_needed(len(tokens) + 1)
+                    - len(cached_blocks))
+            blocks = self._alloc(need)
             if blocks is None:
+                if cached_blocks:
+                    self.prefix_cache.release(cached_blocks)
                 break  # head-of-line blocking: FCFS, no starvation
+            if cached_blocks:
+                self.stats["prefix_cached_tokens"] += n_cached
             self.waiting.popleft()
-            self._prefill_into(slot, req, blocks)
+            self._prefill_into(slot, req, cached_blocks + blocks, n_cached)
 
-    def _prefill_into(self, slot: _Slot, req: Request, blocks: List[int]) -> None:
+    def _prefill_into(self, slot: _Slot, req: Request, blocks: List[int],
+                      n_cached: int = 0) -> None:
         ec = self.cfg
         # On re-admission after preemption the generated-so-far tokens are
-        # part of the recomputed prompt (vLLM recompute semantics).
+        # part of the recomputed prompt (vLLM recompute semantics). With a
+        # prefix-cache hit the first n_cached tokens' KV already sit in
+        # shared blocks — only the suffix is prefilled.
         tokens = req.prompt_token_ids + req.output_token_ids
         n = len(tokens)
-        bucket = self._bucket_for(n)
-        nblk_bucket = self.block_manager.blocks_needed(bucket)
+        suffix = tokens[n_cached:]
+        bucket = self._bucket_for(len(suffix))
+        # Block-table width for this call: quantized so jit specializations
+        # stay O(log^2) over (suffix bucket, table bucket).
+        nblk_needed = self.block_manager.blocks_needed(n)
+        nblk_bucket = 1
+        while nblk_bucket < nblk_needed:
+            nblk_bucket *= 2
+        nblk_bucket = min(nblk_bucket, ec.max_blocks_per_seq)
 
         slot.request = req
         slot.blocks = blocks
@@ -342,9 +381,9 @@ class InferenceEngine:
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
 
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = tokens
+        ids[0, : len(suffix)] = suffix
         pos = np.full((1, bucket), -1, np.int32)
-        pos[0, :n] = np.arange(n)
+        pos[0, : len(suffix)] = np.arange(n_cached, n)
         bt = np.zeros((1, nblk_bucket), np.int32)
         bt[0, : min(len(blocks), nblk_bucket)] = blocks[:nblk_bucket]
 
@@ -352,9 +391,9 @@ class InferenceEngine:
             self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
         self.cache, last_logits = self._prefill_fns[bucket](
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(bt), jnp.int32(n - 1),
+            jnp.asarray(bt), jnp.int32(len(suffix) - 1),
         )
-        self.stats["prefill_tokens"] += n
+        self.stats["prefill_tokens"] += len(suffix)
 
         # Sample the first generated token from the prefill logits, using the
         # same per-slot key + count stream the decode path uses.
@@ -380,7 +419,7 @@ class InferenceEngine:
                 continue
             need = self.block_manager.blocks_needed(slot.seq_len + 1)
             while need > len(slot.blocks):
-                got = self.block_manager.allocate(1)
+                got = self._alloc(1)
                 if got is None:
                     if not self._preempt_youngest(exclude=slot):
                         raise RuntimeError(
@@ -449,7 +488,14 @@ class InferenceEngine:
         return False
 
     def _release(self, slot: _Slot) -> None:
-        self.block_manager.free(slot.blocks)
+        if self.prefix_cache is not None and slot.request is not None:
+            # Register the written full blocks for reuse (shared blocks get
+            # their refcount dropped; the partial tail goes back to the pool).
+            req = slot.request
+            written = (req.prompt_token_ids + req.output_token_ids)[: slot.seq_len]
+            self.prefix_cache.release_sequence(written, slot.blocks)
+        else:
+            self.block_manager.free(slot.blocks)
         slot.request = None
         slot.blocks = []
         slot.seq_len = 0

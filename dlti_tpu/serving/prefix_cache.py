@@ -1,0 +1,179 @@
+"""Automatic prefix caching for the paged KV cache.
+
+vLLM-style: a *full* KV block's contents are a pure function of the token
+chain that produced it (same model, same params), so full blocks are
+registered in a content-addressed table and reused across requests that
+share a prompt prefix — chat system prompts, few-shot preambles, and
+preempted-then-readmitted sequences prefill only their novel suffix.
+
+Design:
+
+* Keys are exact: ``key_i = (key_{i-1}, tokens_of_block_i)`` — no hash
+  collisions, verification-free reuse.
+* Ref-counted sharing: a cached block may back any number of active
+  sequences; it is only evictable at refcount 0.
+* Eviction is lazy LRU: unreferenced cached blocks stay registered (and
+  allocated in the :class:`BlockManager` pool) until the pool runs dry,
+  then the least-recently-used are freed back to the allocator — O(1)
+  per eviction via an insertion-ordered dict of refcount-0 entries.
+* Only *full* blocks are ever cached. The partial tail block of a
+  sequence is exclusively owned and freed normally, so decode writes
+  never mutate shared state.
+
+Engine contract: ``match_prefix`` is a pure lookup; call :meth:`acquire`
+*before* allocating the suffix blocks (so the matched blocks can't be
+evicted to satisfy that very allocation) and :meth:`release` to undo on
+allocation failure.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dlti_tpu.serving.block_manager import BlockManager
+
+
+class _Entry:
+    __slots__ = ("block", "key", "refcount")
+
+    def __init__(self, block: int, key: tuple):
+        self.block = block
+        self.key = key
+        self.refcount = 0
+
+
+class PrefixCachingAllocator:
+    """Wraps a :class:`BlockManager` with content-addressed block reuse.
+
+    All engine allocation/free traffic must flow through this object so
+    refcounts stay consistent.
+    """
+
+    def __init__(self, block_manager: BlockManager):
+        self.bm = block_manager
+        self.block_size = block_manager.block_size
+        self._by_key: Dict[tuple, _Entry] = {}
+        self._by_block: Dict[int, _Entry] = {}
+        # refcount-0 entries in LRU order (oldest first) — the evictables.
+        self._lru: "collections.OrderedDict[int, _Entry]" = collections.OrderedDict()
+        self.stats = {"hits": 0, "hit_tokens": 0, "evictions": 0}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _chain_keys(tokens: Sequence[int], block_size: int) -> List[tuple]:
+        """Content key for each full block of ``tokens``."""
+        keys, prev = [], ()
+        for i in range(len(tokens) // block_size):
+            prev = (prev, tuple(tokens[i * block_size:(i + 1) * block_size]))
+            keys.append(prev)
+        return keys
+
+    # ------------------------------------------------------------------
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached chain of full blocks covering a prefix of
+        ``tokens``; at most ``len(tokens) - 1`` tokens match so prefill
+        always has at least one token to process (its logits produce the
+        next token). Pure lookup (no stats, no refcounts) — admission may
+        be retried many times before it succeeds. Returns
+        (block_ids, n_tokens_covered).
+        """
+        usable = len(tokens) - 1
+        blocks: List[int] = []
+        for key in self._chain_keys(tokens[:usable] if usable > 0 else [],
+                                    self.block_size):
+            entry = self._by_key.get(key)
+            if entry is None:
+                break
+            blocks.append(entry.block)
+        return blocks, len(blocks) * self.block_size
+
+    def acquire(self, block_ids: List[int]) -> None:
+        """Take a reference on matched blocks (pins them against eviction).
+
+        Call before allocating the suffix, undo with :meth:`release` if
+        that allocation fails.
+        """
+        for b in block_ids:
+            entry = self._by_block[b]
+            entry.refcount += 1
+            self._lru.pop(b, None)
+        if block_ids:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += len(block_ids) * self.block_size
+
+    def release(self, block_ids: List[int]) -> None:
+        """Drop references taken by :meth:`acquire` (blocks stay cached)."""
+        for b in block_ids:
+            entry = self._by_block[b]
+            entry.refcount -= 1
+            if entry.refcount == 0:
+                self._lru[b] = entry
+                self._lru.move_to_end(b)
+
+    # ------------------------------------------------------------------
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` fresh blocks, evicting LRU cached blocks if the
+        pool is dry. Returns None when even eviction can't satisfy it."""
+        if n == 0:
+            return []
+        while not self.bm.can_allocate(n):
+            if not self._evict_one():
+                return None
+        return self.bm.allocate(n)
+
+    def _evict_one(self) -> bool:
+        if not self._lru:
+            return False
+        block, entry = self._lru.popitem(last=False)  # oldest
+        del self._by_key[entry.key]
+        del self._by_block[block]
+        self.bm.free([block])
+        self.stats["evictions"] += 1
+        return True
+
+    # ------------------------------------------------------------------
+    def release_sequence(self, tokens: Sequence[int],
+                         blocks: List[int]) -> None:
+        """Return a retiring sequence's blocks.
+
+        Full blocks are registered for reuse (or deduplicated against an
+        existing registration); partial/extra blocks go straight back to
+        the allocator. ``blocks[i]`` must hold tokens
+        ``tokens[i*bs:(i+1)*bs]``.
+        """
+        keys = self._chain_keys(tokens, self.block_size)
+        for i, block in enumerate(blocks):
+            entry = self._by_block.get(block)
+            if entry is not None:
+                # A block we were sharing: drop our reference.
+                self.release([block])
+                continue
+            if i < len(keys):
+                key = keys[i]
+                if key in self._by_key:
+                    # Same content already cached under another block
+                    # (two requests prefilling the same prompt
+                    # concurrently): keep the registered one, free ours.
+                    self.bm.free([block])
+                    continue
+                e = _Entry(block, key)
+                self._by_key[key] = e
+                self._by_block[block] = e
+                self._lru[block] = e
+            else:
+                self.bm.free([block])
+
+    # ------------------------------------------------------------------
+    @property
+    def num_cached_blocks(self) -> int:
+        return len(self._by_block)
+
+    @property
+    def num_free(self) -> int:
+        """Free now, without eviction (see also :meth:`num_reclaimable`)."""
+        return self.bm.num_free
+
+    @property
+    def num_reclaimable(self) -> int:
+        return len(self._lru)

@@ -103,8 +103,12 @@ def make_train_step(
             deterministic=False,
             rngs={"dropout": rng},
         )
-        if moe_coef and loss_mask is not None:
+        if moe_coef and loss_mask is not None and micro.get("segment_ids") is None:
             # Keep padding tokens out of expert capacity/aux statistics.
+            # Only for unpacked batches, where loss_mask IS the padding
+            # mask; packed batches zero loss_mask at every document's
+            # first (real!) token, and the model derives the correct
+            # padding mask from segment_ids instead.
             apply_kwargs["token_mask"] = loss_mask
         if moe_coef:
             # MoE: collect the sown per-layer router load-balance losses

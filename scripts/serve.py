@@ -42,6 +42,9 @@ def parse_args():
     p.add_argument("--block-size", type=int, default=16, help="tokens per KV block")
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--max-tokens-default", type=int, default=256)
+    p.add_argument("--enable-prefix-caching", action="store_true",
+                   help="reuse KV blocks across requests sharing a prompt "
+                        "prefix (content-addressed, LRU-evicted)")
     return p.parse_args()
 
 
@@ -83,6 +86,7 @@ def main() -> None:
         max_seqs=args.max_seqs, block_size=args.block_size,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         eos_token_id=tok.eos_id,
+        enable_prefix_caching=args.enable_prefix_caching,
     )
     engine = InferenceEngine(model_cfg, params, ec, lora_cfg)
     sc = ServerConfig(host=args.host, port=args.port,

@@ -1,0 +1,139 @@
+"""Automatic prefix caching: allocator semantics + engine integration."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlti_tpu.config import MODEL_PRESETS
+from dlti_tpu.models import LlamaForCausalLM
+from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+from dlti_tpu.serving.block_manager import BlockManager
+from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+# ----------------------------------------------------------------------
+# Allocator unit tests
+# ----------------------------------------------------------------------
+
+def test_register_then_match_full_blocks_only():
+    pc = PrefixCachingAllocator(BlockManager(num_blocks=16, block_size=4))
+    blocks = pc.allocate(3)
+    tokens = list(range(10))  # 2 full blocks + partial
+    pc.release_sequence(tokens, blocks)
+    assert pc.num_cached_blocks == 2  # partial tail freed
+
+    # Exact prefix match; capped at len-1 so prefill keeps >= 1 token.
+    m, n = pc.match_prefix(list(range(10)))
+    assert n == 8 and len(m) == 2
+    m, n = pc.match_prefix(list(range(8)))  # 8 tokens: only 4 usable
+    assert n == 4 and len(m) == 1
+    m, n = pc.match_prefix([9, 9, 9, 9, 9])
+    assert n == 0 and m == []
+
+
+def test_chain_key_is_positional():
+    """Block 2's key depends on block 1's content: a different first block
+    kills the match for later identical blocks."""
+    pc = PrefixCachingAllocator(BlockManager(num_blocks=16, block_size=4))
+    blocks = pc.allocate(2)
+    pc.release_sequence([1, 2, 3, 4, 5, 6, 7, 8], blocks)
+    m, n = pc.match_prefix([9, 9, 9, 9, 5, 6, 7, 8, 0])
+    assert n == 0
+
+
+def test_refcount_blocks_eviction():
+    bm = BlockManager(num_blocks=6, block_size=4)  # 5 allocatable
+    pc = PrefixCachingAllocator(bm)
+    blocks = pc.allocate(2)
+    pc.release_sequence(list(range(8)), blocks)  # 2 cached, refcount 0
+    m, _ = pc.match_prefix(list(range(9)))
+    pc.acquire(m)  # refcount 1
+
+    # 3 free + 0 evictable-under-reference: a request for 4 must fail.
+    assert pc.allocate(4) is None
+    # Drop the reference: now eviction can reclaim the 2 cached blocks.
+    pc.release_sequence(list(range(8)), m)
+    got = pc.allocate(4)
+    assert got is not None and len(got) == 4
+    assert pc.stats["evictions"] >= 1
+
+
+def test_duplicate_registration_dedupes():
+    bm = BlockManager(num_blocks=16, block_size=4)
+    pc = PrefixCachingAllocator(bm)
+    b1 = pc.allocate(1)
+    b2 = pc.allocate(1)
+    pc.release_sequence([1, 2, 3, 4], b1)
+    free_before = bm.num_free
+    pc.release_sequence([1, 2, 3, 4], b2)  # same content, other block
+    assert pc.num_cached_blocks == 1
+    assert bm.num_free == free_before + 1  # duplicate freed immediately
+
+
+# ----------------------------------------------------------------------
+# Engine integration
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = LlamaForCausalLM(CFG, None)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _engine(params, **kw):
+    defaults = dict(max_seqs=2, block_size=8, num_blocks=32, max_model_len=64,
+                    cache_dtype="float32", eos_token_id=-1,
+                    enable_prefix_caching=True)
+    defaults.update(kw)
+    return InferenceEngine(CFG, params, EngineConfig(**defaults))
+
+
+def test_engine_prefix_hit_skips_prefill_and_matches_greedy(tiny_params):
+    engine = _engine(tiny_params)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]  # crosses block bdry
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    [r1] = engine.generate([prompt], sp)
+    prefill_first = engine.stats["prefill_tokens"]
+    assert engine.stats["prefix_cached_tokens"] == 0
+
+    [r2] = engine.generate([prompt], sp)
+    # Second run: the prompt's full block (8 tokens) came from cache.
+    assert engine.stats["prefix_cached_tokens"] == 8
+    assert engine.stats["prefill_tokens"] == prefill_first + (len(prompt) - 8)
+    assert r2.output_token_ids == r1.output_token_ids
+
+
+def test_engine_prefix_cache_correctness_vs_uncached(tiny_params):
+    """Generations through cache hits equal a fresh engine's output."""
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    shared = [7, 7, 7, 7, 2, 2, 2, 2]  # exactly one block
+    prompts = [shared + [i, i + 1] for i in range(1, 4)]
+
+    cached = _engine(tiny_params)
+    cached.generate([prompts[0]], sp)  # warm the cache
+    got = cached.generate(prompts[1:], sp)
+    assert cached.stats["prefix_cached_tokens"] > 0
+
+    fresh = InferenceEngine(CFG, tiny_params, EngineConfig(
+        max_seqs=2, block_size=8, num_blocks=32, max_model_len=64,
+        cache_dtype="float32", eos_token_id=-1))
+    want = fresh.generate(prompts[1:], sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+
+
+def test_engine_eviction_under_pressure(tiny_params):
+    """A tiny pool keeps serving: cached blocks are evicted as needed."""
+    engine = _engine(tiny_params, num_blocks=8, max_seqs=1, max_model_len=32)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        prompt = [int(t) for t in rng.integers(0, CFG.vocab_size, 12)]
+        [r] = engine.generate([prompt], sp)
+        assert len(r.output_token_ids) == 4
+    assert engine.prefix_cache.stats["evictions"] > 0
+    assert engine.num_active == 0
