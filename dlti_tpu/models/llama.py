@@ -118,7 +118,12 @@ class LlamaAttention(nn.Module):
             slots = slot_mapping(cache["block_tables"], positions, blk_size, nb)
             new_cache = paged_update(cache, k, v, slots)
             impl = getattr(cfg, "paged_attention_impl", "auto")
-            use_kernel = s == 1 and (
+            # Under a TP mesh the pool is kv_head-sharded; pallas_call has
+            # no SPMD partitioning rules (GSPMD would all-gather the whole
+            # pool), so TP serving uses the sharded-einsum gather path.
+            tp_sharded = (self.mesh is not None
+                          and self.mesh.shape.get("tensor", 1) > 1)
+            use_kernel = s == 1 and not tp_sharded and (
                 impl == "kernel"
                 or (impl == "auto" and jax.default_backend() == "tpu")
             )

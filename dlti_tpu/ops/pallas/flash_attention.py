@@ -1,4 +1,4 @@
-"""Blockwise (flash) causal attention for TPU, in Pallas.
+"""Blockwise (flash) causal attention for TPU, in Pallas — fwd and bwd.
 
 The hot op of the whole framework. Replaces the (seq, seq) score
 materialization of ``reference_attention`` with an online-softmax sweep over
@@ -6,16 +6,18 @@ KV blocks held in VMEM — O(seq) memory, MXU-sized tiles, fp32 accumulators.
 The reference repo inherits its fused attention from HF/torch CUDA kernels;
 this is the TPU-native equivalent.
 
-Layout: kernel operates on (batch*heads, seq, head_dim) with a grid of
-(bh, q_blocks, kv_blocks). TPU grids execute sequentially minor-most-first,
-so the (m, l, acc) running state for one q block lives in VMEM scratch
-across the kv_block sweep. Causal blocks above the diagonal are skipped via
-``pl.when`` (no wasted MXU work), and the diagonal block gets an elementwise
-iota mask.
+Layout: kernels operate on (batch*heads, seq, head_dim) with grids of
+(bh, q_blocks, kv_blocks) (fwd, dq) or (bh, kv_blocks, q_blocks) (dk/dv).
+TPU grids execute sequentially minor-most-first, so per-block running state
+lives in VMEM scratch across the innermost sweep. Causal blocks outside the
+(windowed) band are skipped via ``pl.when`` (no wasted MXU work), and the
+band edges get elementwise iota masks.
 
-Backward: round-1 uses a recompute VJP through the XLA reference attention
-(correct, O(seq^2) memory at the backward only); a Pallas backward kernel is
-the planned follow-up for long-sequence training.
+Backward is the standard flash decomposition: the forward also emits the
+per-row logsumexp L; the backward recomputes p = exp(qk*scale - L) per tile
+(no (seq, seq) materialization), with
+``D = rowsum(dO * O)``, ``dv += p^T dO``, ``ds = p * (dO v^T - D) * scale``,
+``dq += ds k``, ``dk += ds^T q`` — two sweeps, O(seq) memory.
 """
 
 from __future__ import annotations
@@ -30,9 +32,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scratch, l_scratch, acc_scratch,
                 *, scale: float, block_q: int, block_kv: int, causal: bool,
-                window: int):
+                window: int, seq_q: int, seq_kv: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -43,16 +46,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    # Causal: process only kv blocks whose start <= q block's end; with a
-    # sliding window, also skip blocks entirely below every query's window.
-    run = True
-    if causal:
-        run = ki * block_kv <= qi * block_q + (block_q - 1)
-        if window:
-            run = jnp.logical_and(
-                run, ki * block_kv + (block_kv - 1) > qi * block_q - window)
-
-    @pl.when(run)
+    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
     def _body():
         q = q_ref[0].astype(jnp.float32)  # (block_q, d)
         k = k_ref[0].astype(jnp.float32)  # (block_kv, d)
@@ -61,16 +55,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (block_q, block_kv)
 
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            k_pos = ki * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            allowed = k_pos <= q_pos
-            if window:
-                allowed &= k_pos > q_pos - window
+        allowed = _band_mask(qi, ki, block_q, block_kv, s.shape, causal,
+                             window, seq_q, seq_kv)
+        if allowed is not None:
             s = jnp.where(allowed, s, NEG_INF)
 
         m_prev = m_scratch[:]  # (block_q, 1)
@@ -92,8 +79,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
     @pl.when(ki == nk - 1)
     def _finalize():
         l = l_scratch[:]
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
-        o_ref[0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+        o_ref[0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+        # Per-row logsumexp for the backward. Fully-masked rows get +BIG so
+        # the backward's exp(s - L) is exactly 0 there.
+        lse = jnp.where(l > 0.0, m_scratch[:] + jnp.log(safe_l), -NEG_INF)
+        lse_ref[0] = lse
 
 
 def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, window, interpret):
@@ -106,18 +97,24 @@ def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, window, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
-        causal=causal, window=window,
+        causal=causal, window=window, seq_q=sq, seq_kv=skv,
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),  # logsumexp
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -132,6 +129,170 @@ def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, window, interpret):
     )(q, k, v)
 
 
+def _band_mask(qi, ki, block_q, block_kv, shape, causal, window,
+               seq_q, seq_kv):
+    """Elementwise allowed-mask for the (qi, ki) tile.
+
+    Combines the causal/sliding-window band with sequence bounds: Pallas
+    does NOT zero tile padding on TPU, so rows >= seq_q / cols >= seq_kv
+    hold garbage and must be masked in every kernel that *accumulates*
+    across tiles (the whole backward; the non-causal forward). Returns
+    None only when provably nothing needs masking.
+    """
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    padded = seq_q % block_q != 0 or seq_kv % block_kv != 0
+    if not causal and not padded:
+        return None
+    allowed = None
+    if causal:
+        allowed = k_pos <= q_pos
+        if window:
+            allowed &= k_pos > q_pos - window
+    if padded:
+        bounds = (q_pos < seq_q) & (k_pos < seq_kv)
+        allowed = bounds if allowed is None else (allowed & bounds)
+    return allowed
+
+
+def _band_run(qi, ki, block_q, block_kv, causal, window):
+    """Whole-tile skip predicate (conservative w.r.t. :func:`_band_mask`)."""
+    if not causal:
+        return True
+    run = ki * block_kv <= qi * block_q + (block_q - 1)
+    if window:
+        run = jnp.logical_and(
+            run, ki * block_kv + (block_kv - 1) > qi * block_q - window)
+    return run
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scratch, *, scale, block_q, block_kv, causal, window,
+               seq_q, seq_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        mask = _band_mask(qi, ki, block_q, block_kv, s.shape, causal, window,
+                          seq_q, seq_kv)
+        p = jnp.exp(s - lse_ref[0])                        # (bq, bk)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale               # (bq, bk)
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scratch, dv_scratch,
+                *, scale, block_q, block_kv, causal, window, seq_q, seq_kv):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        mask = _band_mask(qi, ki, block_q, block_kv, s.shape, causal, window,
+                          seq_q, seq_kv)
+        p = jnp.exp(s - lse_ref[0])                        # (bq, bk)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, scale, block_q, block_kv, causal,
+               window, interpret):
+    """q,k,v,o,do: (bh, s, d); lse: (bh, s, 1) -> (dq, dk, dv)."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(skv, block_kv)
+
+    # D_i = rowsum(dO_i * O_i) — tiny elementwise pass, XLA-fused.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv, causal=causal, window=window,
+                          seq_q=sq, seq_kv=skv),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv sweep: grid transposed so kv blocks are outer, q inner.
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                          block_kv=block_kv, causal=causal, window=window,
+                          seq_q=sq, seq_kv=skv),
+        out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=(kv_spec_t, kv_spec_t),
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
@@ -142,33 +303,41 @@ def _flash_attention_core(q, k, v, causal, block_q, block_kv, window, interpret)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
-    o = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q, block_kv=block_kv,
-                   causal=causal, window=window, interpret=interpret)
+    o, _ = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q,
+                      block_kv=block_kv, causal=causal, window=window,
+                      interpret=interpret)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
 def _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret):
-    out = _flash_attention_core(q, k, v, causal, block_q, block_kv, window,
-                                interpret)
-    return out, (q, k, v)
+    b, sq, h, d = q.shape
+    scale = d ** -0.5
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
+    o, lse = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q,
+                        block_kv=block_kv, causal=causal, window=window,
+                        interpret=interpret)
+    out = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (qt, kt, vt, o, lse)
 
 
 def _core_bwd(causal, block_q, block_kv, window, interpret, res, g):
-    """Recompute-based backward through the XLA reference implementation.
+    """Flash backward: tile-recomputed p from the saved logsumexp."""
+    qt, kt, vt, o, lse = res
+    bh, sq, d = qt.shape
+    scale = d ** -0.5
+    do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    dq, dk, dv = _flash_bwd(
+        qt, kt, vt, o, lse, do, scale=scale, block_q=block_q,
+        block_kv=block_kv, causal=causal, window=window, interpret=interpret)
+    b = g.shape[0]
+    h = g.shape[2]
 
-    Correct and XLA-fused; a Pallas flash backward replaces this for
-    long-sequence training (tracked follow-up).
-    """
-    from dlti_tpu.ops.attention import reference_attention
+    def unflat(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
-    q, k, v = res
-
-    def ref(q_, k_, v_):
-        return reference_attention(q_, k_, v_, causal=causal,
-                                   window=window or None)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    return unflat(dq, sq), unflat(dk, kt.shape[1]), unflat(dv, vt.shape[1])
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)

@@ -138,12 +138,22 @@ class InferenceEngine:
         mesh=None,
     ):
         if mesh is not None:
-            # TP-sharded serving lands with the TP server wiring; fail loudly
-            # rather than silently running replicated.
-            raise NotImplementedError(
-                "tensor-parallel serving (mesh=...) is not wired yet; "
-                "construct the engine without a mesh"
-            )
+            # Tensor-parallel serving: weights and KV pools shard over the
+            # 'tensor' axis (attention heads / MLP hidden / vocab); GSPMD
+            # inserts the collectives in the jitted prefill/decode programs.
+            # Other axes stay 1 — batch-level scaling is a replica concern.
+            bad = [ax for ax, n in mesh.shape.items()
+                   if n > 1 and ax != "tensor"]
+            if bad:
+                raise ValueError(
+                    f"serving mesh may only extend the 'tensor' axis; got "
+                    f"{dict(mesh.shape)} (axes {bad} > 1)")
+            tp = mesh.shape["tensor"]
+            if model_cfg.num_kv_heads % tp or model_cfg.num_heads % tp:
+                raise ValueError(
+                    f"tensor={tp} must evenly divide num_heads="
+                    f"{model_cfg.num_heads} and num_kv_heads="
+                    f"{model_cfg.num_kv_heads}")
         if engine_cfg.max_blocks_per_seq > engine_cfg.num_blocks - 1:
             # Block 0 is the reserved trash block, so only num_blocks-1 are
             # allocatable. A config where one max-length sequence can never
@@ -158,7 +168,8 @@ class InferenceEngine:
         self.cfg = engine_cfg
         self.model_cfg = model_cfg
         self.logger = get_logger()
-        self.model = LlamaForCausalLM(model_cfg, lora_cfg)
+        self.mesh = mesh
+        self.model = LlamaForCausalLM(model_cfg, lora_cfg, mesh)
         self.params = params
 
         ec = engine_cfg
@@ -167,6 +178,8 @@ class InferenceEngine:
             model_cfg.num_layers, ec.num_blocks, ec.block_size,
             model_cfg.num_kv_heads, model_cfg.resolved_head_dim, dtype,
         )
+        if mesh is not None:
+            self._shard_for_tp(mesh)
         self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
         self.prefix_cache = None
         if ec.enable_prefix_caching:
@@ -202,6 +215,29 @@ class InferenceEngine:
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
                       "prefix_cached_tokens": 0}
+
+    # ------------------------------------------------------------------
+    def _shard_for_tp(self, mesh) -> None:
+        """Place weights and KV pools on the TP mesh.
+
+        Params follow the training TP rules (column/row-parallel
+        projections, sharded vocab); each layer's K/V pool shards its
+        kv_heads dim. Block tables and sampling state stay replicated.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dlti_tpu.config import Config, ParallelConfig
+        from dlti_tpu.parallel.sharding import param_shardings
+
+        cfg = Config(model=self.model_cfg,
+                     parallel=ParallelConfig(tensor=mesh.shape["tensor"]))
+        p_sh = param_shardings(self.params, cfg, mesh)
+        self.params = jax.tree_util.tree_map(jax.device_put, self.params, p_sh)
+        kv_sh = NamedSharding(mesh, P(None, None, "tensor", None))
+        self.cache = [
+            {"k": jax.device_put(l["k"], kv_sh), "v": jax.device_put(l["v"], kv_sh)}
+            for l in self.cache
+        ]
 
     # ------------------------------------------------------------------
     # Compiled programs
@@ -338,6 +374,7 @@ class InferenceEngine:
                 break  # head-of-line blocking: FCFS, no starvation
             if cached_blocks:
                 self.stats["prefix_cached_tokens"] += n_cached
+                self.prefix_cache.record_hit(cached_blocks)
             self.waiting.popleft()
             self._prefill_into(slot, req, cached_blocks + blocks, n_cached)
 

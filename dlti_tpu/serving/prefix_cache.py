@@ -88,6 +88,13 @@ class PrefixCachingAllocator:
             blocks.append(entry.block)
         return blocks, len(blocks) * self.block_size
 
+    def record_hit(self, block_ids: List[int]) -> None:
+        """Count a *successful* admission's reuse (an admission may retry
+        acquire/release many times while head-of-line blocked)."""
+        if block_ids:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += len(block_ids) * self.block_size
+
     def acquire(self, block_ids: List[int]) -> None:
         """Take a reference on matched blocks (pins them against eviction).
 
@@ -98,9 +105,6 @@ class PrefixCachingAllocator:
             entry = self._by_block[b]
             entry.refcount += 1
             self._lru.pop(b, None)
-        if block_ids:
-            self.stats["hits"] += 1
-            self.stats["hit_tokens"] += len(block_ids) * self.block_size
 
     def release(self, block_ids: List[int]) -> None:
         """Drop references taken by :meth:`acquire` (blocks stay cached)."""

@@ -45,6 +45,9 @@ def parse_args():
     p.add_argument("--enable-prefix-caching", action="store_true",
                    help="reuse KV blocks across requests sharing a prompt "
                         "prefix (content-addressed, LRU-evicted)")
+    p.add_argument("--tensor", type=int, default=1,
+                   help="tensor-parallel extent: shard weights + KV pools "
+                        "over this many chips (ICI collectives via GSPMD)")
     return p.parse_args()
 
 
@@ -88,7 +91,13 @@ def main() -> None:
         eos_token_id=tok.eos_id,
         enable_prefix_caching=args.enable_prefix_caching,
     )
-    engine = InferenceEngine(model_cfg, params, ec, lora_cfg)
+    mesh = None
+    if args.tensor > 1:
+        from dlti_tpu.config import ParallelConfig
+        from dlti_tpu.parallel import build_mesh
+
+        mesh = build_mesh(ParallelConfig(tensor=args.tensor))
+    engine = InferenceEngine(model_cfg, params, ec, lora_cfg, mesh=mesh)
     sc = ServerConfig(host=args.host, port=args.port,
                       default_params=SamplingParams(max_tokens=args.max_tokens_default))
     print(f"serving on http://{args.host}:{args.port}  "

@@ -309,3 +309,40 @@ def test_engine_decode_with_pallas_kernel_matches_gather(tiny_model_and_params):
     got = InferenceEngine(cfg_kernel, params, ec).generate(prompts, sp)
     for g, w in zip(got, want):
         assert g.output_token_ids == w.output_token_ids
+
+
+def test_engine_tensor_parallel_matches_single_device(tiny_model_and_params):
+    """TP=2 engine (params + KV pools sharded over 'tensor') produces the
+    same greedy tokens as the unsharded engine."""
+    from dlti_tpu.config import ParallelConfig
+    from dlti_tpu.parallel import build_mesh
+
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    want = InferenceEngine(CFG, params, ec).generate(prompts, sp)
+
+    mesh = build_mesh(ParallelConfig(tensor=2), devices=jax.devices()[:2])
+    tp_engine = InferenceEngine(CFG, params, ec, mesh=mesh)
+    # Weights and pools really are sharded.
+    k0 = tp_engine.cache[0]["k"]
+    assert k0.sharding.spec[2] == "tensor"
+    got = tp_engine.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+
+
+def test_engine_tp_mesh_validation(tiny_model_and_params):
+    from dlti_tpu.config import ParallelConfig
+    from dlti_tpu.parallel import build_mesh
+
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    with pytest.raises(ValueError, match="tensor"):
+        InferenceEngine(CFG, params, ec,
+                        mesh=build_mesh(ParallelConfig(data=2, tensor=2),
+                                        devices=jax.devices()[:4]))
