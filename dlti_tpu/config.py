@@ -67,6 +67,8 @@ class ModelConfig:
     attention_bias: bool = False        # Qwen2: bias on q/k/v (never o)
     sliding_window: Optional[int] = None  # Mistral: local attention window
     mlp_activation: str = "silu"        # "silu" | "gelu_tanh" | "gelu_exact"
+    rmsnorm_offset: bool = False        # Gemma: normalize with (1 + weight)
+    embedding_scale: bool = False       # Gemma: embed * sqrt(hidden_size)
     # Mixture of Experts (Mixtral family): 0 experts = dense MLP. When > 0
     # every block's MLP is a top-k routed expert layer
     # (dlti_tpu.models.moe.MoEMLP) with GShard capacity dispatch.
@@ -254,6 +256,14 @@ class TrainConfig:
     fp16_scale_window: int = 1000
     fp16_hysteresis: int = 2
     fp16_min_scale: float = 1.0
+    # jax.profiler trace capture (view in XProf/TensorBoard): writes a
+    # trace of steps [profile_start_step, profile_start_step +
+    # profile_num_steps) to profile_dir. Empty dir = no profiling.
+    # The upgrade over the reference's wall_clock_breakdown:false
+    # (configs/ds_config_zero1.json:48) — per-op device timelines.
+    profile_dir: str = ""
+    profile_start_step: int = 10
+    profile_num_steps: int = 3
 
 
 @dataclass(frozen=True)
@@ -374,6 +384,13 @@ MODEL_PRESETS: dict = {
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, max_seq_len=32768,
         rope_theta=1000000.0, attention_bias=True,
+    ),
+    # Gemma-7B: MHA with wide heads, (1+w) RMSNorm, scaled + tied embeddings.
+    "gemma_7b": ModelConfig(
+        vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+        num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+        max_seq_len=8192, rms_norm_eps=1e-6, tie_embeddings=True,
+        mlp_activation="gelu_tanh", rmsnorm_offset=True, embedding_scale=True,
     ),
     # Mixtral-8x7B: sparse MoE (8 experts, top-2) on the Mistral base.
     "mixtral_8x7b": ModelConfig(

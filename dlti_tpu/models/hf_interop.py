@@ -85,12 +85,17 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
         if torch_dtype == "float32":
             kw["dtype"] = "float32"
     model_type = hf.get("model_type", "llama")
-    if model_type not in ("llama", "mistral", "qwen2"):
-        # A family we haven't verified forward-pass parity for (e.g. Gemma
-        # needs (1+w) RMSNorm and embedding scaling) must fail loudly, not
-        # import as a subtly different model.
+    if model_type not in ("llama", "mistral", "qwen2", "gemma"):
+        # A family we haven't verified forward-pass parity for (gemma2's
+        # logit softcapping, phi's partial rotary, ...) must fail loudly,
+        # not import as a subtly different model.
         raise NotImplementedError(
-            f"model_type {model_type!r} not supported (llama/mistral/qwen2)")
+            f"model_type {model_type!r} not supported "
+            f"(llama/mistral/qwen2/gemma)")
+    if model_type == "gemma":
+        kw["rmsnorm_offset"] = True       # (1 + w) norm parameterization
+        kw["embedding_scale"] = True      # embed * sqrt(hidden)
+        kw["tie_embeddings"] = bool(hf.get("tie_word_embeddings", True))
     if hf.get("attention_bias") or model_type == "qwen2":
         kw["attention_bias"] = True
     if hf.get("sliding_window"):
@@ -112,7 +117,13 @@ def config_from_hf(hf: Mapping[str, Any], **overrides) -> ModelConfig:
                 # else: no layer is windowed -> full attention, nothing to set
         elif hf.get("use_sliding_window", True):
             kw["sliding_window"] = int(hf["sliding_window"])
-    act = hf.get("hidden_act", "silu")
+    # Gemma configs prefer "hidden_activation"; transformers force-overrides
+    # a null one (and the original-release legacy hidden_act: "gelu") to
+    # gelu_pytorch_tanh, so the fallback for gemma must do the same.
+    if model_type == "gemma":
+        act = hf.get("hidden_activation") or "gelu_pytorch_tanh"
+    else:
+        act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
     kw["mlp_activation"] = {
         "silu": "silu", "gelu": "gelu_exact",
         "gelu_pytorch_tanh": "gelu_tanh", "gelu_new": "gelu_tanh",
@@ -136,7 +147,9 @@ def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
 
     The model_type tracks the family features so transformers picks a class
     that honors them (qwen2: q/k/v bias; mistral: sliding window)."""
-    if cfg.attention_bias:
+    if cfg.rmsnorm_offset:
+        model_type, arch = "gemma", "GemmaForCausalLM"
+    elif cfg.attention_bias:
         model_type, arch = "qwen2", "Qwen2ForCausalLM"
     elif cfg.sliding_window:
         model_type, arch = "mistral", "MistralForCausalLM"

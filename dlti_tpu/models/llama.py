@@ -39,19 +39,29 @@ def _dtype(name: str):
 
 
 class RMSNorm(nn.Module):
-    """Llama RMSNorm; stats in fp32 regardless of compute dtype."""
+    """Llama RMSNorm; stats in fp32 regardless of compute dtype.
+
+    ``offset`` selects Gemma's ``(1 + weight)`` parameterization (weights
+    stored zero-centered, HF state dicts carry ``w`` with the +1 applied at
+    run time); init follows suit (zeros instead of ones).
+    """
 
     eps: float = 1e-5
     param_dtype: Any = jnp.float32
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         orig_dtype = x.dtype
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        init = nn.initializers.zeros if self.offset else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],), self.param_dtype)
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         normed = x32 * jax.lax.rsqrt(var + self.eps)
-        return (normed * scale.astype(jnp.float32)).astype(orig_dtype)
+        s = scale.astype(jnp.float32)
+        if self.offset:
+            s = 1.0 + s
+        return (normed * s).astype(orig_dtype)
 
 
 def _lora_kwargs(cfg: ModelConfig, lora: Optional[LoRAConfig], name: str) -> dict:
@@ -240,11 +250,11 @@ class LlamaBlock(nn.Module):
                  deterministic: bool = True, token_mask=None):
         cfg = self.cfg
         attn_out, new_cache = LlamaAttention(cfg, self.lora, self.mesh, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(x),
+            RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset, name="input_norm")(x),
             cos, sin, positions, segment_ids, cache, deterministic,
         )
         x = x + attn_out
-        normed = RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(x)
+        normed = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset, name="post_attn_norm")(x)
         if cfg.num_experts > 0:
             from dlti_tpu.models.moe import MoEMLP
 
@@ -296,6 +306,8 @@ class LlamaModel(nn.Module):
             pdtype,
         )
         x = jnp.take(embed, input_ids, axis=0).astype(dtype)
+        if cfg.embedding_scale:  # Gemma: embeddings scaled by sqrt(hidden)
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
 
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
@@ -328,7 +340,7 @@ class LlamaModel(nn.Module):
             if cache is not None:
                 new_caches.append(layer_new_cache)
 
-        x = RMSNorm(cfg.rms_norm_eps, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset, name="final_norm")(x)
         return x, new_caches
 
 

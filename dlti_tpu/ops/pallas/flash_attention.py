@@ -166,6 +166,32 @@ def _band_run(qi, ki, block_q, block_kv, causal, window):
     return run
 
 
+def _load_bwd_tiles(q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
+                    seq_q, seq_kv):
+    """Load backward tiles with padding rows/cols zeroed.
+
+    Pallas does not zero tile padding on TPU; the backward *accumulates*
+    across tiles, so garbage (potentially inf/NaN, which survives
+    multiplication by zero) in rows >= seq_q / cols >= seq_kv must be
+    cleared at load time.
+    """
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    if seq_q % block_q != 0:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        q = jnp.where(rows < seq_q, q, 0.0)
+        do = jnp.where(rows < seq_q, do, 0.0)
+    if seq_kv % block_kv != 0:
+        cols = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_kv, 1), 0)
+        k = jnp.where(cols < seq_kv, k, 0.0)
+        v = jnp.where(cols < seq_kv, v, 0.0)
+    return q, k, v, do
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scratch, *, scale, block_q, block_kv, causal, window,
                seq_q, seq_kv):
@@ -179,10 +205,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = _load_bwd_tiles(
+            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
+            seq_q, seq_kv)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -193,7 +218,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        # where() (not just p==0) so garbage lse/delta in padding rows can't
+        # poison the product with 0 * inf = NaN.
         ds = p * (dp - delta_ref[0]) * scale               # (bq, bk)
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
         dq_scratch[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -216,10 +245,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = _load_bwd_tiles(
+            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
+            seq_q, seq_kv)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -233,6 +261,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0]) * scale
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
         dk_scratch[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -298,15 +328,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, scale, block_q, block_kv, causal,
 )
 def _flash_attention_core(q, k, v, causal, block_q, block_kv, window, interpret):
     """(b, s, h, d) attention with GQA via head repetition at the caller."""
-    b, sq, h, d = q.shape
-    scale = d ** -0.5
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
-    o, _ = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q,
-                      block_kv=block_kv, causal=causal, window=window,
-                      interpret=interpret)
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret)[0]
 
 
 def _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret):

@@ -133,6 +133,8 @@ def pipeline_forward(
 
     # Embed outside the pipelined region (replicated).
     x = jnp.take(pparams["embed_tokens"], input_ids, axis=0).astype(dtype)
+    if cfg.embedding_scale:  # Gemma: embeddings scaled by sqrt(hidden)
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     x_mb = x.reshape(num_microbatches, mb, s, -1)
     pos_mb = positions.reshape(num_microbatches, mb, s)
 
@@ -210,7 +212,7 @@ def pipeline_forward(
     y = y.reshape(b, s, -1)
 
     # Final norm + head outside the pipeline (replicated).
-    norm = RMSNorm(cfg.rms_norm_eps)
+    norm = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset)
     y = norm.apply({"params": pparams["final_norm"]}, y)
     if cfg.tie_embeddings or "lm_head" not in pparams:
         logits = jnp.einsum("bsh,vh->bsv", y.astype(jnp.float32),

@@ -173,10 +173,23 @@ class Trainer:
 
             eval_fn = jax.jit(make_eval_step(self.model))
 
+        profiling = False
         for epoch in range(start_epoch, cfg.train.num_epochs):
             for batch in epoch_batches(epoch):
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
+                if cfg.train.profile_dir and is_main_process():
+                    if (not profiling
+                            and global_step == cfg.train.profile_start_step):
+                        jax.profiler.start_trace(cfg.train.profile_dir)
+                        profiling = True
+                    elif profiling and global_step >= (
+                            cfg.train.profile_start_step
+                            + cfg.train.profile_num_steps):
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        self.logger.info("profiler trace -> %s",
+                                         cfg.train.profile_dir)
                 if self.mesh is not None:
                     from dlti_tpu.parallel.sharding import make_global_batch
 
@@ -207,6 +220,8 @@ class Trainer:
             if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                 break
 
+        if profiling:  # run ended inside the trace window
+            jax.profiler.stop_trace()
         if cfg.checkpoint.save_strategy != "no":
             from dlti_tpu.checkpoint import wait_for_saves
 

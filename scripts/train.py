@@ -95,6 +95,10 @@ def parse_args():
     p.add_argument("--metrics-csv", default="results/training_metrics.csv")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logging-steps", type=int, default=10)
+    p.add_argument("--profile-dir", default="",
+                   help="capture a jax.profiler trace window here (XProf)")
+    p.add_argument("--profile-start-step", type=int, default=10)
+    p.add_argument("--profile-num-steps", type=int, default=3)
     return p.parse_args()
 
 
@@ -171,7 +175,10 @@ def build_config(args):
                           micro_batch_size=args.per_device_batch_size * dp,
                           grad_accum_steps=args.gradient_accumulation_steps,
                           logging_steps=args.logging_steps, seed=args.seed,
-                          metrics_csv=args.metrics_csv, fp16=args.fp16),
+                          metrics_csv=args.metrics_csv, fp16=args.fp16,
+                          profile_dir=args.profile_dir,
+                          profile_start_step=args.profile_start_step,
+                          profile_num_steps=args.profile_num_steps),
         experiment_name=create_experiment_name(
             par.num_devices, int(par.zero_stage)),
     )

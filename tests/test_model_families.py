@@ -184,3 +184,39 @@ def test_paged_decode_window_matches_reference():
         q_positions=jnp.asarray(seq_lens)[:, None] - 1, window=window)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_gemma_logits_match_transformers():
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(0)
+    hf_model = GemmaForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=4, head_dim=16, max_seq_len=64,
+        rms_norm_eps=1e-6, tie_embeddings=True, mlp_activation="gelu_tanh",
+        rmsnorm_offset=True, embedding_scale=True,
+        dtype="float32", param_dtype="float32", remat=False,
+        attention_impl="reference",
+    )
+    _assert_logits_match(cfg, hf_model, tol=1e-3)
+
+
+def test_gemma_config_from_hf():
+    from dlti_tpu.models import config_from_hf
+
+    cfg = config_from_hf({
+        "model_type": "gemma", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4, "head_dim": 16,
+        "rms_norm_eps": 1e-6, "hidden_activation": "gelu_pytorch_tanh",
+    })
+    assert cfg.rmsnorm_offset and cfg.embedding_scale and cfg.tie_embeddings
+    assert cfg.mlp_activation == "gelu_tanh"
