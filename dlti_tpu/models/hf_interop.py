@@ -171,6 +171,9 @@ def config_to_hf(cfg: ModelConfig) -> Dict[str, Any]:
         "tie_word_embeddings": cfg.tie_embeddings,
         "hidden_act": {"silu": "silu", "gelu_exact": "gelu",
                        "gelu_tanh": "gelu_pytorch_tanh"}[cfg.mlp_activation],
+        # transformers' Gemma ignores hidden_act and reads this key.
+        "hidden_activation": {"silu": "silu", "gelu_exact": "gelu",
+                              "gelu_tanh": "gelu_pytorch_tanh"}[cfg.mlp_activation],
         "torch_dtype": {"bfloat16": "bfloat16", "float16": "float16",
                         "float32": "float32"}[cfg.param_dtype],
     }

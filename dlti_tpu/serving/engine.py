@@ -59,6 +59,13 @@ class EngineConfig:
     # later requests sharing a prompt prefix; unreferenced blocks are
     # evicted LRU under pool pressure.
     enable_prefix_caching: bool = False
+    # Multi-step decode: run this many decode iterations inside ONE
+    # compiled program (lax.scan: forward -> sample -> feed back), syncing
+    # with the host only at the boundary. Amortizes per-step dispatch and
+    # host round-trips (vLLM's multi-step scheduling); the trade-off is up
+    # to steps_per_sync-1 discarded tokens after an EOS and coarser
+    # admission cadence.
+    steps_per_sync: int = 1
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -209,6 +216,9 @@ class InferenceEngine:
 
         self._prefill_fns: Dict[int, callable] = {}
         self._decode_fn = self._build_decode_fn()
+        self._multi_decode_fn = (
+            self._build_multi_decode_fn(ec.steps_per_sync)
+            if ec.steps_per_sync > 1 else None)
         self._sample_fn = jax.jit(sample_tokens)
 
         # Aggregate stats for the /stats endpoint and load reports.
@@ -284,6 +294,35 @@ class InferenceEngine:
             return new_kv, tokens, logprobs
 
         return decode
+
+    def _build_multi_decode_fn(self, num_steps: int):
+        """K decode iterations in one program: the sampled token feeds the
+        next forward inside a lax.scan; the host syncs once per K tokens.
+
+        The per-slot rng stream (fold_in(key, gen_count)) advances exactly
+        as in single-step decode, so results are identical for a given
+        request regardless of steps_per_sync.
+        """
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_multi(params, cache_kv, input_ids, positions, block_tables,
+                         slot_keys, gen_counts, temperature, top_k, top_p):
+            def body(carry, _):
+                cache, tok, pos, cnt = carry
+                logits, new_kv = self._model_cache_call(
+                    params, cache, block_tables, tok, pos
+                )
+                rngs = jax.vmap(jax.random.fold_in)(slot_keys, cnt)
+                nxt, lp = sample_tokens(
+                    logits[:, 0, :], rngs, temperature, top_k, top_p)
+                return (new_kv, nxt[:, None], pos + 1, cnt + 1), (nxt, lp)
+
+            (new_kv, _, _, _), (toks, lps) = jax.lax.scan(
+                body, (cache_kv, input_ids, positions, gen_counts),
+                None, length=num_steps)
+            # (K, S) -> (S, K)
+            return new_kv, toks.T, lps.T
+
+        return decode_multi
 
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.buckets():
@@ -446,15 +485,25 @@ class InferenceEngine:
 
     def _decode_step(self) -> List[Request]:
         ec = self.cfg
-        # Grow block tables for sequences about to cross a block boundary;
-        # preempt the youngest if the pool is exhausted.
+        # Multi-step decode only when every active slot has room for the
+        # whole window (writing past max_model_len would clip block-table
+        # lookups back into a slot's own live blocks).
+        k_steps = 1
+        active0 = [s for s in self.slots if not s.free]
+        if self._multi_decode_fn is not None and active0 and all(
+                s.seq_len + ec.steps_per_sync <= ec.max_model_len
+                for s in active0):
+            k_steps = ec.steps_per_sync
+
+        # Grow block tables to cover the decode window; preempt the
+        # youngest if the pool is exhausted.
         for slot in sorted(
             (s for s in self.slots if not s.free),
             key=lambda s: s.request.arrival_time,
         ):
             if slot.free:  # preempted by an earlier iteration of this loop
                 continue
-            need = self.block_manager.blocks_needed(slot.seq_len + 1)
+            need = self.block_manager.blocks_needed(slot.seq_len + k_steps)
             while need > len(slot.blocks):
                 got = self._alloc(1)
                 if got is None:
@@ -477,24 +526,35 @@ class InferenceEngine:
             if not s.free:
                 ids[s.slot_id, 0] = s.last_token
                 pos[s.slot_id, 0] = s.seq_len  # position of the new token
-        self.cache, tokens, logprobs = self._decode_fn(
+        args = (
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
             jnp.asarray(self._block_tables), jnp.asarray(self._slot_keys),
             jnp.asarray(self._gen_counts),
             jnp.asarray(self._temperature), jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
-        tokens = np.asarray(jax.device_get(tokens))
+        if k_steps > 1:
+            self.cache, tokens, logprobs = self._multi_decode_fn(*args)
+        else:
+            self.cache, tokens, logprobs = self._decode_fn(*args)
+            tokens = tokens[:, None]
+            logprobs = logprobs[:, None]
+        tokens = np.asarray(jax.device_get(tokens))      # (S, k_steps)
         logprobs = np.asarray(jax.device_get(logprobs))
-        self.stats["decode_steps"] += 1
+        self.stats["decode_steps"] += k_steps
 
         finished = []
         for s in active:
-            s.seq_len += 1  # the input token is now in the cache
-            done = self._append_token(s, int(tokens[s.slot_id]),
-                                      float(logprobs[s.slot_id]))
-            if done:
-                finished.append(s.request)
+            for k in range(k_steps):
+                s.seq_len += 1  # the input token is now in the cache
+                done = self._append_token(s, int(tokens[s.slot_id, k]),
+                                          float(logprobs[s.slot_id, k]))
+                if done:
+                    # Tokens sampled after EOS/limit in this window are
+                    # discarded (their stale KV writes sit past seq_len in
+                    # the freed tail blocks — never registered or read).
+                    finished.append(s.request)
+                    break
         return finished
 
     def _append_token(self, slot: _Slot, token: int, logprob: float) -> bool:

@@ -173,21 +173,26 @@ class Trainer:
 
             eval_fn = jax.jit(make_eval_step(self.model))
 
-        profiling = False
+        # Profiler window state: "pending" -> "active" -> "done" (at most
+        # one trace per run; ">=" so a resume past the start step still
+        # captures the next profile_num_steps steps).
+        profile_state = "pending"
+        profile_stop_at = None
         for epoch in range(start_epoch, cfg.train.num_epochs):
             for batch in epoch_batches(epoch):
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
                 if cfg.train.profile_dir and is_main_process():
-                    if (not profiling
-                            and global_step == cfg.train.profile_start_step):
+                    if (profile_state == "pending"
+                            and global_step >= cfg.train.profile_start_step):
                         jax.profiler.start_trace(cfg.train.profile_dir)
-                        profiling = True
-                    elif profiling and global_step >= (
-                            cfg.train.profile_start_step
-                            + cfg.train.profile_num_steps):
+                        profile_state = "active"
+                        profile_stop_at = (global_step
+                                           + cfg.train.profile_num_steps)
+                    elif (profile_state == "active"
+                          and global_step >= profile_stop_at):
                         jax.profiler.stop_trace()
-                        profiling = False
+                        profile_state = "done"
                         self.logger.info("profiler trace -> %s",
                                          cfg.train.profile_dir)
                 if self.mesh is not None:
@@ -220,7 +225,7 @@ class Trainer:
             if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                 break
 
-        if profiling:  # run ended inside the trace window
+        if profile_state == "active":  # run ended inside the trace window
             jax.profiler.stop_trace()
         if cfg.checkpoint.save_strategy != "no":
             from dlti_tpu.checkpoint import wait_for_saves

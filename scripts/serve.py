@@ -48,6 +48,9 @@ def parse_args():
     p.add_argument("--tensor", type=int, default=1,
                    help="tensor-parallel extent: shard weights + KV pools "
                         "over this many chips (ICI collectives via GSPMD)")
+    p.add_argument("--steps-per-sync", type=int, default=1,
+                   help="decode iterations per compiled program (multi-step "
+                        "scheduling; amortizes host round-trips)")
     return p.parse_args()
 
 
@@ -90,6 +93,7 @@ def main() -> None:
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         eos_token_id=tok.eos_id,
         enable_prefix_caching=args.enable_prefix_caching,
+        steps_per_sync=args.steps_per_sync,
     )
     mesh = None
     if args.tensor > 1:

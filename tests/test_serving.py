@@ -346,3 +346,44 @@ def test_engine_tp_mesh_validation(tiny_model_and_params):
         InferenceEngine(CFG, params, ec,
                         mesh=build_mesh(ParallelConfig(data=2, tensor=2),
                                         devices=jax.devices()[:4]))
+
+
+def test_multi_step_decode_matches_single_step(tiny_model_and_params):
+    """steps_per_sync=4 produces identical tokens (greedy AND seeded
+    sampling) to single-step decode, including mid-window EOS handling."""
+    model, params = tiny_model_and_params
+
+    def mk(steps):
+        ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=64,
+                          max_model_len=64, cache_dtype="float32",
+                          eos_token_id=-1, steps_per_sync=steps)
+        return InferenceEngine(CFG, params, ec)
+
+    prompts = [[3, 1, 4, 1, 5, 9], [2, 7, 1, 8, 2, 8]]
+    for sp in (SamplingParams(temperature=0.0, max_tokens=11),
+               SamplingParams(temperature=0.8, top_k=20, seed=7, max_tokens=11)):
+        want = mk(1).generate(prompts, sp)
+        got = mk(4).generate(prompts, sp)
+        for g, w in zip(got, want):
+            assert g.output_token_ids == w.output_token_ids
+            assert g.finish_reason == w.finish_reason
+
+
+def test_multi_step_decode_respects_stop_tokens(tiny_model_and_params):
+    """A stop token hit mid-window finishes the request there; later
+    window tokens are discarded."""
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=1, block_size=8, num_blocks=32,
+                      max_model_len=64, cache_dtype="float32",
+                      eos_token_id=-1, steps_per_sync=4)
+    engine = InferenceEngine(CFG, params, ec)
+    # Find what greedy generates, then stop on its 2nd token.
+    [probe] = engine.generate([[5, 4, 3]], SamplingParams(temperature=0.0,
+                                                          max_tokens=8))
+    stop_tok = probe.output_token_ids[1]
+    [r] = engine.generate([[5, 4, 3]], SamplingParams(
+        temperature=0.0, max_tokens=8, stop_token_ids=(stop_tok,)))
+    assert r.output_token_ids[-1] == stop_tok
+    assert len(r.output_token_ids) == 2
+    assert r.finish_reason == "stop"
+    assert engine.num_active == 0
