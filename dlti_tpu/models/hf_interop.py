@@ -54,9 +54,7 @@ def _unwrap(params: Mapping[str, Any]) -> Mapping[str, Any]:
     return params["params"] if "params" in params and "model" not in params else params
 
 
-def _dtype(name: str):
-    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-            "float16": jnp.float16}[name]
+from dlti_tpu.utils.dtypes import resolve_dtype as _dtype  # shared table
 
 
 # ----------------------------------------------------------------------

@@ -34,8 +34,7 @@ from dlti_tpu.ops.attention import reference_attention
 from dlti_tpu.ops.rope import apply_rope, rope_frequencies
 
 
-def _dtype(name: str):
-    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
+from dlti_tpu.utils.dtypes import resolve_dtype as _dtype  # shared table
 
 
 class RMSNorm(nn.Module):
@@ -305,7 +304,12 @@ class LlamaModel(nn.Module):
             (cfg.vocab_size, cfg.hidden_size),
             pdtype,
         )
-        x = jnp.take(embed, input_ids, axis=0).astype(dtype)
+        if isinstance(embed, dict):
+            # int8 serving: gather int8 rows, then scale (per-channel).
+            x = (embed["q"][input_ids].astype(dtype)
+                 * embed["scale"].astype(dtype))
+        else:
+            x = jnp.take(embed, input_ids, axis=0).astype(dtype)
         if cfg.embedding_scale:  # Gemma: embeddings scaled by sqrt(hidden)
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
 
@@ -360,7 +364,10 @@ class LlamaForCausalLM(nn.Module):
             input_ids, positions, segment_ids, cache, deterministic, token_mask
         )
         if cfg.tie_embeddings:
-            embed = self.variables["params"]["model"]["embed_tokens"]
+            from dlti_tpu.models.quantization import maybe_dequantize
+
+            embed = maybe_dequantize(
+                self.variables["params"]["model"]["embed_tokens"], jnp.float32)
             logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
                                 embed.astype(jnp.float32))
         else:
@@ -368,6 +375,10 @@ class LlamaForCausalLM(nn.Module):
                 "lm_head", nn.initializers.normal(stddev=0.02),
                 (cfg.hidden_size, cfg.vocab_size), pdtype,
             )
+            if isinstance(lm_head, dict):
+                from dlti_tpu.models.quantization import maybe_dequantize
+
+                lm_head = maybe_dequantize(lm_head, x.dtype)
             logits = jnp.dot(x, lm_head.astype(x.dtype),
                              preferred_element_type=jnp.float32)
         return logits.astype(jnp.float32), new_cache

@@ -44,6 +44,13 @@ class LoRADense(nn.Module):
         kernel = self.param(
             "kernel", self.kernel_init, (in_features, self.features), self.param_dtype
         )
+        if isinstance(kernel, dict):
+            # Weight-only int8 serving: the stored leaf is {"q", "scale"};
+            # dequantize at the consumer so only the executing layer holds
+            # a compute-dtype copy (dlti_tpu.models.quantization).
+            from dlti_tpu.models.quantization import maybe_dequantize
+
+            kernel = maybe_dequantize(kernel, self.dtype)
         y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype),
                     preferred_element_type=self.dtype)
         if self.use_bias:

@@ -97,6 +97,10 @@ class MoEMLP(nn.Module):
         w1 = self.param("w1", nn.initializers.lecun_normal(), (E, h, m), pdtype)
         w3 = self.param("w3", nn.initializers.lecun_normal(), (E, h, m), pdtype)
         w2 = self.param("w2", nn.initializers.lecun_normal(), (E, m, h), pdtype)
+        if isinstance(w1, dict):  # int8 serving (per-expert-channel scales)
+            from dlti_tpu.models.quantization import maybe_dequantize
+
+            w1, w2, w3 = (maybe_dequantize(w, dtype) for w in (w1, w2, w3))
 
         hidden = (nn.silu(jnp.einsum("ech,ehm->ecm", expert_in, w1.astype(dtype)))
                   * jnp.einsum("ech,ehm->ecm", expert_in, w3.astype(dtype)))

@@ -1,0 +1,99 @@
+"""Weight-only int8 quantization for serving.
+
+The reference pins ``bitsandbytes`` (``requirements.txt:12``) but never
+imports it (SURVEY.md §2b: declared, unused); this is the TPU-native
+realization of that latent capability. Weights rest in HBM as int8 with
+per-output-channel fp32 scales (symmetric absmax) — roughly halving
+weight memory, which goes straight into a bigger KV block pool — and are
+dequantized inside the compiled program, where XLA fuses the
+``int8 -> bf16 * scale`` expansion into the consuming matmul's prologue.
+
+Quantized leaves are ``{"q": int8[...], "scale": f32[out]}`` dicts in
+place of the original array; :func:`dequantize_params` restores the
+compute-dtype tree (call it *inside* jit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+# Leaves worth quantizing: big 2-D+ matmul weights. Tiny/1-D leaves (norm
+# scales, biases, LoRA factors) stay in their original dtype.
+_MIN_QUANT_SIZE = 1 << 14
+
+
+def _should_quantize(path: tuple, value: Any) -> bool:
+    if not hasattr(value, "shape") or value.ndim < 2:
+        return False
+    if value.size < _MIN_QUANT_SIZE:
+        return False
+    name = str(getattr(path[-1], "key", path[-1]))
+    return name not in ("lora_a", "lora_b")
+
+
+def quantize_params_int8(params: Mapping[str, Any]) -> Any:
+    """Quantize matmul weights to int8 + per-out-channel scales.
+
+    The last dim is treated as the output-channel dim ((in, out) Flax
+    kernels, (vocab, hidden) embeddings, stacked expert weights alike).
+    """
+    def leaf(path, v):
+        if not _should_quantize(path, v):
+            return v
+        v32 = jnp.asarray(v, jnp.float32)
+        # Reduce over the contraction dim only (axis -2), keeping leading
+        # dims: 2-D kernels get per-out-channel scales, stacked expert
+        # weights (E, h, m) get per-expert-per-channel (E, 1, m) scales —
+        # one quiet expert never inherits a loud expert's scale.
+        absmax = jnp.max(jnp.abs(v32), axis=-2, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(v32 / scale), -127, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale.astype(jnp.float32)}
+
+    return jax.tree_util.tree_map_with_path(leaf, dict(params))
+
+
+def is_quant_node(node: Any) -> bool:
+    return (isinstance(node, Mapping) and set(node.keys()) == {"q", "scale"}
+            and getattr(node.get("q"), "dtype", None) == jnp.int8)
+
+
+def maybe_dequantize(leaf: Any, dtype) -> Any:
+    """Expand one (possibly) quantized leaf to ``dtype``.
+
+    Called at each weight's *consumer* (LoRADense / embeddings / MoE
+    experts), so only the weights of the layer currently executing hold a
+    dequantized copy — peak HBM stays ~int8 tree + one layer, not int8 +
+    a full compute-dtype tree (which a whole-tree dequant at program top
+    would pin live, especially hoisted out of a multi-step decode scan).
+    """
+    if is_quant_node(leaf):
+        return (leaf["q"].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+    return leaf
+
+
+def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Whole-tree expansion (tests/export; the model dequantizes per leaf
+    at the consumer via :func:`maybe_dequantize`)."""
+    if is_quant_node(params):
+        return maybe_dequantize(params, dtype)
+    if isinstance(params, Mapping):
+        return {k: dequantize_params(v, dtype) for k, v in params.items()}
+    return params
+
+
+def quantization_error(params: Any, qparams: Any) -> float:
+    """Worst relative per-leaf RMS error — a quick sanity metric."""
+    worst = 0.0
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    deq = dequantize_params(qparams, jnp.float32)
+    flat_b = jax.tree_util.tree_leaves_with_path(deq)
+    for (_, a), (_, b) in zip(flat_a, flat_b):
+        a32 = jnp.asarray(a, jnp.float32)
+        rms = float(jnp.sqrt(jnp.mean((a32 - b) ** 2)))
+        denom = float(jnp.sqrt(jnp.mean(a32 ** 2))) or 1.0
+        worst = max(worst, rms / denom)
+    return worst

@@ -66,6 +66,10 @@ class EngineConfig:
     # to steps_per_sync-1 discarded tokens after an EOS and coarser
     # admission cadence.
     steps_per_sync: int = 1
+    # Weight-only quantization: "int8" stores matmul weights as int8 +
+    # per-channel scales (~half the weight HBM -> bigger KV pool),
+    # dequantized inside the compiled programs. "none" keeps param_dtype.
+    quantization: str = "none"
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -177,10 +181,24 @@ class InferenceEngine:
         self.logger = get_logger()
         self.mesh = mesh
         self.model = LlamaForCausalLM(model_cfg, lora_cfg, mesh)
+        self._quantized = engine_cfg.quantization == "int8"
+        if engine_cfg.quantization not in ("none", "int8"):
+            raise ValueError(f"unknown quantization {engine_cfg.quantization!r}")
+        if self._quantized:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "int8 weights + tensor-parallel serving are not "
+                    "composable yet (TP sharding rules match unquantized "
+                    "param paths)")
+            from dlti_tpu.models.quantization import quantize_params_int8
+
+            params = quantize_params_int8(params)
         self.params = params
 
         ec = engine_cfg
-        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[ec.cache_dtype]
+        from dlti_tpu.utils.dtypes import resolve_dtype
+
+        dtype = resolve_dtype(ec.cache_dtype)
         self.cache = init_paged_cache(
             model_cfg.num_layers, ec.num_blocks, ec.block_size,
             model_cfg.num_kv_heads, model_cfg.resolved_head_dim, dtype,
@@ -253,7 +271,12 @@ class InferenceEngine:
     # Compiled programs
     # ------------------------------------------------------------------
     def _model_cache_call(self, params, cache_kv, block_tables, input_ids, positions):
-        """Run the model over a paged cache; returns (logits, new k/v list)."""
+        """Run the model over a paged cache; returns (logits, new k/v list).
+
+        Quantized params pass through as-is — each module dequantizes its
+        own weights at the consumer (``models.quantization.maybe_dequantize``),
+        so only the executing layer holds a compute-dtype copy even inside
+        the multi-step decode scan."""
         cache = [
             {"k": layer["k"], "v": layer["v"], "block_tables": block_tables}
             for layer in cache_kv

@@ -51,6 +51,9 @@ def parse_args():
     p.add_argument("--steps-per-sync", type=int, default=1,
                    help="decode iterations per compiled program (multi-step "
                         "scheduling; amortizes host round-trips)")
+    p.add_argument("--quantization", default="none", choices=["none", "int8"],
+                   help="weight-only quantization (int8 + per-channel scales; "
+                        "~halves weight HBM)")
     return p.parse_args()
 
 
@@ -94,6 +97,7 @@ def main() -> None:
         eos_token_id=tok.eos_id,
         enable_prefix_caching=args.enable_prefix_caching,
         steps_per_sync=args.steps_per_sync,
+        quantization=args.quantization,
     )
     mesh = None
     if args.tensor > 1:

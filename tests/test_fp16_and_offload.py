@@ -177,3 +177,28 @@ def test_param_offload_requires_lora():
                                lora_enabled=False)
     with pytest.raises(ValueError, match="offload_params"):
         shard_train_state(state, cfg, mesh)
+
+
+def test_fp16_scaler_survives_checkpoint_resume(tmp_path, rng):
+    """The dynamic scaler state checkpoints and restores with the rest of
+    the train state."""
+    from dlti_tpu.checkpoint import (latest_step, restore_train_state,
+                                     save_train_state, wait_for_saves)
+
+    model, state = _mk_state(fp16_scale=2.0 ** 8)
+    step = jax.jit(make_train_step(model, accum_steps=1, fp16_scale_window=2))
+    state, _ = step(state, _batch(rng), rng)
+    state, _ = step(state, _batch(rng), rng)  # window hit: scale doubled
+    assert float(state.scaler["scale"]) == 512.0
+
+    save_train_state(str(tmp_path), 2, state, keep=2, async_save=False)
+    wait_for_saves(str(tmp_path))
+
+    _, fresh = _mk_state(fp16_scale=2.0 ** 8)
+    assert latest_step(str(tmp_path)) == 2
+    restored = restore_train_state(str(tmp_path), 2, fresh)
+    assert float(restored.scaler["scale"]) == 512.0
+    assert int(restored.scaler["good_steps"]) == int(state.scaler["good_steps"])
+    # And training continues from the restored scaler.
+    restored, m = step(restored, _batch(rng), rng)
+    assert np.isfinite(float(m["loss"]))
