@@ -31,7 +31,9 @@ def _should_quantize(path: tuple, value: Any) -> bool:
     if value.size < _MIN_QUANT_SIZE:
         return False
     name = str(getattr(path[-1], "key", path[-1]))
-    return name not in ("lora_a", "lora_b")
+    # LoRA factors are tiny; the MoE router is deliberately fp32 (stable
+    # softmax/top-k) and its consumer takes it unquantized.
+    return name not in ("lora_a", "lora_b", "router")
 
 
 def quantize_params_int8(params: Mapping[str, Any]) -> Any:

@@ -52,5 +52,12 @@ def load_native_runtime() -> Optional[ctypes.CDLL]:
     lib.dlti_allocator_allocate.restype = ctypes.c_int32
     lib.dlti_allocator_free.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    # Packer ABI (absent in older builds of the library).
+    if hasattr(lib, "dlti_pack_assign"):
+        lib.dlti_pack_assign.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.dlti_pack_assign.restype = ctypes.c_int32
     _LIB = lib
     return _LIB

@@ -1,0 +1,61 @@
+// Sequence-packing assignment: the host-side hot loop of the data
+// pipeline, in C++. Matches dlti_tpu.data.pipeline.pack_sequences'
+// greedy windowed first-fit semantics exactly (same placements, same
+// segment ids) — the Python implementation remains as the fallback and
+// the differential-test oracle.
+//
+// Only the *assignment* runs here (O(docs * open_rows) scalar work that
+// dominates in Python); the token scatter into the packed matrix is a
+// single vectorized numpy put on the Python side.
+
+#include <algorithm>
+#include <cstdint>
+#include <deque>
+#include <vector>
+
+extern "C" {
+
+// doc_lens: per-document token counts (callers pre-truncate to seq_len).
+// Outputs (all length n_docs): row index, start column, 1-based segment id
+// within the row. Returns the number of packed rows.
+int32_t dlti_pack_assign(const int64_t* doc_lens, int32_t n_docs,
+                         int32_t seq_len, int32_t open_rows,
+                         int32_t* out_row, int32_t* out_col,
+                         int32_t* out_seg) {
+  std::vector<int32_t> row_len;
+  std::vector<int32_t> row_last_seg;
+  std::deque<int32_t> open;  // still-open rows, oldest first
+  row_len.reserve(n_docs);
+  row_last_seg.reserve(n_docs);
+
+  for (int32_t d = 0; d < n_docs; ++d) {
+    const int32_t L =
+        static_cast<int32_t>(std::min<int64_t>(doc_lens[d], seq_len));
+    bool placed = false;
+    for (auto it = open.begin(); it != open.end(); ++it) {
+      const int32_t r = *it;
+      if (row_len[r] + L <= seq_len) {
+        out_row[d] = r;
+        out_col[d] = row_len[r];
+        out_seg[d] = ++row_last_seg[r];
+        row_len[r] += L;
+        if (row_len[r] == seq_len) open.erase(it);
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
+      const int32_t r = static_cast<int32_t>(row_len.size());
+      row_len.push_back(L);
+      row_last_seg.push_back(1);
+      out_row[d] = r;
+      out_col[d] = 0;
+      out_seg[d] = 1;
+      open.push_back(r);
+      if (static_cast<int32_t>(open.size()) > open_rows) open.pop_front();
+    }
+  }
+  return static_cast<int32_t>(row_len.size());
+}
+
+}  // extern "C"

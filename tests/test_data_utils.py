@@ -132,3 +132,45 @@ def test_config_roundtrip():
     cfg = preset("zero2_8dev", model="llama_debug")
     back = Config.from_json(cfg.to_json())
     assert back == cfg
+
+
+def test_native_packer_matches_python_oracle(monkeypatch):
+    """C++ pack assignment == pure-Python packing, bit for bit."""
+    import os
+    import numpy as np
+
+    from dlti_tpu.data.pipeline import pack_sequences
+    from dlti_tpu.utils import native as native_mod
+
+    if native_mod.load_native_runtime() is None or not hasattr(
+            native_mod.load_native_runtime(), "dlti_pack_assign"):
+        import pytest
+        pytest.skip("native runtime not built")
+
+    rng = np.random.default_rng(0)
+    seqs = [list(map(int, rng.integers(1, 100, rng.integers(1, 40))))
+            for _ in range(300)]
+    got = pack_sequences(seqs, seq_len=64, pad_id=0, open_rows=8)
+
+    # Force the Python path for the oracle.
+    monkeypatch.setenv("DLTI_DISABLE_NATIVE", "1")
+    native_mod._TRIED = False
+    native_mod._LIB = None
+    try:
+        want = pack_sequences(seqs, seq_len=64, pad_id=0, open_rows=8)
+    finally:
+        monkeypatch.delenv("DLTI_DISABLE_NATIVE")
+        native_mod._TRIED = False
+        native_mod._LIB = None
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_pack_sequences_drops_empty_docs():
+    import numpy as np
+
+    from dlti_tpu.data.pipeline import pack_sequences
+
+    ids, mask, segs = pack_sequences([[5], [], [7]], seq_len=4, pad_id=0)
+    np.testing.assert_array_equal(ids[0, :2], [5, 7])
+    np.testing.assert_array_equal(segs[0, :2], [1, 2])

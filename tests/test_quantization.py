@@ -84,3 +84,25 @@ def test_int8_rejects_tp_mesh(model_and_params):
                         EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
                                      max_model_len=48, quantization="int8"),
                         mesh=mesh)
+
+
+def test_int8_moe_engine_serves(model_and_params):
+    """MoE int8 serving: experts quantize (per-expert scales), the router
+    stays fp32, and generation runs."""
+    moe_cfg = dataclasses.replace(
+        MODEL_PRESETS["mixtral_tiny"], hidden_size=128, intermediate_size=256,
+        vocab_size=1024)
+    model = LlamaForCausalLM(moe_cfg, None)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    qp = quantize_params_int8(params)
+    mlp = qp["model"]["layers_0"]["mlp"]
+    assert mlp["w1"]["q"].dtype == jnp.int8
+    assert mlp["w1"]["scale"].shape == (4, 1, 256)  # per-expert-channel
+    assert mlp["router"].dtype != jnp.int8  # excluded
+
+    engine = InferenceEngine(moe_cfg, params, EngineConfig(
+        max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+        cache_dtype="float32", eos_token_id=-1, quantization="int8"))
+    [r] = engine.generate([[3, 1, 4, 1, 5]],
+                          SamplingParams(temperature=0.0, max_tokens=5))
+    assert len(r.output_token_ids) == 5
