@@ -131,6 +131,9 @@ def _pack_sequences_native(native, seqs, seq_len: int, pad_id: int,
     )
 
     total = int(lens.sum())
+    # Measured at 50k docs: fromiter over one flat generator beats
+    # per-doc np.asarray + np.concatenate ~2x (50k tiny array
+    # constructions dominate the latter).
     tokens = np.fromiter(
         (t for s in seqs for t in (s if len(s) <= seq_len else s[:seq_len])),
         np.int32, count=total) if total else np.empty(0, np.int32)

@@ -70,6 +70,19 @@ class EngineConfig:
     # per-channel scales (~half the weight HBM -> bigger KV pool),
     # dequantized inside the compiled programs. "none" keeps param_dtype.
     quantization: str = "none"
+    # Speculative decoding: "ngram" proposes draft tokens by prompt lookup
+    # (match the trailing n-gram against earlier context, copy what
+    # followed) and verifies them in ONE forward over draft+1 positions —
+    # greedy-exact up to batched-matmul numerics (a (k+1)-position forward
+    # tiles differently than a 1-position one, the same ~1e-2 bf16 logit
+    # delta any batch-shape change causes; ties only flip on near-ties,
+    # which trained models rarely produce at the argmax). Several tokens
+    # per model call on repetitive text (code, extraction, chat
+    # templates). Engaged only when every active slot decodes greedily;
+    # sampling batches use the normal path.
+    speculative: str = "none"          # "none" | "ngram"
+    num_draft_tokens: int = 4
+    ngram_size: int = 2
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -237,12 +250,17 @@ class InferenceEngine:
         self._multi_decode_fn = (
             self._build_multi_decode_fn(ec.steps_per_sync)
             if ec.steps_per_sync > 1 else None)
+        self._verify_fn = (self._build_verify_fn(ec.num_draft_tokens)
+                          if ec.speculative == "ngram" else None)
+        if ec.speculative not in ("none", "ngram"):
+            raise ValueError(f"unknown speculative mode {ec.speculative!r}")
         self._sample_fn = jax.jit(sample_tokens)
 
         # Aggregate stats for the /stats endpoint and load reports.
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
-                      "prefix_cached_tokens": 0}
+                      "prefix_cached_tokens": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
 
     # ------------------------------------------------------------------
     def _shard_for_tp(self, mesh) -> None:
@@ -346,6 +364,41 @@ class InferenceEngine:
             return new_kv, toks.T, lps.T
 
         return decode_multi
+
+    def _build_verify_fn(self, k: int):
+        """One forward over (S, k+1) positions: the current input token
+        plus k draft tokens per slot. Returns greedy argmax tokens and
+        logprobs at every position; acceptance happens on the host."""
+        @partial(jax.jit, donate_argnums=(1,))
+        def verify(params, cache_kv, input_ids, positions, block_tables):
+            logits, new_kv = self._model_cache_call(
+                params, cache_kv, block_tables, input_ids, positions
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (S, k+1)
+            lps = jnp.take_along_axis(logp, toks[:, :, None], axis=-1)[:, :, 0]
+            return new_kv, toks, lps
+
+        return verify
+
+    @staticmethod
+    def _propose_ngram(context: List[int], n: int, k: int) -> List[int]:
+        """Prompt-lookup drafts: find the most recent earlier occurrence of
+        the trailing n-gram and copy up to k tokens that followed it.
+
+        Vectorized (one sliding-window comparison in C) — this runs per
+        slot per decode step on the host critical path.
+        """
+        if len(context) <= n:
+            return []
+        ctx = np.asarray(context, np.int32)
+        tail = ctx[-n:]
+        windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.flatnonzero(np.all(windows == tail, axis=1))
+        if hits.size == 0:
+            return []
+        start = int(hits[-1])  # most recent earlier occurrence
+        return [int(t) for t in ctx[start + n:start + n + k]]
 
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.buckets():
@@ -513,7 +566,22 @@ class InferenceEngine:
         # lookups back into a slot's own live blocks).
         k_steps = 1
         active0 = [s for s in self.slots if not s.free]
-        if self._multi_decode_fn is not None and active0 and all(
+        # Speculative decode: greedy-only batches with at least one
+        # non-empty n-gram draft verify k drafts + 1 token per model call.
+        drafts: Dict[int, List[int]] = {}
+        if self._verify_fn is not None and active0 and all(
+                s.request.params.temperature == 0.0 for s in active0) and all(
+                s.seq_len + ec.num_draft_tokens + 1 <= ec.max_model_len
+                for s in active0):
+            for s in active0:
+                ctx = s.request.prompt_token_ids + s.request.output_token_ids
+                drafts[s.slot_id] = self._propose_ngram(
+                    ctx, ec.ngram_size, ec.num_draft_tokens)
+            if not any(drafts.values()):
+                drafts = {}
+        if drafts:
+            k_steps = ec.num_draft_tokens + 1  # window for block growth
+        elif self._multi_decode_fn is not None and active0 and all(
                 s.seq_len + ec.steps_per_sync <= ec.max_model_len
                 for s in active0):
             k_steps = ec.steps_per_sync
@@ -542,6 +610,8 @@ class InferenceEngine:
         active = [s for s in self.slots if not s.free]
         if not active:
             return []
+        if drafts:
+            return self._speculative_step(active, drafts)
 
         ids = np.zeros((ec.max_seqs, 1), np.int32)
         pos = np.zeros((ec.max_seqs, 1), np.int32)  # inactive -> trash block
@@ -576,6 +646,57 @@ class InferenceEngine:
                     # Tokens sampled after EOS/limit in this window are
                     # discarded (their stale KV writes sit past seq_len in
                     # the freed tail blocks — never registered or read).
+                    finished.append(s.request)
+                    break
+        return finished
+
+    def _speculative_step(self, active: List[_Slot],
+                          drafts: Dict[int, List[int]]) -> List[Request]:
+        """Verify each slot's draft in one (S, k+1)-position forward and
+        emit the accepted prefix plus one bonus token — exact greedy
+        decoding, m+1 tokens per model call when m drafts match."""
+        ec = self.cfg
+        k = ec.num_draft_tokens
+        ids = np.zeros((ec.max_seqs, k + 1), np.int32)
+        pos = np.zeros((ec.max_seqs, k + 1), np.int32)  # inactive -> trash
+        for s in active:
+            d = drafts.get(s.slot_id, [])
+            ids[s.slot_id, 0] = s.last_token
+            ids[s.slot_id, 1:1 + len(d)] = d
+            pos[s.slot_id] = np.arange(s.seq_len, s.seq_len + k + 1)
+        # Multi-query attention takes the gather path (the Pallas paged
+        # kernel is single-token); bound its window to the blocks actually
+        # live now, quantized pow2 so jit specializations stay O(log).
+        nblk = max(self.block_manager.blocks_needed(s.seq_len + k + 1)
+                   for s in active)
+        width = 1
+        while width < nblk:
+            width *= 2
+        width = min(width, ec.max_blocks_per_seq)
+        self.cache, toks, lps = self._verify_fn(
+            self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
+            jnp.asarray(self._block_tables[:, :width]),
+        )
+        toks = np.asarray(jax.device_get(toks))
+        lps = np.asarray(jax.device_get(lps))
+        self.stats["decode_steps"] += 1
+
+        finished = []
+        for s in active:
+            d = drafts.get(s.slot_id, [])
+            m = 0
+            while m < len(d) and d[m] == int(toks[s.slot_id, m]):
+                m += 1
+            self.stats["spec_proposed"] += len(d)
+            self.stats["spec_accepted"] += m
+            # Emit the m accepted tokens plus the bonus token; positions
+            # past the accepted prefix hold wrong-input KV and are simply
+            # overwritten when those positions are truly decoded.
+            for j in range(m + 1):
+                s.seq_len += 1
+                done = self._append_token(s, int(toks[s.slot_id, j]),
+                                          float(lps[s.slot_id, j]))
+                if done:
                     finished.append(s.request)
                     break
         return finished

@@ -54,6 +54,12 @@ def parse_args():
     p.add_argument("--quantization", default="none", choices=["none", "int8"],
                    help="weight-only quantization (int8 + per-channel scales; "
                         "~halves weight HBM)")
+    p.add_argument("--speculative", default="none", choices=["none", "ngram"],
+                   help="n-gram prompt-lookup speculative decoding (exact "
+                        "greedy outputs, multiple tokens per model call)")
+    p.add_argument("--num-draft-tokens", type=int, default=4)
+    p.add_argument("--ngram-size", type=int, default=2,
+                   help="trailing n-gram length matched for prompt lookup")
     return p.parse_args()
 
 
@@ -98,6 +104,9 @@ def main() -> None:
         enable_prefix_caching=args.enable_prefix_caching,
         steps_per_sync=args.steps_per_sync,
         quantization=args.quantization,
+        speculative=args.speculative,
+        num_draft_tokens=args.num_draft_tokens,
+        ngram_size=args.ngram_size,
     )
     mesh = None
     if args.tensor > 1:
