@@ -63,6 +63,9 @@ class Trainer:
         )
         self._step_fn = None
         self._ckpt_mgr = None
+        # Preemption flag: set by SIGTERM (cluster eviction) or
+        # request_stop(); honored at the next step boundary.
+        self._stop_requested = False
 
     # ------------------------------------------------------------------
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -123,6 +126,23 @@ class Trainer:
         state = state or self.init_state()
         resume = cfg.checkpoint.resume if resume is None else resume
 
+        # Preemption-aware checkpointing (SURVEY.md §5.3): the reference's
+        # only resilience is frequent periodic saves; here SIGTERM (the
+        # cluster-eviction signal) triggers one final checkpoint at the
+        # next step boundary, so resume loses at most one step instead of
+        # up to save_steps.
+        import signal as _signal
+
+        self._stop_requested = False  # a reused Trainer trains again
+        prev_handler = None
+        sigterm_installed = False
+        try:
+            prev_handler = _signal.signal(
+                _signal.SIGTERM, lambda *_: self.request_stop())
+            sigterm_installed = True
+        except ValueError:
+            pass  # not the main thread (e.g. embedded in a server)
+
         start_step = 0
         if resume and cfg.checkpoint.save_strategy != "no":
             from dlti_tpu.checkpoint import latest_step, restore_train_state
@@ -178,7 +198,9 @@ class Trainer:
         # captures the next profile_num_steps steps).
         profile_state = "pending"
         profile_stop_at = None
-        for epoch in range(start_epoch, cfg.train.num_epochs):
+        try:
+            self._train_epochs_done = False
+            for epoch in range(start_epoch, cfg.train.num_epochs):
             for batch in epoch_batches(epoch):
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
@@ -221,9 +243,25 @@ class Trainer:
                 ):
                     self._run_eval(eval_fn, state, eval_dataset, global_step)
                 self._maybe_save(state, global_step, epoch_end=False)
+                if self._stop_requested:
+                    break
             self._maybe_save(state, global_step, epoch_end=True)
             if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                 break
+            if self._stop_requested:
+                break
+        if self._stop_requested and cfg.checkpoint.save_strategy != "no":
+            from dlti_tpu.checkpoint import save_train_state
+
+            save_train_state(cfg.checkpoint.output_dir, global_step, state,
+                             keep=cfg.checkpoint.save_total_limit,
+                             async_save=False)
+            self.logger.info(
+                "preemption checkpoint written at step %d", global_step)
+        if prev_handler is not None:
+            import signal as _signal
+
+            _signal.signal(_signal.SIGTERM, prev_handler)
 
         if profile_state == "active":  # run ended inside the trace window
             jax.profiler.stop_trace()
@@ -243,6 +281,11 @@ class Trainer:
         return state, record
 
     # ------------------------------------------------------------------
+    def request_stop(self) -> None:
+        """Ask the training loop to checkpoint and exit at the next step
+        boundary (what the SIGTERM handler calls on preemption)."""
+        self._stop_requested = True
+
     def _run_eval(self, eval_fn, state, eval_dataset, step: int) -> None:
         losses, toks = [], 0.0
         for batch in eval_dataset.epoch(0):

@@ -172,3 +172,54 @@ def test_golden_loss_regression(rng):
     # Loose envelope golden: starting loss ~= log(vocab) and monotone-ish fall.
     assert abs(losses[0] - np.log(CFG.vocab_size)) < 0.5
     assert losses[-1] < losses[0]
+
+
+def test_preemption_checkpoint_and_resume(tmp_path, rng):
+    """request_stop() (the SIGTERM handler's action) checkpoints at the
+    next step boundary; a fresh Trainer resumes from that step."""
+    import threading
+
+    from dlti_tpu.checkpoint import latest_step
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        model=MODEL_PRESETS["llama_tiny"],
+        lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=1),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16),
+        train=TrainConfig(num_epochs=1, max_steps=50, micro_batch_size=2,
+                          grad_accum_steps=1, logging_steps=100,
+                          metrics_csv=str(tmp_path / "m.csv")),
+        checkpoint=CheckpointConfig(output_dir=str(tmp_path / "ckpt"),
+                                    save_strategy="steps", save_steps=1000,
+                                    save_total_limit=2, async_save=False),
+    )
+    trainer = Trainer(cfg)
+
+    batch = {
+        "input_ids": np.asarray(jax.random.randint(
+            rng, (1, 2, 16), 0, cfg.model.vocab_size)),
+        "loss_mask": np.ones((1, 2, 16), np.int32),
+    }
+
+    def batches():
+        for i in range(50):
+            if i == 3:
+                trainer.request_stop()  # deterministic "SIGTERM" mid-run
+            yield batch
+
+    state, record = trainer.train(batches_per_epoch=batches())
+    stopped_at = latest_step(cfg.checkpoint.output_dir)
+    assert stopped_at is not None and 0 < stopped_at < 50
+
+    # Fresh trainer resumes from the preemption checkpoint.
+    t2 = Trainer(cfg)
+    s2 = t2.init_state()
+    from dlti_tpu.checkpoint import restore_train_state
+
+    s2 = restore_train_state(cfg.checkpoint.output_dir, stopped_at, s2)
+    assert int(s2.step) == stopped_at
