@@ -125,8 +125,12 @@ def make_train_step(
             aux = 0.0
         loss_sum, n_tok = causal_lm_loss(logits, input_ids, loss_mask)
         # Weight the (per-microbatch mean) aux loss by tokens so the final
-        # /n_tok gives ce_mean + coef * token-weighted-mean(aux).
-        return loss_sum + moe_coef * aux * n_tok, n_tok
+        # /n_tok gives ce_mean + coef * token-weighted-mean(aux). The
+        # differentiated objective carries the aux term; reported metrics
+        # keep CE and aux separate so logged losses stay comparable with
+        # dense runs and the reference's pure-CE trajectory.
+        objective = loss_sum + moe_coef * aux * n_tok
+        return objective, (loss_sum, aux * n_tok, n_tok)
 
     def train_step(state: TrainState, batch: dict, rng: jax.Array):
         trainable, frozen = state.trainable_and_frozen()
@@ -136,35 +140,36 @@ def make_train_step(
 
         def accum_body(carry, micro_with_rng):
             # One fused fwd+bwd per microbatch via value_and_grad.
-            grads_acc, loss_acc, tok_acc = carry
+            grads_acc, loss_acc, aux_acc, tok_acc = carry
             micro, micro_rng = micro_with_rng
 
             def scaled_loss(trainable, frozen, micro, rng):
-                loss_sum, n_tok = microbatch_loss(trainable, frozen, micro, rng)
-                return loss_sum * loss_scale, (loss_sum, n_tok)
+                objective, parts = microbatch_loss(trainable, frozen, micro, rng)
+                return objective * loss_scale, parts
 
-            (_, (loss_sum, n_tok)), grads = jax.value_and_grad(
+            (_, (loss_sum, aux_sum, n_tok)), grads = jax.value_and_grad(
                 scaled_loss, argnums=0, has_aux=True
             )(trainable, frozen, micro, micro_rng)
             grads_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
             )
-            return (grads_acc, loss_acc + loss_sum, tok_acc + n_tok), None
+            return (grads_acc, loss_acc + loss_sum, aux_acc + aux_sum,
+                    tok_acc + n_tok), None
 
         zero_grads = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), trainable
         )
+        zero_carry = (zero_grads, jnp.float32(0.0), jnp.float32(0.0),
+                      jnp.float32(0.0))
         rngs = jax.random.split(rng, accum_steps)
         if accum_steps == 1:
             micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-            (grads, loss_sum, n_tok), _ = accum_body(
-                (zero_grads, jnp.float32(0.0), jnp.float32(0.0)), (micro, rngs[0])
+            (grads, loss_sum, aux_sum, n_tok), _ = accum_body(
+                zero_carry, (micro, rngs[0])
             )
         else:
-            (grads, loss_sum, n_tok), _ = jax.lax.scan(
-                accum_body,
-                (zero_grads, jnp.float32(0.0), jnp.float32(0.0)),
-                (batch, rngs),
+            (grads, loss_sum, aux_sum, n_tok), _ = jax.lax.scan(
+                accum_body, zero_carry, (batch, rngs),
             )
 
         # Mean over all tokens in the global batch (matches HF Trainer's
@@ -181,10 +186,12 @@ def make_train_step(
 
         grad_norm = optax.global_norm(grads)
         metrics = {
-            "loss": loss,
+            "loss": loss,  # pure token-mean CE (aux reported separately)
             "grad_norm": grad_norm,
             "num_tokens": n_tok,
         }
+        if moe_coef:
+            metrics["aux_loss"] = aux_sum / n_tok
 
         new_scaler = state.scaler
         if state.scaler is not None:

@@ -199,72 +199,81 @@ class Trainer:
         profile_state = "pending"
         profile_stop_at = None
         try:
-            self._train_epochs_done = False
             for epoch in range(start_epoch, cfg.train.num_epochs):
-            for batch in epoch_batches(epoch):
+                for batch in epoch_batches(epoch):
+                    if cfg.train.max_steps and global_step >= cfg.train.max_steps:
+                        break
+                    if cfg.train.profile_dir and is_main_process():
+                        if (profile_state == "pending"
+                                and global_step >= cfg.train.profile_start_step):
+                            jax.profiler.start_trace(cfg.train.profile_dir)
+                            profile_state = "active"
+                            profile_stop_at = (global_step
+                                               + cfg.train.profile_num_steps)
+                        elif (profile_state == "active"
+                              and global_step >= profile_stop_at):
+                            jax.profiler.stop_trace()
+                            profile_state = "done"
+                            self.logger.info("profiler trace -> %s",
+                                             cfg.train.profile_dir)
+                    if self.mesh is not None:
+                        from dlti_tpu.parallel.sharding import make_global_batch
+
+                        batch = make_global_batch(batch, cfg, self.mesh)
+                    rng, step_rng = jax.random.split(rng)
+                    with timer.measure():
+                        state, metrics = step_fn(state, batch, step_rng)
+                        metrics = jax.device_get(metrics)  # blocks: true step time
+                    global_step += 1
+                    samples_seen += cfg.train.micro_batch_size * cfg.train.grad_accum_steps
+                    losses.append(float(metrics["loss"]))
+
+                    if global_step % cfg.train.logging_steps == 0 and is_main_process():
+                        self.logger.info(
+                            "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s | %.0f tok/s/chip",
+                            global_step, losses[-1], float(metrics["grad_norm"]),
+                            timer.steps_per_second,
+                            timer.steps_per_second * tokens_per_step
+                            / max(jax.device_count(), 1),
+                        )
+                    if (
+                        eval_fn is not None
+                        and global_step % cfg.train.eval_steps == 0
+                    ):
+                        self._run_eval(eval_fn, state, eval_dataset, global_step)
+                    self._maybe_save(state, global_step, epoch_end=False)
+                    if self._stop_requested:
+                        break
+                self._maybe_save(state, global_step, epoch_end=True)
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
-                if cfg.train.profile_dir and is_main_process():
-                    if (profile_state == "pending"
-                            and global_step >= cfg.train.profile_start_step):
-                        jax.profiler.start_trace(cfg.train.profile_dir)
-                        profile_state = "active"
-                        profile_stop_at = (global_step
-                                           + cfg.train.profile_num_steps)
-                    elif (profile_state == "active"
-                          and global_step >= profile_stop_at):
-                        jax.profiler.stop_trace()
-                        profile_state = "done"
-                        self.logger.info("profiler trace -> %s",
-                                         cfg.train.profile_dir)
-                if self.mesh is not None:
-                    from dlti_tpu.parallel.sharding import make_global_batch
-
-                    batch = make_global_batch(batch, cfg, self.mesh)
-                rng, step_rng = jax.random.split(rng)
-                with timer.measure():
-                    state, metrics = step_fn(state, batch, step_rng)
-                    metrics = jax.device_get(metrics)  # blocks: true step time
-                global_step += 1
-                samples_seen += cfg.train.micro_batch_size * cfg.train.grad_accum_steps
-                losses.append(float(metrics["loss"]))
-
-                if global_step % cfg.train.logging_steps == 0 and is_main_process():
-                    self.logger.info(
-                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s | %.0f tok/s/chip",
-                        global_step, losses[-1], float(metrics["grad_norm"]),
-                        timer.steps_per_second,
-                        timer.steps_per_second * tokens_per_step
-                        / max(jax.device_count(), 1),
-                    )
-                if (
-                    eval_fn is not None
-                    and global_step % cfg.train.eval_steps == 0
-                ):
-                    self._run_eval(eval_fn, state, eval_dataset, global_step)
-                self._maybe_save(state, global_step, epoch_end=False)
                 if self._stop_requested:
                     break
-            self._maybe_save(state, global_step, epoch_end=True)
-            if cfg.train.max_steps and global_step >= cfg.train.max_steps:
-                break
-            if self._stop_requested:
-                break
-        if self._stop_requested and cfg.checkpoint.save_strategy != "no":
-            from dlti_tpu.checkpoint import save_train_state
+            if self._stop_requested and cfg.checkpoint.save_strategy != "no":
+                from dlti_tpu.checkpoint import (
+                    latest_step, save_train_state, wait_for_saves)
 
-            save_train_state(cfg.checkpoint.output_dir, global_step, state,
-                             keep=cfg.checkpoint.save_total_limit,
-                             async_save=False)
-            self.logger.info(
-                "preemption checkpoint written at step %d", global_step)
-        if prev_handler is not None:
-            import signal as _signal
-
-            _signal.signal(_signal.SIGTERM, prev_handler)
-
-        if profile_state == "active":  # run ended inside the trace window
-            jax.profiler.stop_trace()
+                # _maybe_save may have just written this very step (e.g. the
+                # stop landed on a save_steps boundary or at epoch end);
+                # Orbax raises StepAlreadyExistsError on a duplicate save.
+                # Settle any in-flight async save before checking.
+                wait_for_saves(cfg.checkpoint.output_dir)
+                if latest_step(cfg.checkpoint.output_dir) != global_step:
+                    save_train_state(
+                        cfg.checkpoint.output_dir, global_step, state,
+                        keep=cfg.checkpoint.save_total_limit,
+                        async_save=False)
+                    self.logger.info(
+                        "preemption checkpoint written at step %d", global_step)
+        finally:
+            if sigterm_installed:
+                # signal.signal reports a non-Python-installed previous
+                # handler as None; SIG_DFL is the closest restorable state.
+                _signal.signal(_signal.SIGTERM,
+                               prev_handler if prev_handler is not None
+                               else _signal.SIG_DFL)
+            if profile_state == "active":  # run ended inside the trace window
+                jax.profiler.stop_trace()
         if cfg.checkpoint.save_strategy != "no":
             from dlti_tpu.checkpoint import wait_for_saves
 

@@ -35,3 +35,21 @@ def test_subpackage_imports_standalone(pkg):
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"import {pkg} failed:\n{proc.stderr[-2000:]}"
+
+
+def test_everything_compiles():
+    """Whole-repo py_compile gate: a snapshot that does not parse can never
+    ship again (round 1 shipped a half-applied edit leaving trainer.py with
+    a SyntaxError at HEAD)."""
+    import compileall
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    targets = [root / "dlti_tpu", root / "scripts", root / "tests",
+               root / "bench.py", root / "__graft_entry__.py"]
+    for t in targets:
+        if t.is_dir():
+            ok = compileall.compile_dir(str(t), quiet=2)
+        else:
+            ok = compileall.compile_file(str(t), quiet=2)
+        assert ok, f"python sources under {t} failed to compile"
