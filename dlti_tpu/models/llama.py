@@ -27,6 +27,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from dlti_tpu.config import LoRAConfig, ModelConfig
 from dlti_tpu.models.lora import LoRADense
@@ -173,21 +174,18 @@ class LlamaAttention(nn.Module):
                 window=cfg.sliding_window,
             )
         elif (self.mesh is not None and "sequence" in self.mesh.shape
-              and self.mesh.shape["sequence"] > 1 and segment_ids is None):
+              and self.mesh.shape["sequence"] > 1):
             # Sequence-parallel training: exact ring attention over the
             # 'sequence' mesh axis. RoPE positions are passed through so
             # the ring's causal mask always agrees with the embedded
-            # positions; packed batches (segment_ids) are gated off above
-            # and rejected at config level (make_sharded_train_step).
+            # positions; packed batches travel their segment ids around
+            # the ring, and sliding-window models skip chunks outside
+            # the window band.
             from dlti_tpu.parallel.ring_attention import ring_attention
 
-            if cfg.sliding_window:
-                raise NotImplementedError(
-                    "sliding-window attention is not supported with "
-                    "sequence parallelism (ring attention) yet; set "
-                    "parallel.sequence=1 for sliding-window models")
             out = ring_attention(q, k, v, self.mesh, positions=positions,
-                                 causal=True)
+                                 segment_ids=segment_ids, causal=True,
+                                 window=cfg.sliding_window)
         else:
             if cfg.attention_impl in ("flash", "auto"):
                 from dlti_tpu.ops.attention import multi_head_attention
@@ -202,7 +200,13 @@ class LlamaAttention(nn.Module):
                 out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids,
                                           window=cfg.sliding_window)
 
-        out = out.reshape(b, s, cfg.num_heads * hd)
+        # Remat seam: with remat_policy="save_attn_out", the backward reuses
+        # this (b, s, h*d) tensor instead of re-running the whole attention
+        # (flash fwd is the most expensive thing under recompute) while
+        # everything else still remats — a memory/FLOPs middle ground
+        # between nothing_saveable and dots_*.
+        out = checkpoint_name(out.reshape(b, s, cfg.num_heads * hd),
+                              "attn_out")
         out = proj("o_proj", cfg.hidden_size)(out, deterministic)
         return out, new_cache
 
@@ -277,6 +281,11 @@ def _remat_policy(name: str):
         "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "dots_with_no_batch_dims_saveable":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # Save only each block's attention output (tagged in LlamaAttention):
+        # the backward skips the flash-fwd recompute at the cost of one
+        # (b, s, hidden) tensor per layer.
+        "save_attn_out":
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
     }
     return policies[name]
 

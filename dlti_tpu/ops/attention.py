@@ -123,9 +123,10 @@ def multi_head_attention(
     """Dispatching attention entry point used by the model.
 
     impl: "reference" | "flash" | "auto". "auto" picks flash on TPU for
-    tile-aligned self-attention shapes without packing, else reference.
-    Sliding ``window`` works on both paths (flash skips whole blocks
-    outside the band).
+    tile-aligned causal self-attention shapes — packed batches included
+    (segment masking runs inside the kernel) — else reference. Sliding
+    ``window`` works on both paths (flash skips whole blocks outside the
+    band).
     """
     use_flash = False
     if impl == "flash":
@@ -134,7 +135,7 @@ def multi_head_attention(
         on_tpu = jax.default_backend() == "tpu"
         sq, skv, hd = q.shape[1], k.shape[1], q.shape[3]
         aligned = sq % 128 == 0 and skv % 128 == 0 and hd % 128 == 0 and sq == skv
-        use_flash = on_tpu and aligned and causal and segment_ids is None
+        use_flash = on_tpu and aligned and causal
 
     if use_flash:
         from dlti_tpu.ops.pallas.flash_attention import flash_attention

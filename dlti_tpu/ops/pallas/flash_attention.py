@@ -3,15 +3,26 @@
 The hot op of the whole framework. Replaces the (seq, seq) score
 materialization of ``reference_attention`` with an online-softmax sweep over
 KV blocks held in VMEM — O(seq) memory, MXU-sized tiles, fp32 accumulators.
-The reference repo inherits its fused attention from HF/torch CUDA kernels;
-this is the TPU-native equivalent.
+The reference repo inherits its fused attention from HF/torch CUDA kernels
+(``/root/reference/training/train_baseline.py:122-126`` loads the stock HF
+Llama); this is the TPU-native equivalent.
 
-Layout: kernels operate on (batch*heads, seq, head_dim) with grids of
-(bh, q_blocks, kv_blocks) (fwd, dq) or (bh, kv_blocks, q_blocks) (dk/dv).
-TPU grids execute sequentially minor-most-first, so per-block running state
-lives in VMEM scratch across the innermost sweep. Causal blocks outside the
-(windowed) band are skipped via ``pl.when`` (no wasted MXU work), and the
-band edges get elementwise iota masks.
+Layout: the grid is (batch * kv_heads, q_blocks, kv_blocks) (fwd, dq) or
+(batch * kv_heads, kv_blocks, q_blocks) (dk/dv). **GQA is native**: each
+grid row processes all ``group = heads // kv_heads`` query heads of one kv
+head together — q tiles are (group, block_q, d) against a single
+(block_kv, d) K/V tile, so K/V are never repeated in HBM and the score
+matmul keeps its MXU shape. TPU grids execute sequentially
+minor-most-first, so per-block running state lives in VMEM scratch across
+the innermost sweep.
+
+**Packed sequences are native**: optional per-token segment ids mask
+cross-document attention inside the kernel (id 0 = padding, matching
+``reference_attention``), and whole (q, kv) tiles whose segment-id
+intervals are disjoint are skipped before any MXU work — packed
+long-context batches degrade toward block-diagonal cost instead of
+O(seq²). Causal blocks outside the (windowed) band are likewise skipped
+via ``pl.when``, and the band edges get elementwise iota masks.
 
 Backward is the standard flash decomposition: the forward also emits the
 per-row logsumexp L; the backward recomputes p = exp(qk*scale - L) per tile
@@ -26,120 +37,31 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scratch, l_scratch, acc_scratch,
-                *, scale: float, block_q: int, block_kv: int, causal: bool,
-                window: int, seq_q: int, seq_kv: int):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
-        l_scratch[:] = jnp.zeros_like(l_scratch)
-        acc_scratch[:] = jnp.zeros_like(acc_scratch)
-
-    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
-    def _body():
-        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)  # (block_kv, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (block_q, block_kv)
-
-        allowed = _band_mask(qi, ki, block_q, block_kv, s.shape, causal,
-                             window, seq_q, seq_kv)
-        if allowed is not None:
-            s = jnp.where(allowed, s, NEG_INF)
-
-        m_prev = m_scratch[:]  # (block_q, 1)
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # Rows with no causally-valid entry in this block have m_new ==
-        # NEG_INF, making exp(s - m_new) == 1 for every *masked* entry —
-        # explicitly zero them (hit when block_kv > block_q admits blocks
-        # strictly above a row's diagonal).
-        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)  # (block_q, block_kv)
-        alpha = jnp.exp(m_prev - m_new)  # (block_q, 1)
-        l_new = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_scratch[:] = m_new
-        l_scratch[:] = l_new
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        l = l_scratch[:]
-        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
-        o_ref[0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
-        # Per-row logsumexp for the backward. Fully-masked rows get +BIG so
-        # the backward's exp(s - L) is exactly 0 there.
-        lse = jnp.where(l > 0.0, m_scratch[:] + jnp.log(safe_l), -NEG_INF)
-        lse_ref[0] = lse
-
-
-def _flash_fwd(q, k, v, *, scale, block_q, block_kv, causal, window, interpret):
-    """q,k,v: (bh, seq, d) -> o: (bh, seq, d)."""
-    bh, sq, d = q.shape
-    skv = k.shape[1]
-    block_q = min(block_q, sq)
-    block_kv = min(block_kv, skv)
-    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
-
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
-        causal=causal, window=window, seq_q=sq, seq_kv=skv,
-    )
-    return pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),  # logsumexp
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        interpret=interpret,
-        cost_estimate=pl.CostEstimate(
-            flops=int(2 * 2 * bh * sq * skv * d * (0.5 if causal else 1.0)),
-            bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,
-            transcendentals=bh * sq * skv,
-        ),
-    )(q, k, v)
-
-
-def _band_mask(qi, ki, block_q, block_kv, shape, causal, window,
-               seq_q, seq_kv):
+def _band_mask(qi, ki, block_q, block_kv, group, causal, window, seq_q,
+               seq_kv):
     """Elementwise allowed-mask for the (qi, ki) tile.
 
-    Combines the causal/sliding-window band with sequence bounds: Pallas
-    does NOT zero tile padding on TPU, so rows >= seq_q / cols >= seq_kv
-    hold garbage and must be masked in every kernel that *accumulates*
-    across tiles (the whole backward; the non-causal forward). Returns
-    None only when provably nothing needs masking.
+    Shape (group*block_q, block_kv): the kernels flatten the GQA query
+    group into the row dim (Mosaic's matmul lowering wants 2D operands),
+    so row r is query position ``qi*block_q + r % block_q``. Combines the
+    causal/sliding-window band with sequence bounds: Pallas does NOT zero
+    tile padding on TPU, so rows >= seq_q / cols >= seq_kv hold garbage
+    and must be masked in every kernel that *accumulates* across tiles
+    (the whole backward; the non-causal forward). Returns None only when
+    provably nothing needs masking.
     """
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    shape = (group * block_q, block_kv)
+    row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    if group > 1:
+        row = jax.lax.rem(row, block_q)
+    q_pos = qi * block_q + row
     k_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     padded = seq_q % block_q != 0 or seq_kv % block_kv != 0
     if not causal and not padded:
@@ -155,6 +77,23 @@ def _band_mask(qi, ki, block_q, block_kv, shape, causal, window,
     return allowed
 
 
+def _tile_mask(qi, ki, block_q, block_kv, group, causal, window, seq_q,
+               seq_kv, qseg_ref, kseg_ref):
+    """Full allowed-mask: causal band ∧ bounds ∧ same-segment (id 0 = pad).
+    Shape (group*block_q, block_kv) (see :func:`_band_mask`)."""
+    allowed = _band_mask(qi, ki, block_q, block_kv, group, causal, window,
+                         seq_q, seq_kv)
+    if qseg_ref is not None:
+        q_ids = qseg_ref[0]    # (block_q, 1)
+        if group > 1:
+            q_ids = jnp.broadcast_to(
+                q_ids[None], (group, block_q, 1)).reshape(group * block_q, 1)
+        kv_ids = kseg_ref[0]   # (1, block_kv)
+        seg = (q_ids == kv_ids) & (kv_ids != 0)
+        allowed = seg if allowed is None else (allowed & seg)
+    return allowed
+
+
 def _band_run(qi, ki, block_q, block_kv, causal, window):
     """Whole-tile skip predicate (conservative w.r.t. :func:`_band_mask`)."""
     if not causal:
@@ -166,22 +105,182 @@ def _band_run(qi, ki, block_q, block_kv, causal, window):
     return run
 
 
+def _seg_run(qseg_ref, kseg_ref):
+    """Dynamic whole-tile skip: if the q and kv tiles' segment-id intervals
+    are disjoint, no pair can be equal and the tile contributes nothing.
+    Garbage ids in tile padding only *widen* the intervals, so the skip
+    stays conservative (a widened interval can only overlap more)."""
+    q_ids = qseg_ref[0]
+    kv_ids = kseg_ref[0]
+    return jnp.logical_and(jnp.min(q_ids) <= jnp.max(kv_ids),
+                           jnp.max(q_ids) >= jnp.min(kv_ids))
+
+
+def _fwd_kernel(*refs, scale: float, block_q: int, block_kv: int,
+                group: int, causal: bool, window: int, seq_q: int,
+                seq_kv: int, has_segs: bool):
+    if has_segs:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scratch, l_scratch, acc_scratch) = refs
+        qseg_ref = kseg_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    gbq = group * block_q
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if has_segs:
+        run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
+
+    @pl.when(run)
+    def _body():
+        # (group, block_q, d) -> (group*block_q, d): Mosaic's matmul wants
+        # 2D operands, and the flattened form is one big MXU matmul.
+        q = q_ref[0].astype(jnp.float32).reshape(gbq, -1)
+        k = k_ref[0].astype(jnp.float32)  # (block_kv, d)
+        v = v_ref[0].astype(jnp.float32)
+        if seq_kv % block_kv != 0:
+            # Zero OOB tile padding: Pallas leaves it garbage (NaN in
+            # interpret mode) and the p @ v contraction sums over it —
+            # 0 * NaN = NaN even though p is masked there.
+            cols = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_kv, 1), 0)
+            k = jnp.where(cols < seq_kv, k, 0.0)
+            v = jnp.where(cols < seq_kv, v, 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (group*block_q, block_kv)
+
+        allowed = _tile_mask(qi, ki, block_q, block_kv, group, causal,
+                             window, seq_q, seq_kv, qseg_ref, kseg_ref)
+        if allowed is not None:
+            s = jnp.where(allowed, s, NEG_INF)
+
+        m_prev = m_scratch[:]  # (group*block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Rows with no valid entry in this block have m_new == NEG_INF,
+        # making exp(s - m_new) == 1 for every *masked* entry — explicitly
+        # zero them (hit when block_kv > block_q admits blocks strictly
+        # above a row's diagonal, or a fully-masked segment row).
+        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
+        alpha = jnp.exp(m_prev - m_new)  # (group*block_q, 1)
+        l_new = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scratch[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+        o_ref[0] = (acc_scratch[:] / safe_l).reshape(
+            group, block_q, -1).astype(o_ref.dtype)
+        # Per-row logsumexp for the backward. Fully-masked rows get +BIG so
+        # the backward's exp(s - L) is exactly 0 there.
+        lse = jnp.where(l > 0.0, m_scratch[:] + jnp.log(safe_l), -NEG_INF)
+        lse_ref[0] = lse.reshape(group, block_q, 1)
+
+
+def _seg_specs(h_kv, block_q, block_kv, transposed=False):
+    """BlockSpecs for (b, sq, 1) q-segment and (b, 1, skv) kv-segment arrays.
+
+    The (block_q, 1) / (1, block_kv) tile shapes let the kernel form the
+    (block_q, block_kv) equality mask by broadcast — no lane<->sublane
+    transposes on TPU. The grid's leading axis is batch*kv_heads; ``// h_kv``
+    recovers the batch row.
+    """
+    if transposed:  # dkv grid: (bh, kv_block, q_block)
+        q_map = lambda b, j, i: (b // h_kv, i, 0)
+        kv_map = lambda b, j, i: (b // h_kv, 0, j)
+    else:
+        q_map = lambda b, i, j: (b // h_kv, i, 0)
+        kv_map = lambda b, i, j: (b // h_kv, 0, j)
+    return (pl.BlockSpec((1, block_q, 1), q_map),
+            pl.BlockSpec((1, 1, block_kv), kv_map))
+
+
+def _flash_fwd(q, k, v, q_seg, kv_seg, *, h_kv, scale, block_q, block_kv,
+               causal, window, interpret):
+    """q: (b*h_kv, group, sq, d); k/v: (b*h_kv, skv, d);
+    q_seg: (b, sq, 1) / kv_seg: (b, 1, skv) or None -> (o, lse)."""
+    bh, group, sq, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+        group=group, causal=causal, window=window, seq_q=sq, seq_kv=skv,
+        has_segs=q_seg is not None,
+    )
+    q_spec = pl.BlockSpec((1, group, block_q, d), lambda b, i, j: (b, 0, i, 0))
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [q, k, v]
+    if q_seg is not None:
+        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv)
+        in_specs += [qs_spec, ks_spec]
+        inputs += [q_seg, kv_seg]
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, group, sq, 1), jnp.float32),  # logsumexp
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(
+            q_spec,
+            pl.BlockSpec((1, group, block_q, 1), lambda b, i, j: (b, 0, i, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group * block_q, 1), jnp.float32),
+            pltpu.VMEM((group * block_q, 1), jnp.float32),
+            pltpu.VMEM((group * block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=int(2 * 2 * bh * group * sq * skv * d
+                      * (0.5 if causal else 1.0)),
+            bytes_accessed=(2 * q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * group * sq * skv,
+        ),
+    )(*inputs)
+
+
 def _load_bwd_tiles(q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
-                    seq_q, seq_kv):
-    """Load backward tiles with padding rows/cols zeroed.
+                    group, seq_q, seq_kv):
+    """Load backward tiles (q/do flattened to (group*block_q, d)) with
+    padding rows/cols zeroed.
 
     Pallas does not zero tile padding on TPU; the backward *accumulates*
     across tiles, so garbage (potentially inf/NaN, which survives
     multiplication by zero) in rows >= seq_q / cols >= seq_kv must be
     cleared at load time.
     """
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    gbq = group * block_q
+    q = q_ref[0].astype(jnp.float32).reshape(gbq, -1)
+    k = k_ref[0].astype(jnp.float32)    # (block_kv, d)
     v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32).reshape(gbq, -1)
     if seq_q % block_q != 0:
-        rows = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, 1), 0)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (gbq, 1), 0)
+        if group > 1:
+            rows = jax.lax.rem(rows, block_q)
+        rows = qi * block_q + rows
         q = jnp.where(rows < seq_q, q, 0.0)
         do = jnp.where(rows < seq_q, do, 0.0)
     if seq_kv % block_kv != 0:
@@ -192,35 +291,48 @@ def _load_bwd_tiles(q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
     return q, k, v, do
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scratch, *, scale, block_q, block_kv, causal, window,
-               seq_q, seq_kv):
+def _dq_kernel(*refs, scale, block_q, block_kv, group, causal, window,
+               seq_q, seq_kv, has_segs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+         dq_ref, dq_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scratch) = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    gbq = group * block_q
 
     @pl.when(ki == 0)
     def _init():
         dq_scratch[:] = jnp.zeros_like(dq_scratch)
 
-    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if has_segs:
+        run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
+
+    @pl.when(run)
     def _body():
         q, k, v, do = _load_bwd_tiles(
-            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
+            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv, group,
             seq_q, seq_kv)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        mask = _band_mask(qi, ki, block_q, block_kv, s.shape, causal, window,
-                          seq_q, seq_kv)
-        p = jnp.exp(s - lse_ref[0])                        # (bq, bk)
+        ) * scale  # (group*bq, bk)
+        mask = _tile_mask(qi, ki, block_q, block_kv, group, causal, window,
+                          seq_q, seq_kv, qseg_ref, kseg_ref)
+        lse = lse_ref[0].reshape(gbq, 1)
+        delta = delta_ref[0].reshape(gbq, 1)
+        p = jnp.exp(s - lse)                               # (group*bq, bk)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         # where() (not just p==0) so garbage lse/delta in padding rows can't
         # poison the product with 0 * inf = NaN.
-        ds = p * (dp - delta_ref[0]) * scale               # (bq, bk)
+        ds = p * (dp - delta) * scale                      # (group*bq, bk)
         if mask is not None:
             ds = jnp.where(mask, ds, 0.0)
         dq_scratch[:] += jax.lax.dot_general(
@@ -228,43 +340,61 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+        dq_ref[0] = dq_scratch[:].reshape(
+            group, block_q, -1).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scratch, dv_scratch,
-                *, scale, block_q, block_kv, causal, window, seq_q, seq_kv):
+def _dkv_kernel(*refs, scale, block_q, block_kv, group, causal, window,
+                seq_q, seq_kv, has_segs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+         dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
+    gbq = group * block_q
 
     @pl.when(qi == 0)
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    @pl.when(_band_run(qi, ki, block_q, block_kv, causal, window))
+    run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if has_segs:
+        run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
+
+    @pl.when(run)
     def _body():
         q, k, v, do = _load_bwd_tiles(
-            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
+            q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv, group,
             seq_q, seq_kv)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        mask = _band_mask(qi, ki, block_q, block_kv, s.shape, causal, window,
-                          seq_q, seq_kv)
-        p = jnp.exp(s - lse_ref[0])                        # (bq, bk)
+        ) * scale  # (group*bq, bk)
+        mask = _tile_mask(qi, ki, block_q, block_kv, group, causal, window,
+                          seq_q, seq_kv, qseg_ref, kseg_ref)
+        lse = lse_ref[0].reshape(gbq, 1)
+        delta = delta_ref[0].reshape(gbq, 1)
+        p = jnp.exp(s - lse)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
+        # Contract over all group*bq rows: one (bkv, group*bq) @
+        # (group*bq, d) MXU matmul per tile sums the group contributions.
         dv_scratch[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = p * (dp - delta) * scale
         if mask is not None:
             ds = jnp.where(mask, ds, 0.0)
         dk_scratch[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -272,94 +402,145 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, *, scale, block_q, block_kv, causal,
-               window, interpret):
-    """q,k,v,o,do: (bh, s, d); lse: (bh, s, 1) -> (dq, dk, dv)."""
-    bh, sq, d = q.shape
+def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, h_kv, scale, block_q,
+               block_kv, causal, window, interpret):
+    """q,o,do: (b*h_kv, group, s, d); k,v: (b*h_kv, s, d);
+    lse: (b*h_kv, group, s, 1) -> (dq, dk, dv)."""
+    bh, group, sq, d = q.shape
     skv = k.shape[1]
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(skv, block_kv)
+    has_segs = q_seg is not None
 
     # D_i = rowsum(dO_i * O_i) — tiny elementwise pass, XLA-fused.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    q_spec = pl.BlockSpec((1, group, block_q, d), lambda b, i, j: (b, 0, i, 0))
     kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, group, block_q, 1), lambda b, i, j: (b, 0, i, 0))
 
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+    inputs = [q, k, v, do, lse, delta]
+    if has_segs:
+        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv)
+        in_specs += [qs_spec, ks_spec]
+        inputs += [q_seg, kv_seg]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, causal=causal, window=window,
-                          seq_q=sq, seq_kv=skv),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                          block_kv=block_kv, group=group, causal=causal,
+                          window=window, seq_q=sq, seq_kv=skv,
+                          has_segs=has_segs),
+        out_shape=jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
         grid=(bh, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((group * block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
 
     # dk/dv sweep: grid transposed so kv blocks are outer, q inner.
-    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    q_spec_t = pl.BlockSpec((1, group, block_q, d), lambda b, j, i: (b, 0, i, 0))
     kv_spec_t = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    row_spec_t = pl.BlockSpec((1, group, block_q, 1), lambda b, j, i: (b, 0, i, 0))
+    in_specs_t = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t]
+    inputs_t = [q, k, v, do, lse, delta]
+    if has_segs:
+        qs_spec_t, ks_spec_t = _seg_specs(h_kv, block_q, block_kv,
+                                          transposed=True)
+        in_specs_t += [qs_spec_t, ks_spec_t]
+        inputs_t += [q_seg, kv_seg]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
-                          block_kv=block_kv, causal=causal, window=window,
-                          seq_q=sq, seq_kv=skv),
+                          block_kv=block_kv, group=group, causal=causal,
+                          window=window, seq_q=sq, seq_kv=skv,
+                          has_segs=has_segs),
         out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
         grid=(bh, nk, nq),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
-                  row_spec_t],
+        in_specs=in_specs_t,
         out_specs=(kv_spec_t, kv_spec_t),
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*inputs_t)
     return dq, dk, dv
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
-def _flash_attention_core(q, k, v, causal, block_q, block_kv, window, interpret):
-    """(b, s, h, d) attention with GQA via head repetition at the caller."""
-    return _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret)[0]
+def _flash_attention_core(q, k, v, segment_ids, causal, block_q, block_kv,
+                          window, interpret):
+    """(b, s, h, d) attention; GQA and packing handled inside the kernels."""
+    return _core_fwd(q, k, v, segment_ids, causal, block_q, block_kv,
+                     window, interpret)[0]
 
 
-def _core_fwd(q, k, v, causal, block_q, block_kv, window, interpret):
+def _split_heads(q, k, v):
+    """(b, s, h, d) q -> (b*h_kv, group, s, d); k/v -> (b*h_kv, s, d).
+
+    Query head ``kh * group + g`` reads kv head ``kh`` — the same layout
+    ``repeat_kv`` produces, so results are bit-comparable with the
+    reference path.
+    """
+    b, sq, h, d = q.shape
+    h_kv = k.shape[2]
+    group = h // h_kv
+    qt = (q.transpose(0, 2, 1, 3)
+          .reshape(b * h_kv, group, sq, d))
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h_kv, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h_kv, v.shape[1], d)
+    return qt, kt, vt, h_kv, group
+
+
+def _core_fwd(q, k, v, segment_ids, causal, block_q, block_kv, window,
+              interpret):
     b, sq, h, d = q.shape
     scale = d ** -0.5
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
-    o, lse = _flash_fwd(qt, kt, vt, scale=scale, block_q=block_q,
-                        block_kv=block_kv, causal=causal, window=window,
-                        interpret=interpret)
-    out = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return out, (qt, kt, vt, o, lse)
+    qt, kt, vt, h_kv, group = _split_heads(q, k, v)
+    if segment_ids is not None:
+        if k.shape[1] != sq:
+            raise ValueError(
+                f"flash_attention segment masking requires self-attention "
+                f"shapes (one segment_ids array for both sides); got "
+                f"sq={sq}, skv={k.shape[1]}")
+        seg = segment_ids.astype(jnp.int32)
+        q_seg = seg[:, :, None]   # (b, sq, 1): block tile (block_q, 1)
+        kv_seg = seg[:, None, :]  # (b, 1, skv): block tile (1, block_kv)
+    else:
+        q_seg = kv_seg = None
+    o, lse = _flash_fwd(qt, kt, vt, q_seg, kv_seg, h_kv=h_kv, scale=scale,
+                        block_q=block_q, block_kv=block_kv, causal=causal,
+                        window=window, interpret=interpret)
+    out = (o.reshape(b, h, sq, d).transpose(0, 2, 1, 3))
+    return out, (qt, kt, vt, o, lse, q_seg, kv_seg)
 
 
 def _core_bwd(causal, block_q, block_kv, window, interpret, res, g):
     """Flash backward: tile-recomputed p from the saved logsumexp."""
-    qt, kt, vt, o, lse = res
-    bh, sq, d = qt.shape
-    scale = d ** -0.5
-    do = g.transpose(0, 2, 1, 3).reshape(bh, sq, d)
-    dq, dk, dv = _flash_bwd(
-        qt, kt, vt, o, lse, do, scale=scale, block_q=block_q,
-        block_kv=block_kv, causal=causal, window=window, interpret=interpret)
+    qt, kt, vt, o, lse, q_seg, kv_seg = res
+    bh, group, sq, d = qt.shape
     b = g.shape[0]
     h = g.shape[2]
+    h_kv = bh // b
+    scale = d ** -0.5
+    do = g.transpose(0, 2, 1, 3).reshape(bh, group, sq, d)
+    dq, dk, dv = _flash_bwd(
+        qt, kt, vt, o, lse, do, q_seg, kv_seg, h_kv=h_kv, scale=scale,
+        block_q=block_q, block_kv=block_kv, causal=causal, window=window,
+        interpret=interpret)
 
-    def unflat(x, s):
-        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-
-    return unflat(dq, sq), unflat(dk, kt.shape[1]), unflat(dv, vt.shape[1])
+    dq_out = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    skv = kt.shape[1]
+    dk_out = dk.reshape(b, h_kv, skv, d).transpose(0, 2, 1, 3)
+    dv_out = dv.reshape(b, h_kv, skv, d).transpose(0, 2, 1, 3)
+    dseg = (None if q_seg is None
+            else np.zeros(g.shape[:1] + (sq,), jax.dtypes.float0))
+    return dq_out, dk_out, dv_out, dseg
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
@@ -379,21 +560,12 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Flash attention entry. q: (b, sq, h, d); k/v: (b, skv, h_kv, d).
 
-    GQA is handled by repeating kv heads (the MXU cost is in the matmuls,
-    which are unchanged). ``window`` enables Mistral-style sliding-window
-    attention with whole-block skipping outside the band. Segment masking
-    falls back to the reference implementation for now.
+    GQA runs natively in the kernel (each kv head's query group shares its
+    K/V tile — nothing is repeated in HBM). ``window`` enables
+    Mistral-style sliding-window attention with whole-block skipping
+    outside the band. ``segment_ids`` (b, s) enables packed-sequence
+    masking with whole-block skipping of segment-disjoint tiles; id 0 is
+    padding (such tokens attend to nothing and produce zero output).
     """
-    if segment_ids is not None:
-        from dlti_tpu.ops.attention import reference_attention
-
-        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                                   window=window)
-
-    h, h_kv = q.shape[2], k.shape[2]
-    if h != h_kv:
-        rep = h // h_kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    return _flash_attention_core(q, k, v, causal, block_q, block_kv,
-                                 window or 0, interpret)
+    return _flash_attention_core(q, k, v, segment_ids, causal, block_q,
+                                 block_kv, window or 0, interpret)

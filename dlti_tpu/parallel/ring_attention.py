@@ -50,12 +50,15 @@ from dlti_tpu.ops.attention import repeat_kv
 NEG_INF = -1e30
 
 
-def _block_accumulate(carry, q, k, v, q_pos, kv_pos, scale, causal):
+def _block_accumulate(carry, q, k, v, q_pos, kv_pos, q_seg, kv_seg, scale,
+                      causal, window):
     """Fold one K/V chunk into the online-softmax state.
 
     carry: (m, l, acc) with m,l (b, h, sq) fp32 and acc (b, sq, h, d) fp32.
     q: (b, sq, h, d); k/v: (b, sk, hk, d); q_pos/kv_pos: (b, sq)/(b, sk)
-    global token positions driving the causal mask.
+    global token positions driving the causal (and sliding-window) mask;
+    q_seg/kv_seg: optional (b, sq)/(b, sk) segment ids for packed batches
+    (id 0 = padding, matching ``reference_attention``).
     """
     m, l, acc = carry
     kr = repeat_kv(k, q.shape[2] // k.shape[2])
@@ -64,14 +67,25 @@ def _block_accumulate(carry, q, k, v, q_pos, kv_pos, scale, causal):
     # (b, h, sq, sk) scores, fp32 accumulation on the MXU.
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32)
     s = s.astype(jnp.float32) * scale
+    allowed = None
     if causal:
         # (b, 1, sq, sk): kv token visible iff its position <= the query's.
         allowed = kv_pos[:, None, None, :] <= q_pos[:, None, :, None]
+        if window:
+            allowed &= kv_pos[:, None, None, :] > (q_pos[:, None, :, None]
+                                                   - window)
+    if q_seg is not None:
+        same = ((q_seg[:, None, :, None] == kv_seg[:, None, None, :])
+                & (kv_seg[:, None, None, :] != 0))
+        allowed = same if allowed is None else (allowed & same)
+    if allowed is not None:
         s = jnp.where(allowed, s, NEG_INF)
 
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     p = jnp.exp(s - m_new[..., None])
-    if causal:
+    if allowed is not None:
+        # Fully-masked rows have m_new == NEG_INF, making exp(s - m_new)
+        # == 1 at every masked entry — zero them explicitly.
         p = jnp.where(allowed, p, 0.0)
     alpha = jnp.exp(m - m_new)  # (b, h, sq)
 
@@ -87,15 +101,18 @@ def ring_attention_local(
     k: jnp.ndarray,
     v: jnp.ndarray,
     q_pos: jnp.ndarray,
+    q_seg: Optional[jnp.ndarray] = None,
     *,
     axis_name: str,
     axis_size: int,
     causal: bool = True,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Per-shard ring attention body. Must run under ``shard_map`` with
     ``axis_name`` bound; each call sees the local (b, s_local, h|hk, d)
     chunks of globally (b, s, h|hk, d) arrays sharded on dim 1, and the
-    matching local slice of token positions ``q_pos`` (b, s_local).
+    matching local slices of token positions ``q_pos`` (b, s_local) and
+    (for packed batches) segment ids ``q_seg`` (b, s_local).
     """
     b, sq, h, d = q.shape
     scale = d ** -0.5
@@ -106,30 +123,52 @@ def ring_attention_local(
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     kv_pos = q_pos
+    kv_seg = q_seg
     carry = (m, l, acc)
     for t in range(axis_size):
+        # Runtime whole-chunk skips (the ring analog of flash's block
+        # skipping). All are conservative: a skipped chunk provably
+        # contributes nothing to any row.
+        skip = None
         if causal:
-            # Chunk entirely in the future for every row -> skip its
-            # matmuls at runtime. With default contiguous positions this
-            # reduces to the classic "source shard index > mine" skip.
+            # Chunk entirely in the future for every row. With default
+            # contiguous positions this reduces to the classic "source
+            # shard index > mine" skip (~half the ring FLOPs).
             skip = jnp.min(kv_pos) > jnp.max(q_pos)
+            if window:
+                # Chunk entirely behind every row's sliding window.
+                skip |= jnp.max(kv_pos) <= jnp.min(q_pos) - window
+        if q_seg is not None:
+            # Segment-id intervals disjoint -> no equal pair can exist.
+            seg_disjoint = jnp.logical_or(
+                jnp.min(q_seg) > jnp.max(kv_seg),
+                jnp.max(q_seg) < jnp.min(kv_seg))
+            skip = seg_disjoint if skip is None else (skip | seg_disjoint)
+
+        if skip is not None:
             carry = jax.lax.cond(
                 skip,
                 lambda op: op[0],
                 lambda op: _block_accumulate(op[0], q, op[1], op[2],
-                                             q_pos, op[3], scale, True),
-                (carry, k, v, kv_pos),
+                                             q_pos, op[3], q_seg, op[4],
+                                             scale, causal, window),
+                (carry, k, v, kv_pos,
+                 kv_seg if kv_seg is not None else kv_pos),
             )
         else:
-            carry = _block_accumulate(carry, q, k, v, q_pos, kv_pos, scale,
-                                      False)
+            carry = _block_accumulate(carry, q, k, v, q_pos, kv_pos, q_seg,
+                                      kv_seg, scale, causal, window)
 
         if t != axis_size - 1:
             k = jax.lax.ppermute(k, axis_name, perm)
             v = jax.lax.ppermute(v, axis_name, perm)
             kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
+            if kv_seg is not None:
+                kv_seg = jax.lax.ppermute(kv_seg, axis_name, perm)
 
     _, l, acc = carry
+    # Fully-masked rows (padding tokens in packed batches) have l == 0 and
+    # acc == 0: the max() guard makes their output exactly zero.
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
@@ -141,7 +180,9 @@ def ring_attention(
     mesh: Mesh,
     *,
     positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
     causal: bool = True,
+    window: Optional[int] = None,
     seq_axis: str = "sequence",
     batch_axes: tuple = ("data", "fsdp"),
     head_axis: str = "tensor",
@@ -152,17 +193,24 @@ def ring_attention(
     shard_maps them as P(batch_axes, seq_axis, head_axis?, None).
     ``positions`` (b, s) are the token positions RoPE was applied at; the
     causal mask is computed from them so the two can never disagree
-    (default: contiguous 0..s-1). The head dim is sharded over
-    ``head_axis`` (TP) only when both h and hk divide; otherwise heads
-    stay replicated and GSPMD reconciles with the surrounding layout.
+    (default: contiguous 0..s-1). ``segment_ids`` (b, s) enables packed
+    batches (tokens attend within their own segment; id 0 = padding,
+    producing zero output rows); the ids travel the ring with K/V and
+    segment-disjoint chunks skip their matmuls. ``window`` is
+    Mistral-style sliding-window locality (requires ``causal``); chunks
+    entirely behind every query's window are skipped, so a long ring
+    does O(window) work per query, not O(seq). The head dim is sharded
+    over ``head_axis`` (TP) only when both h and hk divide; otherwise
+    heads stay replicated and GSPMD reconciles with the surrounding
+    layout.
     """
     n = mesh.shape[seq_axis]
     if n == 1:
         from dlti_tpu.ops.attention import reference_attention
 
         return reference_attention(
-            q, k, v, causal=causal,
-            q_positions=positions, kv_positions=positions,
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            q_positions=positions, kv_positions=positions, window=window,
         )
     b, s = q.shape[0], q.shape[1]
     if s % n != 0:
@@ -183,10 +231,19 @@ def ring_attention(
     pos_spec = P(batch_axes, seq_axis)
 
     body = functools.partial(
-        ring_attention_local, axis_name=seq_axis, axis_size=n, causal=causal
+        ring_attention_local, axis_name=seq_axis, axis_size=n, causal=causal,
+        window=int(window or 0),
     )
+    if segment_ids is None:
+        f = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec, pos_spec),
+            out_specs=spec, check_vma=False,
+        )
+        return f(q, k, v, positions)
+    segment_ids = jnp.broadcast_to(segment_ids.astype(jnp.int32), (b, s))
     f = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec, pos_spec),
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, pos_spec, pos_spec),
         out_specs=spec, check_vma=False,
     )
-    return f(q, k, v, positions)
+    return f(q, k, v, positions, segment_ids)

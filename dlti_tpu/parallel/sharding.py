@@ -29,6 +29,7 @@ import re
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlti_tpu.config import Config, ZeROStage
@@ -95,6 +96,19 @@ def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     if len(shape) == 0:
         return P()
     path_s = _path_str(path)
+    # Weight-only int8 trees (serving) wrap each quantized kernel as
+    # {"q": int8, "scale": fp32} — rules match on the kernel's own path.
+    # "q" keeps the kernel's rank and sharding; "scale" has size 1 on the
+    # contraction dim, so the divisibility checks below automatically
+    # replicate it for row-parallel kernels and shard it with the output
+    # channels for column-parallel ones. Gated on the quant-node layout so
+    # ordinary leaves that happen to be *named* scale (RMSNorm's param) are
+    # never aliased to their parent path.
+    if path_s.endswith("/q") and value.dtype == jnp.int8:
+        path_s = path_s[:-2]
+    elif path_s.endswith("/scale") and path_s.rsplit("/", 2)[-2] in (
+            "kernel", "embed_tokens", "lm_head", "w1", "w2", "w3"):
+        path_s = path_s.rsplit("/", 1)[0]
     spec: list = [None] * len(shape)
 
     ep_d = None
@@ -273,14 +287,6 @@ def make_sharded_train_step(
             "dlti_tpu.parallel.pipeline.make_pipeline_train_step (the GPipe "
             "schedule) — running this step on a pipe mesh would silently "
             "replicate all work across the pipe axis"
-        )
-    if cfg.parallel.sequence > 1 and cfg.data.pack_sequences:
-        raise ValueError(
-            "sequence parallelism (parallel.sequence > 1) does not compose "
-            "with pack_sequences: packed batches carry segment_ids, which "
-            "bypass the ring-attention path and force GSPMD to all-gather "
-            "the length-sharded activations every layer. Disable packing "
-            "or set parallel.sequence=1."
         )
     dp = mesh.shape["data"] * mesh.shape["fsdp"]
     if cfg.train.micro_batch_size % dp != 0:

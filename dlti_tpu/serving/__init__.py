@@ -23,6 +23,7 @@ from dlti_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
     Request,
 )
+from dlti_tpu.serving.replicas import ReplicatedEngine  # noqa: F401
 from dlti_tpu.serving.server import (  # noqa: F401
     ServerConfig,
     make_server,

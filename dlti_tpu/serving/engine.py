@@ -114,6 +114,8 @@ class Request:
     finish_time: Optional[float] = None
     finish_reason: Optional[str] = None
     num_preemptions: int = 0
+    # Which replica owns this request (set by ReplicatedEngine.submit).
+    replica: int = 0
 
     @property
     def done(self) -> bool:
@@ -198,11 +200,10 @@ class InferenceEngine:
         if engine_cfg.quantization not in ("none", "int8"):
             raise ValueError(f"unknown quantization {engine_cfg.quantization!r}")
         if self._quantized:
-            if mesh is not None:
-                raise NotImplementedError(
-                    "int8 weights + tensor-parallel serving are not "
-                    "composable yet (TP sharding rules match unquantized "
-                    "param paths)")
+            # Composes with TP: the sharding rules match quantized
+            # {"q","scale"} leaves on the kernel's own path (int8 kernels
+            # shard like their fp ancestors; scales follow the output
+            # channels and replicate for row-parallel kernels).
             from dlti_tpu.models.quantization import quantize_params_int8
 
             params = quantize_params_int8(params)

@@ -1,0 +1,125 @@
+"""Data-parallel serving: independent engine replicas over device groups.
+
+The reference claims vLLM serving with tensor parallelism
+(``/root/reference/README.md:10``); vLLM scales *throughput* beyond one
+TP group by running multiple engine replicas behind a dispatcher. This is
+the TPU-native equivalent: the visible devices are partitioned into
+``replicas`` groups of ``tensor`` chips, each group gets a fully
+independent :class:`InferenceEngine` (its own sharded weights, KV pool,
+scheduler, prefix cache), and requests are dispatched least-loaded.
+
+Replication is deliberately *above* the engine rather than a mesh axis
+inside it: batch rows of one jitted program sharded over a ``data`` axis
+would lock every replica to the same program counter (one global decode
+step), while independent engines prefill, decode and preempt on their own
+schedules — the same reason vLLM runs one engine per data-parallel rank.
+Within a replica, jit dispatch is async, so driving the replicas
+round-robin from one host thread overlaps their device work.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from dlti_tpu.config import LoRAConfig, ModelConfig, ParallelConfig
+from dlti_tpu.serving.engine import (
+    EngineConfig, GenerationResult, InferenceEngine, Request, SamplingParams,
+)
+
+
+class ReplicatedEngine:
+    """N independent engine replicas (each optionally TP-sharded) behind a
+    least-loaded dispatcher. API mirrors :class:`InferenceEngine`:
+    ``submit`` / ``step`` / ``generate`` / ``has_work``."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params,
+        engine_cfg: EngineConfig = EngineConfig(),
+        lora_cfg: Optional[LoRAConfig] = None,
+        *,
+        replicas: int = 1,
+        tensor: int = 1,
+        devices: Optional[Sequence] = None,
+    ):
+        devices = list(devices if devices is not None else jax.devices())
+        if replicas < 1 or tensor < 1:
+            raise ValueError(
+                f"replicas ({replicas}) and tensor ({tensor}) must be >= 1")
+        need = replicas * tensor
+        if need > len(devices):
+            raise ValueError(
+                f"{replicas} replicas x tensor={tensor} needs {need} "
+                f"devices, have {len(devices)}")
+        from dlti_tpu.parallel.mesh import build_mesh
+
+        self.engines: List[InferenceEngine] = []
+        for r in range(replicas):
+            group = devices[r * tensor:(r + 1) * tensor]
+            mesh = (build_mesh(ParallelConfig(tensor=tensor), devices=group)
+                    if tensor > 1 else None)
+            # Single-chip replicas (tensor=1) pin weights to their device
+            # explicitly — engines would otherwise all initialize onto the
+            # default device.
+            rep_params = (params if mesh is not None
+                          else jax.device_put(params, group[0]))
+            self.engines.append(
+                InferenceEngine(model_cfg, rep_params, engine_cfg, lora_cfg,
+                                mesh=mesh))
+        self._rr = 0
+
+    # ------------------------------------------------------------------
+    def _load(self, eng: InferenceEngine) -> int:
+        return len(eng.waiting) + eng.num_active
+
+    def submit(self, prompt_token_ids: Sequence[int],
+               params: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Dispatch to the least-loaded replica (round-robin tiebreak)."""
+        order = (self.engines[self._rr:] + self.engines[:self._rr])
+        self._rr = (self._rr + 1) % len(self.engines)
+        eng = min(order, key=self._load)
+        req = eng.submit(prompt_token_ids, params, request_id)
+        req.replica = self.engines.index(eng)
+        return req
+
+    @property
+    def has_work(self) -> bool:
+        return any(e.has_work for e in self.engines)
+
+    def step(self) -> List[Request]:
+        """One scheduler iteration on every replica that has work.
+
+        jit dispatch is async, so each replica's device program launches
+        before the next replica's host-side scheduling runs — the chips
+        decode concurrently even though this is one Python loop.
+        """
+        finished: List[Request] = []
+        for eng in self.engines:
+            if eng.has_work:
+                finished.extend(eng.step())
+        return finished
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 params: Optional[SamplingParams] = None,
+                 ) -> List[GenerationResult]:
+        """Offline batch generation across all replicas."""
+        reqs = [self.submit(p, params) for p in prompts]
+        while self.has_work:
+            self.step()
+        out = []
+        for r in reqs:
+            eng = self.engines[r.replica]
+            out.append(eng._result(r))
+        return out
+
+    @property
+    def stats(self) -> dict:
+        """Aggregated counters across replicas (per-replica under 'replicas')."""
+        keys = self.engines[0].stats.keys()
+        agg = {k: sum(e.stats[k] for e in self.engines) for k in keys}
+        agg["replicas"] = [dict(e.stats) for e in self.engines]
+        return agg

@@ -38,3 +38,20 @@ def devices():
 @pytest.fixture()
 def rng():
     return jax.random.PRNGKey(0)
+
+
+def make_packed_segments(b, s, n_docs=3, seed=0):
+    """Shared packed-batch layout for attention tests: contiguous docs
+    1..n_docs with random cut points, trailing padding id 0."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    gen = np.random.default_rng(seed)
+    segs = np.zeros((b, s), dtype=np.int32)
+    for row in range(b):
+        cuts = np.sort(gen.choice(np.arange(4, s - 4), n_docs, replace=False))
+        prev, sid = 0, 1
+        for c in cuts:
+            segs[row, prev:c] = sid
+            prev, sid = c, sid + 1
+    return jnp.asarray(segs)

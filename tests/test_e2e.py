@@ -26,6 +26,10 @@ from dlti_tpu.config import (
 from dlti_tpu.data import ByteTokenizer, format_conversation_for_llama2, make_batches
 from dlti_tpu.training.trainer import Trainer
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 
 def _cfg(tmp_path, **train_kwargs):
     defaults = dict(num_epochs=1, micro_batch_size=8, grad_accum_steps=2,

@@ -29,6 +29,10 @@ from dlti_tpu.models import (
     save_peft_adapter,
 )
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 # fp32 everywhere so the parity check is numerically meaningful.
 TINY = ModelConfig(
     vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,

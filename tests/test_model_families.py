@@ -16,6 +16,10 @@ from dlti_tpu.models import LlamaForCausalLM, params_from_hf_state_dict
 from dlti_tpu.ops.attention import reference_attention
 from dlti_tpu.ops.pallas.flash_attention import flash_attention
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 
 def _sd_numpy(model):
     return {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}

