@@ -80,6 +80,11 @@ class ModelConfig:
     param_dtype: str = "bfloat16"  # storage dtype of (frozen) base params
     remat: bool = True  # jax.checkpoint each block (grad-ckpt parity)
     remat_policy: str = "nothing_saveable"  # or "dots_with_no_batch_dims_saveable"
+    # Selective remat: every remat_stride-th block skips jax.checkpoint and
+    # keeps its activations (1 = remat every block, the DeepSpeed
+    # gradient-checkpointing default). Spends HBM headroom to cut the
+    # recompute forward: stride k removes 1/k of it.
+    remat_stride: int = 1
     attention_impl: str = "auto"  # "auto" | "reference" | "flash"
     flash_block_q: int = 512
     flash_block_kv: int = 512
@@ -182,8 +187,9 @@ class ParallelConfig:
     # ZeRO-3 host offload parity (configs/ds_config_zero3.json:19-27).
     # offload_optimizer places optimizer state in pinned host memory (wired
     # in opt_state_shardings); offload_params places the frozen base params
-    # in pinned host memory and streams them to HBM inside the step
-    # (param_shardings + the frozen_fetch hook in the train step).
+    # in pinned host memory — streamed into the compiled step as host
+    # operands when the runtime supports it, else moved at step boundaries
+    # (make_sharded_train_step).
     offload_optimizer: bool = False
     offload_params: bool = False
 

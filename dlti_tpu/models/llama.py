@@ -345,8 +345,16 @@ class LlamaModel(nn.Module):
 
         new_caches = [] if cache is not None else None
         for i in range(cfg.num_layers):
+            # Selective remat: every remat_stride-th block keeps its
+            # activations instead of recomputing them in the backward —
+            # stride k trades ~1/k of the recompute forward for that
+            # fraction of saved activations in HBM.
+            cls_i = block_cls
+            if (cfg.remat and cache is None and cfg.remat_stride > 1
+                    and i % cfg.remat_stride == 0):
+                cls_i = LlamaBlock
             layer_cache = cache[i] if cache is not None else None
-            x, layer_new_cache = block_cls(cfg, self.lora, self.mesh, name=f"layers_{i}")(
+            x, layer_new_cache = cls_i(cfg, self.lora, self.mesh, name=f"layers_{i}")(
                 x, cos, sin, positions, segment_ids, layer_cache, deterministic,
                 token_mask,
             )

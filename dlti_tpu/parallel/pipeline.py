@@ -259,6 +259,17 @@ def make_pipeline_train_step(
     from dlti_tpu.training.state import combine_params, partition_params
     from dlti_tpu.training.step import causal_lm_loss
 
+    if cfg.model.remat and cfg.model.remat_stride > 1:
+        from dlti_tpu.utils.logging import get_logger
+
+        # The pipeline body is a lax.scan over identical per-stage layers;
+        # a per-layer stride predicate is not expressible there, so every
+        # scanned layer remats.
+        get_logger().warning(
+            "remat_stride=%d is ignored under pipeline parallelism "
+            "(scan-uniform layers remat every block)",
+            cfg.model.remat_stride)
+
     lora = cfg.lora if cfg.lora.enabled else None
 
     def loss_fn(trainable, frozen, batch, rng):

@@ -161,8 +161,9 @@ def param_shardings(params: Any, cfg: Config, mesh: Mesh) -> Any:
 
     With ``offload_params`` (ZeRO-3 CPU-offload parity,
     ``ds_config_zero3.json:24-27``) the frozen base params live in pinned
-    host memory; the train step streams them to HBM via its
-    ``frozen_fetch`` hook. Trainable (LoRA) leaves always stay on device —
+    host memory; ``make_sharded_train_step`` streams them into the step —
+    as in-program host operands when the runtime supports it, else via
+    boundary transfers. Trainable (LoRA) leaves always stay on device —
     they are updated every step.
     """
     host_kind = None
@@ -330,14 +331,8 @@ def make_sharded_train_step(
         fp16_hysteresis=cfg.train.fp16_hysteresis,
     )
 
-    # Host offload (ds_config_zero3.json:19-27 parity): the state *rests*
-    # in pinned host memory (st_sh carries memory kinds); the jit itself is
-    # all-device — in-jit memory-kind streaming breaks XLA's SPMD
-    # partitioner on replicated outputs in current jax, so transfers happen
-    # at the step boundary instead. HBM thus holds the offloaded tensors
-    # only for the duration of a step, freeing it between steps (and for
-    # anything colocated); per-layer streaming inside the step is a planned
-    # upgrade once the partitioner handles placement annotations.
+    # Host offload (ds_config_zero3.json:19-27 parity): offloaded leaves
+    # *rest* in pinned host memory (st_sh carries memory kinds).
     has_offload = any(
         getattr(s, "memory_kind", None) == "pinned_host"
         for s in jax.tree_util.tree_leaves(st_sh))
@@ -356,12 +351,24 @@ def make_sharded_train_step(
     if not has_offload:
         return jitted
 
-    # Derived from the actual param shardings (single source of truth with
-    # param_shardings' offload policy).
     frozen_offloaded = any(
         getattr(s, "memory_kind", None) == "pinned_host"
         for s in jax.tree_util.tree_leaves(st_sh.params))
+    if frozen_offloaded and _supports_host_compute_inputs(mesh):
+        # Per-layer streaming (the DeepSpeed per-layer paging analog,
+        # ds_config_zero3.json:19-27): the frozen base params enter the
+        # jitted program AS host-memory operands and are excluded from its
+        # outputs, so XLA's latency-hiding scheduler streams each weight
+        # HBM-ward at its use point inside the step and frees it after —
+        # peak HBM holds the trainable/optimizer leaves plus the layers in
+        # flight, never the whole frozen tree. Trainable leaves stay
+        # device-resident across steps (no boundary transfers at all).
+        return _make_streaming_offload_step(
+            step_fn, cfg, mesh, st_sh, st_sh_dev, b_sh, rng_sh, donate)
 
+    # Fallback (runtime without host-compute operands, or only the
+    # *optimizer* is offloaded): step-boundary whole-state transfer — HBM
+    # holds offloaded tensors only for the duration of a step.
     def step_with_offload(state, batch, rng):
         host_state = state
         dev_state = jax.device_put(state, st_sh_dev)   # host -> HBM
@@ -380,3 +387,99 @@ def make_sharded_train_step(
         return new_state, metrics
 
     return step_with_offload
+
+
+_HOST_COMPUTE_PROBE_CACHE: dict = {}
+
+
+def _supports_host_compute_inputs(mesh: Mesh) -> bool:
+    """Probe: can a jitted program take pinned-host operands into device
+    compute? (XLA host-memory-space operands; needed for in-step weight
+    streaming; degrade to boundary transfers when absent.)
+
+    Probes BOTH a replicated and a mesh-sharded host operand — the real
+    frozen tree contains both kinds, and SPMD-partitioner support for the
+    placement annotation has differed between them in past XLA versions.
+    The answer is a property of the backend + mesh shape, so it is cached.
+    """
+    key = (jax.default_backend(), tuple(sorted(mesh.shape.items())))
+    if key in _HOST_COMPUTE_PROBE_CACHE:
+        return _HOST_COMPUTE_PROBE_CACHE[key]
+
+    def probe(spec, rows) -> None:
+        host = NamedSharding(mesh, spec, memory_kind="pinned_host")
+        dev = NamedSharding(mesh, spec, memory_kind="device")
+        x = jax.device_put(jnp.ones((rows, 16), jnp.float32), host)
+        # The exact streaming pattern: host operand, explicit in-program
+        # move to device space, then compute.
+        f = jax.jit(lambda a: jax.device_put(a, dev) * 2.0,
+                    in_shardings=host, out_shardings=NamedSharding(mesh, spec))
+        jax.block_until_ready(f(x))
+
+    try:
+        probe(P(), 16)
+        sharded_axes = [ax for ax, n in mesh.shape.items() if n > 1]
+        if sharded_axes:
+            ax = sharded_axes[0]
+            # Rows sized to the axis so the shard is never ragged.
+            probe(P(ax), 8 * mesh.shape[ax])
+        ok = True
+    except Exception:
+        ok = False
+    _HOST_COMPUTE_PROBE_CACHE[key] = ok
+    return ok
+
+
+def _make_streaming_offload_step(step_fn, cfg: Config, mesh: Mesh, st_sh,
+                                 st_sh_dev, b_sh, rng_sh, donate: bool):
+    """Build the in-step streaming wrapper: frozen params are host operands
+    of the compiled program; outputs cover only the dynamic state."""
+    from dlti_tpu.training.state import combine_params, partition_params
+
+    lora = cfg.lora.enabled
+
+    def split(tree_state):
+        tr, fr = partition_params(tree_state.params, lora)
+        return tree_state.replace(params=tr), fr
+
+    dyn_sh, frozen_sh = split(st_sh)
+    dyn_sh_dev, frozen_sh_dev = split(st_sh_dev)
+    frozen_dev_kind = {
+        k: NamedSharding(mesh, s.spec, memory_kind="device")
+        for k, s in frozen_sh_dev.items()
+    }
+
+    def run(dyn, frozen, batch, rng):
+        # Explicit per-leaf host->device moves: ops cannot mix memory
+        # spaces, so each frozen weight gets a copy op the latency-hiding
+        # scheduler places near (and overlaps with) its first use.
+        frozen = {k: jax.device_put(v, frozen_dev_kind[k])
+                  for k, v in frozen.items()}
+        state = dyn.replace(params=combine_params(dyn.params, frozen))
+        new_state, metrics = step_fn(state, batch, rng)
+        t_new, _ = partition_params(new_state.params, lora)
+        return new_state.replace(params=t_new), metrics
+
+    jitted = jax.jit(
+        run,
+        # Frozen params enter in pinned host memory and are not outputs.
+        # The dynamic part (trainable params + optimizer state) is
+        # device-in/device-out: host-memory *outputs* are what the SPMD
+        # partitioner cannot handle, so offloaded optimizer leaves rest on
+        # host between steps via the boundary transfers below (tiny for a
+        # LoRA run — the 14 GB frozen tree is what streams in-step).
+        in_shardings=(dyn_sh_dev, frozen_sh, b_sh, rng_sh),
+        out_shardings=(dyn_sh_dev, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def step_streaming(state, batch, rng):
+        dyn, frozen = split(state)
+        dyn = jax.device_put(dyn, dyn_sh_dev)      # no-op unless opt offloaded
+        new_dyn, metrics = jitted(dyn, frozen, batch, rng)
+        new_dyn = jax.device_put(new_dyn, dyn_sh)  # opt leaves back to host
+        # Reattach the untouched host-resident frozen arrays — no copies.
+        return new_dyn.replace(
+            params=combine_params(new_dyn.params, frozen)), metrics
+
+    return step_streaming

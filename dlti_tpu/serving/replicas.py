@@ -19,6 +19,7 @@ round-robin from one host thread overlaps their device work.
 
 from __future__ import annotations
 
+import itertools
 from typing import List, Optional, Sequence
 
 import jax
@@ -70,6 +71,10 @@ class ReplicatedEngine:
                 InferenceEngine(model_cfg, rep_params, engine_cfg, lora_cfg,
                                 mesh=mesh))
         self._rr = 0
+        # Own id namespace: each engine's req-N counter starts at 0, so
+        # auto-ids from different replicas would collide in any id-keyed
+        # consumer (server streams, generate()'s by_id map).
+        self._req_counter = itertools.count()
 
     # ------------------------------------------------------------------
     def _load(self, eng: InferenceEngine) -> int:
@@ -82,6 +87,8 @@ class ReplicatedEngine:
         order = (self.engines[self._rr:] + self.engines[:self._rr])
         self._rr = (self._rr + 1) % len(self.engines)
         eng = min(order, key=self._load)
+        if request_id is None:
+            request_id = f"rep-req-{next(self._req_counter)}"
         req = eng.submit(prompt_token_ids, params, request_id)
         req.replica = self.engines.index(eng)
         return req

@@ -72,12 +72,12 @@ def make_train_step(
     layer to pin activations to the mesh). ``grad_constraint`` pins the
     accumulated grads to the optimizer-state sharding — the ZeRO-2
     reduce-scatter semantics (``configs/ds_config_zero1.json:40``).
-    Host offload (``configs/ds_config_zero3.json:19-27``) happens *outside*
-    this function: the sharded-step wrapper moves host-resident state to
-    HBM before invoking the jitted step and back after (see
-    ``make_sharded_train_step``) — in-jit streaming via memory-kind
-    annotations trips XLA's SPMD partitioner on replicated outputs in the
-    current jax.
+    Host offload (``configs/ds_config_zero3.json:19-27``) is wired by the
+    sharded-step wrapper (``make_sharded_train_step``), not here: when the
+    runtime supports host-memory compute operands the frozen params enter
+    the compiled program directly from pinned host memory (in-step
+    streaming); otherwise the wrapper moves host-resident state to HBM at
+    the step boundary and back after.
 
     When ``state.scaler`` is set (fp16 training), the loss is multiplied by
     the dynamic scale before backward, grads are unscaled, and non-finite

@@ -202,3 +202,42 @@ def test_fp16_scaler_survives_checkpoint_resume(tmp_path, rng):
     # And training continues from the restored scaler.
     restored, m = step(restored, _batch(rng), rng)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_param_offload_streams_in_step_without_copies(rng):
+    """Per-layer streaming contract (ds_config_zero3.json:19-27 analog):
+    when the runtime supports host-memory compute operands, the frozen
+    base params are operands of the compiled step — NOT step outputs and
+    NOT boundary-copied. The same host buffers must flow through N steps
+    unchanged (identity, not just equality), and they must stay in pinned
+    host memory the whole time."""
+    from dlti_tpu.parallel.sharding import _supports_host_compute_inputs
+    from dlti_tpu.training.state import partition_params
+
+    cfg = _offload_cfg()
+    mesh = build_mesh(cfg.parallel)
+    if not _supports_host_compute_inputs(mesh):
+        pytest.skip("runtime lacks host-memory compute operands")
+    model = LlamaForCausalLM(cfg.model, cfg.lora, mesh)
+    tx = build_optimizer(cfg.optimizer)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                               lora_enabled=True)
+    state = shard_train_state(state, cfg, mesh)
+    step = make_sharded_train_step(model, state, cfg, mesh, accum_steps=2)
+    batch = {
+        "input_ids": jax.random.randint(
+            jax.random.PRNGKey(1), (2, 4, 16), 0, cfg.model.vocab_size),
+        "loss_mask": jnp.ones((2, 4, 16), jnp.int32),
+    }
+    _, frozen0 = partition_params(state.params, True)
+    for i in range(2):
+        state, m = step(state, batch, jax.random.PRNGKey(2 + i))
+    _, frozen2 = partition_params(state.params, True)
+    assert frozen0 and frozen2.keys() == frozen0.keys()
+    for k in frozen0:
+        assert frozen2[k] is frozen0[k], f"frozen leaf {k} was copied"
+        assert frozen2[k].sharding.memory_kind == "pinned_host", k
+    # Trainable leaves did update and live on device.
+    tr, _ = partition_params(state.params, True)
+    assert all(v.sharding.memory_kind != "pinned_host" for v in tr.values())
+    assert np.isfinite(float(m["loss"]))

@@ -179,3 +179,34 @@ def test_num_params_analytic_matches_actual(rng):
     params = _init(model, rng)
     actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
     assert CFG.num_params() == actual
+
+
+def test_remat_stride_preserves_training_math(rng):
+    """Selective remat (every k-th block keeps activations) is a pure
+    memory/FLOPs tradeoff — two steps must produce identical losses for
+    any stride."""
+    import dataclasses
+
+    from dlti_tpu.config import MODEL_PRESETS, LoRAConfig, OptimizerConfig
+    from dlti_tpu.training import (
+        build_optimizer, create_train_state, make_train_step,
+    )
+
+    losses = []
+    for stride in (1, 2, 3):
+        cfg = dataclasses.replace(MODEL_PRESETS["llama_tiny"], remat=True,
+                                  remat_stride=stride)
+        model = LlamaForCausalLM(cfg, LoRAConfig(r=4, alpha=8, dropout=0.0))
+        tx = build_optimizer(OptimizerConfig())
+        state = create_train_state(rng, model, tx, (2, 32))
+        step = jax.jit(make_train_step(model, accum_steps=1))
+        batch = {
+            "input_ids": jax.random.randint(rng, (1, 2, 32), 0,
+                                            cfg.vocab_size),
+            "loss_mask": jnp.ones((1, 2, 32), jnp.int32),
+        }
+        for _ in range(2):
+            state, m = step(state, batch, rng)
+        losses.append(float(m["loss"]))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert losses[0] == pytest.approx(losses[2], rel=1e-6)
