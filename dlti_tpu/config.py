@@ -358,6 +358,13 @@ MODEL_PRESETS: dict = {
         vocab_size=4096, hidden_size=256, intermediate_size=512, num_layers=4,
         num_heads=8, num_kv_heads=4, max_seq_len=512,
     ),
+    # ~330M config: the largest preset whose *full* fine-tune (bf16 params
+    # + fp32 AdamW moments + fp32 grad accumulators) fits one 16 GB chip —
+    # used for on-hardware convergence runs.
+    "llama_300m": ModelConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_layers=24, num_heads=16, num_kv_heads=16, max_seq_len=2048,
+    ),
     # ~1.1B TinyLlama-shaped config for single-chip benchmarking.
     "llama_1b": ModelConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,

@@ -49,6 +49,41 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
+class IdTokenizer:
+    """Hermetic id-passthrough tokenizer: every id renders as ``<id> `` and
+    text encodes by parsing that form (non-numeric words hash into the
+    vocab). Exists for serving benchmarks against random-weight models,
+    whose sampled ids exceed any real tokenizer's printable range — the
+    byte tokenizer renders those as empty strings, which suppresses every
+    SSE delta and zeroes streaming TTFT/TPOT measurements.
+    """
+
+    def __init__(self, vocab_size: int = 32000) -> None:
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = []
+        for w in text.split():
+            if w.startswith("<") and w.endswith(">") and w[1:-1].isdigit():
+                ids.append(int(w[1:-1]) % self.vocab_size)
+            else:
+                import zlib
+
+                # crc32, not hash(): stable across processes (PYTHONHASHSEED).
+                ids.append(3 + (zlib.crc32(w.encode()) % (self.vocab_size - 3)))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return " ".join(f"<{i}>" for i in ids)
+
+
 class HFTokenizer:
     """Adapter over a HF fast tokenizer (pad=eos fallback like
     ``train_baseline.py:116-117``)."""
@@ -79,7 +114,14 @@ class HFTokenizer:
 
 
 def get_tokenizer(name: str) -> Tokenizer:
-    """"byte" -> hermetic ByteTokenizer; anything else -> HF hub/path."""
+    """"byte" / "id[:vocab]" -> hermetic tokenizers; else -> HF hub/path.
+
+    "id:4096" bounds the IdTokenizer to a 4096-vocab model so hashed or
+    parsed prompt ids never exceed the served model's embedding table.
+    """
     if name == "byte":
         return ByteTokenizer()
+    if name == "id" or name.startswith("id:"):
+        vocab = int(name.split(":", 1)[1]) if ":" in name else 32000
+        return IdTokenizer(vocab)
     return HFTokenizer(name)

@@ -384,7 +384,8 @@ class LlamaForCausalLM(nn.Module):
             from dlti_tpu.models.quantization import maybe_dequantize
 
             embed = maybe_dequantize(
-                self.variables["params"]["model"]["embed_tokens"], jnp.float32)
+                self.variables["params"]["model"]["embed_tokens"],
+                jnp.float32, anchor=x)
             logits = jnp.einsum("bsh,vh->bsv", x.astype(jnp.float32),
                                 embed.astype(jnp.float32))
         else:
@@ -395,7 +396,7 @@ class LlamaForCausalLM(nn.Module):
             if isinstance(lm_head, dict):
                 from dlti_tpu.models.quantization import maybe_dequantize
 
-                lm_head = maybe_dequantize(lm_head, x.dtype)
+                lm_head = maybe_dequantize(lm_head, x.dtype, anchor=x)
             logits = jnp.dot(x, lm_head.astype(x.dtype),
                              preferred_element_type=jnp.float32)
         return logits.astype(jnp.float32), new_cache

@@ -50,7 +50,7 @@ class LoRADense(nn.Module):
             # a compute-dtype copy (dlti_tpu.models.quantization).
             from dlti_tpu.models.quantization import maybe_dequantize
 
-            kernel = maybe_dequantize(kernel, self.dtype)
+            kernel = maybe_dequantize(kernel, self.dtype, anchor=x)
         y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype),
                     preferred_element_type=self.dtype)
         if self.use_bias:

@@ -100,7 +100,8 @@ class MoEMLP(nn.Module):
         if isinstance(w1, dict):  # int8 serving (per-expert-channel scales)
             from dlti_tpu.models.quantization import maybe_dequantize
 
-            w1, w2, w3 = (maybe_dequantize(w, dtype) for w in (w1, w2, w3))
+            w1, w2, w3 = (maybe_dequantize(w, dtype, anchor=expert_in)
+                          for w in (w1, w2, w3))
 
         hidden = (nn.silu(jnp.einsum("ech,ehm->ecm", expert_in, w1.astype(dtype)))
                   * jnp.einsum("ech,ehm->ecm", expert_in, w3.astype(dtype)))

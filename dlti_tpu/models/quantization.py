@@ -36,11 +36,17 @@ def _should_quantize(path: tuple, value: Any) -> bool:
     return name not in ("lora_a", "lora_b", "router")
 
 
-def quantize_params_int8(params: Mapping[str, Any]) -> Any:
+def quantize_params_int8(params: Mapping[str, Any], donate: bool = False) -> Any:
     """Quantize matmul weights to int8 + per-out-channel scales.
 
     The last dim is treated as the output-channel dim ((in, out) Flax
     kernels, (vocab, hidden) embeddings, stacked expert weights alike).
+
+    ``donate=True`` frees each source array as soon as its int8 twin is
+    materialized, so peak device memory is the *source* tree + one leaf
+    instead of source + quantized together — the difference between
+    fitting and OOMing when quantizing a 7B bf16 tree in 16 GB of HBM.
+    The caller's tree is unusable afterwards.
     """
     def leaf(path, v):
         if not _should_quantize(path, v):
@@ -53,7 +59,12 @@ def quantize_params_int8(params: Mapping[str, Any]) -> Any:
         absmax = jnp.max(jnp.abs(v32), axis=-2, keepdims=True)
         scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
         q = jnp.clip(jnp.round(v32 / scale), -127, 127).astype(jnp.int8)
-        return {"q": q, "scale": scale.astype(jnp.float32)}
+        out = {"q": q, "scale": scale.astype(jnp.float32)}
+        if donate and hasattr(v, "delete"):
+            # Retire the quantize computation, then drop the source buffer.
+            jax.block_until_ready(q)
+            v.delete()
+        return out
 
     return jax.tree_util.tree_map_with_path(leaf, dict(params))
 
@@ -63,17 +74,27 @@ def is_quant_node(node: Any) -> bool:
             and getattr(node.get("q"), "dtype", None) == jnp.int8)
 
 
-def maybe_dequantize(leaf: Any, dtype) -> Any:
+def maybe_dequantize(leaf: Any, dtype, anchor: Any = None) -> Any:
     """Expand one (possibly) quantized leaf to ``dtype``.
 
     Called at each weight's *consumer* (LoRADense / embeddings / MoE
     experts), so only the weights of the layer currently executing hold a
     dequantized copy — peak HBM stays ~int8 tree + one layer, not int8 +
-    a full compute-dtype tree (which a whole-tree dequant at program top
-    would pin live, especially hoisted out of a multi-step decode scan).
+    a full compute-dtype tree.
+
+    ``anchor`` (the consumer's activation input) matters for exactly that:
+    a dequant whose only inputs are weights is loop-invariant, so XLA
+    hoists it out of a multi-step decode scan and schedules every layer's
+    expansion at program start — pinning the full bf16 tree live (OOMs
+    7B int8 serving on a 16 GB chip). The optimization barrier makes the
+    expansion depend on the activation, forcing it to stay inside the
+    loop, per layer, scheduled at its use.
     """
     if is_quant_node(leaf):
-        return (leaf["q"].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+        q = leaf["q"]
+        if anchor is not None:
+            q, _ = jax.lax.optimization_barrier((q, anchor))
+        return (q.astype(jnp.float32) * leaf["scale"]).astype(dtype)
     return leaf
 
 

@@ -162,6 +162,7 @@ class InferenceEngine:
         engine_cfg: EngineConfig = EngineConfig(),
         lora_cfg: Optional[LoRAConfig] = None,
         mesh=None,
+        donate_params: bool = False,
     ):
         if mesh is not None:
             # Tensor-parallel serving: weights and KV pools shard over the
@@ -204,9 +205,11 @@ class InferenceEngine:
             # {"q","scale"} leaves on the kernel's own path (int8 kernels
             # shard like their fp ancestors; scales follow the output
             # channels and replicate for row-parallel kernels).
+            # donate_params frees each source leaf as it quantizes — at 7B
+            # the bf16 and int8 trees cannot coexist in one chip's HBM.
             from dlti_tpu.models.quantization import quantize_params_int8
 
-            params = quantize_params_int8(params)
+            params = quantize_params_int8(params, donate=donate_params)
         self.params = params
 
         ec = engine_cfg
