@@ -270,6 +270,13 @@ class TrainConfig:
     profile_dir: str = ""
     profile_start_step: int = 10
     profile_num_steps: int = 3
+    # Deterministic-replay forensics (SURVEY.md §5.2 sanitizer analog):
+    # persist a ring of (batch, rng, metrics) records so any recent step
+    # can be re-executed bit-for-bit against a checkpoint
+    # (dlti_tpu.utils.debug.replay_step). Empty dir = off.
+    record_replay_dir: str = ""
+    record_replay_every: int = 100
+    record_replay_keep: int = 8
 
 
 @dataclass(frozen=True)
@@ -358,9 +365,10 @@ MODEL_PRESETS: dict = {
         vocab_size=4096, hidden_size=256, intermediate_size=512, num_layers=4,
         num_heads=8, num_kv_heads=4, max_seq_len=512,
     ),
-    # ~330M config: the largest preset whose *full* fine-tune (bf16 params
-    # + fp32 AdamW moments + fp32 grad accumulators) fits one 16 GB chip —
-    # used for on-hardware convergence runs.
+    # ~374M config (32k untied vocab): the largest preset whose *full*
+    # fine-tune (bf16 params + fp32 AdamW moments + fp32 grad
+    # accumulators) fits one 16 GB chip — used for on-hardware
+    # convergence runs.
     "llama_300m": ModelConfig(
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
         num_layers=24, num_heads=16, num_kv_heads=16, max_seq_len=2048,

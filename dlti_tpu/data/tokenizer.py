@@ -122,6 +122,10 @@ def get_tokenizer(name: str) -> Tokenizer:
     if name == "byte":
         return ByteTokenizer()
     if name == "id" or name.startswith("id:"):
-        vocab = int(name.split(":", 1)[1]) if ":" in name else 32000
-        return IdTokenizer(vocab)
+        suffix = name.split(":", 1)[1] if ":" in name else ""
+        if suffix and not suffix.isdigit():
+            raise ValueError(
+                f"bad id-tokenizer spec {name!r}: expected 'id' or "
+                f"'id:<vocab_size>' (e.g. 'id:4096')")
+        return IdTokenizer(int(suffix) if suffix else 32000)
     return HFTokenizer(name)

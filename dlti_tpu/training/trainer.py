@@ -198,6 +198,14 @@ class Trainer:
         # captures the next profile_num_steps steps).
         profile_state = "pending"
         profile_stop_at = None
+
+        recorder = None
+        if cfg.train.record_replay_dir and is_main_process():
+            from dlti_tpu.utils.debug import StepRecorder
+
+            recorder = StepRecorder(cfg.train.record_replay_dir,
+                                    keep=cfg.train.record_replay_keep,
+                                    every_steps=cfg.train.record_replay_every)
         try:
             for epoch in range(start_epoch, cfg.train.num_epochs):
                 for batch in epoch_batches(epoch):
@@ -216,6 +224,7 @@ class Trainer:
                             profile_state = "done"
                             self.logger.info("profiler trace -> %s",
                                              cfg.train.profile_dir)
+                    host_batch = batch
                     if self.mesh is not None:
                         from dlti_tpu.parallel.sharding import make_global_batch
 
@@ -227,6 +236,12 @@ class Trainer:
                     global_step += 1
                     samples_seen += cfg.train.micro_batch_size * cfg.train.grad_accum_steps
                     losses.append(float(metrics["loss"]))
+                    if recorder is not None:
+                        # Record the pre-assembly host-local batch: the
+                        # global array's shards span other hosts' devices
+                        # and cannot be fetched here.
+                        recorder.record(global_step, host_batch, step_rng,
+                                        metrics)
 
                     if global_step % cfg.train.logging_steps == 0 and is_main_process():
                         self.logger.info(

@@ -1,0 +1,172 @@
+"""Sharding assertions + deterministic step replay — the sanitizer analog.
+
+The reference's only "sanitizer" is turning DDP's unused-parameter
+detection *off* (``train_deepspeed_zero1.py:248``; SURVEY.md §5.2) — on a
+GSPMD stack the failure modes worth guarding are different: a leaf
+silently landing with the wrong PartitionSpec (GSPMD falls back to
+all-gather instead of erroring), non-finite values creeping into a step,
+and "it diverged at step 31k" reports with nothing to reproduce from.
+This module covers all three:
+
+* :func:`assert_tree_sharding` / :func:`sharding_mismatches` — walk a
+  pytree and fail loudly (with param paths) when actual shardings drift
+  from the intended specs.
+* :func:`assert_all_finite` — pinpoints which leaves carry NaN/inf.
+* :class:`StepRecorder` / :func:`replay_step` — capture (batch, rng,
+  metrics) of live training steps into a ring of ``.npz`` files; replay
+  re-executes a recorded batch through a step function and checks the
+  metrics reproduce — the deterministic-seed replay SURVEY §5.2
+  prescribes, usable for post-mortem forensics on any checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _path_str(path: tuple) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif isinstance(p, tuple):
+            parts.extend(str(q) for q in p)
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def sharding_mismatches(tree: Any, expected: Any) -> List[Tuple[str, str, str]]:
+    """Compare actual leaf shardings against expected NamedShardings.
+
+    ``expected`` is a matching pytree of shardings (e.g. the output of
+    ``param_shardings`` / ``state_shardings``). Returns
+    ``(path, actual, expected)`` triples for every drifted leaf; memory
+    kinds are compared too (a weight quietly falling back from
+    pinned_host to device defeats offload without an error).
+    """
+    actual_flat = jax.tree_util.tree_leaves_with_path(tree)
+    expected_flat = jax.tree_util.tree_leaves_with_path(expected)
+    exp_by_path = {_path_str(p): s for p, s in expected_flat}
+    bad = []
+    for path, leaf in actual_flat:
+        ps = _path_str(path)
+        want = exp_by_path.get(ps)
+        if want is None:
+            continue
+        got = getattr(leaf, "sharding", None)
+        if got is None:
+            continue
+        same_spec = getattr(got, "spec", None) == getattr(want, "spec", None)
+        same_kind = (getattr(got, "memory_kind", None)
+                     == getattr(want, "memory_kind", None))
+        if not (same_spec and same_kind):
+            bad.append((ps, f"{got}", f"{want}"))
+    return bad
+
+
+def assert_tree_sharding(tree: Any, expected: Any, what: str = "tree") -> None:
+    """Raise ``AssertionError`` naming every leaf whose sharding drifted."""
+    bad = sharding_mismatches(tree, expected)
+    if bad:
+        lines = "\n".join(f"  {p}:\n    actual   {a}\n    expected {e}"
+                          for p, a, e in bad[:20])
+        more = f"\n  ... and {len(bad) - 20} more" if len(bad) > 20 else ""
+        raise AssertionError(
+            f"{len(bad)} leaves of {what} have drifted shardings:\n{lines}{more}")
+
+
+def assert_all_finite(tree: Any, what: str = "tree") -> None:
+    """Raise with the paths of every leaf containing NaN/inf."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            n_bad = int((~np.isfinite(arr)).sum())
+            bad.append(f"  {_path_str(path)}: {n_bad}/{arr.size} non-finite")
+    if bad:
+        raise AssertionError(f"non-finite values in {what}:\n" + "\n".join(bad))
+
+
+class StepRecorder:
+    """Ring buffer of training-step inputs for deterministic replay.
+
+    ``record(step, batch, rng, metrics)`` persists the *inputs* of a step
+    (the host-side batch arrays and the folded rng key) plus the observed
+    metrics. Keeps the newest ``keep`` records. Cheap: one .npz write of
+    the already-host-resident batch per recorded step.
+    """
+
+    def __init__(self, directory: str, keep: int = 8,
+                 every_steps: int = 1) -> None:
+        self.directory = directory
+        self.keep = max(1, keep)  # keep<=0 would disable rotation entirely
+        self.every_steps = max(1, every_steps)
+        os.makedirs(directory, exist_ok=True)
+
+    def record(self, step: int, batch: dict, rng, metrics: dict) -> None:
+        if step % self.every_steps != 0:
+            return
+        path = os.path.join(self.directory, f"step_{step:08d}.npz")
+        payload = {f"batch.{k}": np.asarray(jax.device_get(v))
+                   for k, v in batch.items()}
+        payload["rng"] = np.asarray(jax.random.key_data(rng))
+        payload["metrics_json"] = np.frombuffer(
+            json.dumps({k: float(v) for k, v in metrics.items()}).encode(),
+            dtype=np.uint8)
+        np.savez(path, step=step, **payload)
+        self._rotate()
+
+    def _rotate(self) -> None:
+        files = sorted(f for f in os.listdir(self.directory)
+                       if f.startswith("step_") and f.endswith(".npz"))
+        for f in files[:-self.keep]:
+            os.remove(os.path.join(self.directory, f))
+
+    @staticmethod
+    def load(path: str) -> Tuple[int, dict, Any, dict]:
+        """-> (step, batch, rng, recorded_metrics)."""
+        data = np.load(path)
+        batch = {k[len("batch."):]: data[k] for k in data.files
+                 if k.startswith("batch.")}
+        rng = jax.random.wrap_key_data(data["rng"])
+        metrics = json.loads(bytes(data["metrics_json"]).decode())
+        return int(data["step"]), batch, rng, metrics
+
+
+def replay_step(
+    record_path: str,
+    step_fn: Callable,
+    state,
+    *,
+    rtol: float = 0.0,
+    compare: Optional[List[str]] = None,
+) -> dict:
+    """Re-execute a recorded step and check its metrics reproduce.
+
+    ``step_fn(state, batch, rng) -> (state, metrics)`` must be the same
+    step function (and ``state`` the same train state — restore the
+    matching checkpoint first). With ``rtol=0`` this asserts bitwise
+    determinism of the recorded metrics — XLA executions are
+    deterministic given identical inputs, program, and topology, so any
+    divergence means the inputs/program differ from the original run.
+    Returns the replayed metrics.
+    """
+    step, batch, rng, recorded = StepRecorder.load(record_path)
+    _, metrics = step_fn(state, batch, rng)
+    metrics = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+    keys = compare if compare is not None else [
+        k for k in ("loss", "grad_norm") if k in recorded and k in metrics]
+    for k in keys:
+        a, b = metrics[k], recorded[k]
+        ok = (a == b) if rtol == 0.0 else abs(a - b) <= rtol * max(abs(b), 1e-12)
+        if not ok:
+            raise AssertionError(
+                f"replay of step {step} diverged on {k!r}: replayed {a!r} "
+                f"vs recorded {b!r} (rtol={rtol})")
+    return metrics

@@ -88,17 +88,30 @@ def detect_chip_peak_flops() -> float:
 
 def device_peak_memory_gb() -> float:
     """Peak device memory (the ``torch.cuda.max_memory_allocated`` analog,
-    reference ``train_baseline.py:253``)."""
+    reference ``train_baseline.py:253``).
+
+    CPU-simulated runs (and PJRT plugins that return no stats, like the
+    remote relay) fall back to the process's peak RSS so the reference CSV
+    schema's ``peak_memory_gb`` column is never silently zero.
+    """
     import jax
 
     try:
         stats = jax.local_devices()[0].memory_stats()
-        if not stats:  # some PJRT plugins return None
-            return 0.0
-        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
-        return peak / 1024**3
+        if stats:
+            peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            if peak:
+                return peak / 1024**3
     except Exception:
-        return 0.0
+        pass
+    try:  # host fallback: peak resident set (VmHWM), linux procfs
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) / 1024**2  # kB -> GB
+    except Exception:
+        pass
+    return 0.0
 
 
 def save_training_metrics(metrics: MetricsRecord | dict,
