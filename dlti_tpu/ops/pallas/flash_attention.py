@@ -118,7 +118,7 @@ def _seg_run(qseg_ref, kseg_ref):
 
 def _fwd_kernel(*refs, scale: float, block_q: int, block_kv: int,
                 group: int, causal: bool, window: int, seq_q: int,
-                seq_kv: int, has_segs: bool):
+                seq_kv: int, has_segs: bool, window_blocks: int = 0):
     if has_segs:
         (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
          m_scratch, l_scratch, acc_scratch) = refs
@@ -127,17 +127,27 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_kv: int,
          m_scratch, l_scratch, acc_scratch) = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    kj = pl.program_id(2)
     gbq = group * block_q
+    # Windowed grid: the kv dimension enumerates only the window_blocks
+    # blocks ending at q's diagonal block — blocks outside the band are
+    # never visited (and never DMA'd). ki is the *virtual* kv-block index
+    # the visit targets; negative values are clamped duplicate fetches of
+    # block 0, fully masked and skipped below.
+    if window_blocks:
+        ki = ((qi + 1) * block_q - 1) // block_kv - (window_blocks - 1) + kj
+    else:
+        ki = kj
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _init():
         m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if window_blocks:
+        run = jnp.logical_and(run, ki >= 0)
     if has_segs:
         run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
 
@@ -181,7 +191,7 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_kv: int,
         m_scratch[:] = m_new
         l_scratch[:] = l_new
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():
         l = l_scratch[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
@@ -193,20 +203,53 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_kv: int,
         lse_ref[0] = lse.reshape(group, block_q, 1)
 
 
-def _seg_specs(h_kv, block_q, block_kv, transposed=False):
+def _window_kv_blocks(causal, window, block_q, block_kv, nk):
+    """kv-block visits per q block under a sliding window (0 = full sweep).
+
+    The band of q tile qi spans kv blocks
+    [floor((qi*Bq - window + 1)/Bkv), floor(((qi+1)*Bq - 1)/Bkv)] — at
+    most (Bq + window - 2)//Bkv + 1 blocks; +1 margin keeps the bound
+    safe. Only worthwhile when it actually shrinks the sweep.
+    """
+    if not (causal and window):
+        return 0
+    w = (block_q + window - 2) // block_kv + 2
+    return w if w < nk else 0
+
+
+def _window_q_blocks(causal, window, block_q, block_kv, nq):
+    """q-block visits per kv block for the dk/dv sweep (0 = full sweep)."""
+    if not (causal and window):
+        return 0
+    w = (block_kv + window - 2) // block_q + 2
+    return w if w < nq else 0
+
+
+def _kv_block_index(qi, j, block_q, block_kv, window_blocks, nk):
+    """Physical kv-block index for visit j of q tile qi (clamped for DMA;
+    the kernel recomputes the unclamped value for masking)."""
+    v = ((qi + 1) * block_q - 1) // block_kv - (window_blocks - 1) + j
+    return jnp.clip(v, 0, nk - 1)
+
+
+def _seg_specs(h_kv, block_q, block_kv, transposed=False, kv_index=None,
+               q_index=None):
     """BlockSpecs for (b, sq, 1) q-segment and (b, 1, skv) kv-segment arrays.
 
     The (block_q, 1) / (1, block_kv) tile shapes let the kernel form the
     (block_q, block_kv) equality mask by broadcast — no lane<->sublane
     transposes on TPU. The grid's leading axis is batch*kv_heads; ``// h_kv``
-    recovers the batch row.
+    recovers the batch row. ``kv_index``/``q_index`` remap the minor grid
+    dim for windowed sweeps.
     """
-    if transposed:  # dkv grid: (bh, kv_block, q_block)
-        q_map = lambda b, j, i: (b // h_kv, i, 0)
-        kv_map = lambda b, j, i: (b // h_kv, 0, j)
+    if transposed:  # dkv grid: (bh, kv_block, q_visit)
+        qix = q_index or (lambda ki, j: j)
+        q_map = lambda b, jk, jq: (b // h_kv, qix(jk, jq), 0)
+        kv_map = lambda b, jk, jq: (b // h_kv, 0, jk)
     else:
+        kix = kv_index or (lambda i, j: j)
         q_map = lambda b, i, j: (b // h_kv, i, 0)
-        kv_map = lambda b, i, j: (b // h_kv, 0, j)
+        kv_map = lambda b, i, j: (b // h_kv, 0, kix(i, j))
     return (pl.BlockSpec((1, block_q, 1), q_map),
             pl.BlockSpec((1, 1, block_kv), kv_map))
 
@@ -219,19 +262,30 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, h_kv, scale, block_q, block_kv,
     skv = k.shape[1]
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
-    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(skv, block_kv))
+    nk = pl.cdiv(skv, block_kv)
+    win_blocks = _window_kv_blocks(causal, window, block_q, block_kv, nk)
+    grid = (bh, pl.cdiv(sq, block_q), win_blocks or nk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
         group=group, causal=causal, window=window, seq_q=sq, seq_kv=skv,
-        has_segs=q_seg is not None,
+        has_segs=q_seg is not None, window_blocks=win_blocks,
     )
     q_spec = pl.BlockSpec((1, group, block_q, d), lambda b, i, j: (b, 0, i, 0))
-    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    if win_blocks:
+        kv_index = functools.partial(_kv_block_index, block_q=block_q,
+                                     block_kv=block_kv,
+                                     window_blocks=win_blocks, nk=nk)
+        kv_spec = pl.BlockSpec((1, block_kv, d),
+                               lambda b, i, j: (b, kv_index(i, j), 0))
+    else:
+        kv_index = None
+        kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
     in_specs = [q_spec, kv_spec, kv_spec]
     inputs = [q, k, v]
     if q_seg is not None:
-        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv)
+        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv,
+                                      kv_index=kv_index)
         in_specs += [qs_spec, ks_spec]
         inputs += [q_seg, kv_seg]
     return pl.pallas_call(
@@ -253,10 +307,15 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, h_kv, scale, block_q, block_kv,
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=int(2 * 2 * bh * group * sq * skv * d
-                      * (0.5 if causal else 1.0)),
+            # Banded fraction: a windowed grid visits win_blocks kv blocks
+            # per q tile instead of the causal triangle.
+            flops=int(2 * 2 * bh * group * sq * d
+                      * (min(win_blocks * block_kv, skv) if win_blocks
+                         else skv * (0.5 if causal else 1.0))),
             bytes_accessed=(2 * q.size + k.size + v.size) * q.dtype.itemsize,
-            transcendentals=bh * group * sq * skv,
+            transcendentals=int(bh * group * sq
+                                * (min(win_blocks * block_kv, skv)
+                                   if win_blocks else skv)),
         ),
     )(*inputs)
 
@@ -292,7 +351,7 @@ def _load_bwd_tiles(q_ref, k_ref, v_ref, do_ref, qi, ki, block_q, block_kv,
 
 
 def _dq_kernel(*refs, scale, block_q, block_kv, group, causal, window,
-               seq_q, seq_kv, has_segs):
+               seq_q, seq_kv, has_segs, window_blocks: int = 0):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
          dq_ref, dq_scratch) = refs
@@ -301,15 +360,20 @@ def _dq_kernel(*refs, scale, block_q, block_kv, group, causal, window,
          dq_ref, dq_scratch) = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    kj = pl.program_id(2)
     gbq = group * block_q
+    if window_blocks:  # see _fwd_kernel: virtual kv index of this visit
+        ki = ((qi + 1) * block_q - 1) // block_kv - (window_blocks - 1) + kj
+    else:
+        ki = kj
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _init():
         dq_scratch[:] = jnp.zeros_like(dq_scratch)
 
     run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if window_blocks:
+        run = jnp.logical_and(run, ki >= 0)
     if has_segs:
         run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
 
@@ -338,14 +402,14 @@ def _dq_kernel(*refs, scale, block_q, block_kv, group, causal, window,
         dq_scratch[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():
         dq_ref[0] = dq_scratch[:].reshape(
             group, block_q, -1).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(*refs, scale, block_q, block_kv, group, causal, window,
-                seq_q, seq_kv, has_segs):
+                seq_q, seq_kv, has_segs, window_q_blocks: int = 0):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
          dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
@@ -354,16 +418,24 @@ def _dkv_kernel(*refs, scale, block_q, block_kv, group, causal, window,
          dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
         qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    qj = pl.program_id(2)
     gbq = group * block_q
+    if window_q_blocks:
+        # Virtual q-block index of this visit: the band of kv block ki
+        # starts at its own diagonal q block and extends window forward.
+        qi = (ki * block_kv) // block_q + qj
+    else:
+        qi = qj
 
-    @pl.when(qi == 0)
+    @pl.when(qj == 0)
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
     run = _band_run(qi, ki, block_q, block_kv, causal, window)
+    if window_q_blocks:
+        # Clamped duplicate visits past the last real q block are masked.
+        run = jnp.logical_and(run, qi * block_q < seq_q)
     if has_segs:
         run = jnp.logical_and(run, _seg_run(qseg_ref, kseg_ref))
 
@@ -396,7 +468,7 @@ def _dkv_kernel(*refs, scale, block_q, block_kv, group, causal, window,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qj == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
@@ -413,28 +485,39 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, h_kv, scale, block_q,
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(skv, block_kv)
     has_segs = q_seg is not None
+    win_blocks = _window_kv_blocks(causal, window, block_q, block_kv, nk)
+    win_q_blocks = _window_q_blocks(causal, window, block_q, block_kv, nq)
 
     # D_i = rowsum(dO_i * O_i) — tiny elementwise pass, XLA-fused.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
     q_spec = pl.BlockSpec((1, group, block_q, d), lambda b, i, j: (b, 0, i, 0))
-    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    if win_blocks:
+        kv_index = functools.partial(_kv_block_index, block_q=block_q,
+                                     block_kv=block_kv,
+                                     window_blocks=win_blocks, nk=nk)
+        kv_spec = pl.BlockSpec((1, block_kv, d),
+                               lambda b, i, j: (b, kv_index(i, j), 0))
+    else:
+        kv_index = None
+        kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
     row_spec = pl.BlockSpec((1, group, block_q, 1), lambda b, i, j: (b, 0, i, 0))
 
     in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
     inputs = [q, k, v, do, lse, delta]
     if has_segs:
-        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv)
+        qs_spec, ks_spec = _seg_specs(h_kv, block_q, block_kv,
+                                      kv_index=kv_index)
         in_specs += [qs_spec, ks_spec]
         inputs += [q_seg, kv_seg]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_q=block_q,
                           block_kv=block_kv, group=group, causal=causal,
                           window=window, seq_q=sq, seq_kv=skv,
-                          has_segs=has_segs),
+                          has_segs=has_segs, window_blocks=win_blocks),
         out_shape=jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
-        grid=(bh, nq, nk),
+        grid=(bh, nq, win_blocks or nk),
         in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((group * block_q, d), jnp.float32)],
@@ -442,25 +525,33 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, h_kv, scale, block_q,
     )(*inputs)
 
     # dk/dv sweep: grid transposed so kv blocks are outer, q inner.
-    q_spec_t = pl.BlockSpec((1, group, block_q, d), lambda b, j, i: (b, 0, i, 0))
-    kv_spec_t = pl.BlockSpec((1, block_kv, d), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, group, block_q, 1), lambda b, j, i: (b, 0, i, 0))
+    if win_q_blocks:
+        def q_index(jk, jq):
+            return jnp.clip((jk * block_kv) // block_q + jq, 0, nq - 1)
+    else:
+        q_index = None
+    qix = q_index or (lambda jk, jq: jq)
+    q_spec_t = pl.BlockSpec((1, group, block_q, d),
+                            lambda b, jk, jq: (b, 0, qix(jk, jq), 0))
+    kv_spec_t = pl.BlockSpec((1, block_kv, d), lambda b, jk, jq: (b, jk, 0))
+    row_spec_t = pl.BlockSpec((1, group, block_q, 1),
+                              lambda b, jk, jq: (b, 0, qix(jk, jq), 0))
     in_specs_t = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t]
     inputs_t = [q, k, v, do, lse, delta]
     if has_segs:
         qs_spec_t, ks_spec_t = _seg_specs(h_kv, block_q, block_kv,
-                                          transposed=True)
+                                          transposed=True, q_index=q_index)
         in_specs_t += [qs_spec_t, ks_spec_t]
         inputs_t += [q_seg, kv_seg]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
                           block_kv=block_kv, group=group, causal=causal,
                           window=window, seq_q=sq, seq_kv=skv,
-                          has_segs=has_segs),
+                          has_segs=has_segs, window_q_blocks=win_q_blocks),
         out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
-        grid=(bh, nk, nq),
+        grid=(bh, nk, win_q_blocks or nq),
         in_specs=in_specs_t,
         out_specs=(kv_spec_t, kv_spec_t),
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),

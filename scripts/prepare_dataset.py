@@ -102,6 +102,31 @@ def prepare_dataset(args) -> str:
                 f.write(json.dumps({"text": t}) + "\n")
     total_chars = sum(len(t) for t in texts)
     print(f"saved -> {out}  ({len(texts)} rows, {total_chars / 1e6:.1f} MB of text)")
+
+    if args.write_token_store:
+        # Corpus-scale path: tokenize + (optionally pack) straight into the
+        # memory-mapped row store scripts/train.py consumes with O(rows)
+        # host RAM (dlti_tpu.data.streaming).
+        from dlti_tpu.data import get_tokenizer
+        from dlti_tpu.data.streaming import write_token_store
+
+        tok = get_tokenizer(args.tokenizer)
+        t1 = time.time()
+
+        def docs():
+            # Tokenize lazily, one document at a time — the writer chunks
+            # internally, so peak host RAM stays one chunk of token rows,
+            # not the tokenized corpus.
+            for t in texts:
+                yield tok.encode(t, add_bos=True,
+                                 add_eos=True)[:args.max_seq_len]
+
+        meta = write_token_store(docs(), args.write_token_store,
+                                 seq_len=args.max_seq_len, pad_id=tok.pad_id,
+                                 pack=args.pack, tokenizer=args.tokenizer)
+        print(f"token store -> {args.write_token_store}  "
+              f"({meta['n_rows']} rows x {args.max_seq_len}, "
+              f"packed={args.pack}, {time.time() - t1:.1f}s)")
     return out
 
 
@@ -115,6 +140,14 @@ def main() -> None:
                    help="local JSON/JSONL with question/answer records (offline)")
     p.add_argument("--synthetic", type=int, default=0,
                    help="generate N synthetic pairs instead of downloading")
+    p.add_argument("--write-token-store", default=None, metavar="DIR",
+                   help="also tokenize into a memory-mapped token store "
+                        "(consumed directly by scripts/train.py)")
+    p.add_argument("--tokenizer", default="byte",
+                   help="tokenizer for --write-token-store")
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--pack", action="store_true",
+                   help="pack documents when writing the token store")
     prepare_dataset(p.parse_args())
 
 
