@@ -88,6 +88,14 @@ class ModelConfig:
     attention_impl: str = "auto"  # "auto" | "reference" | "flash"
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    # Packed batches: an upper bound on any packed document's token count
+    # (0 = unknown). Intra-document attention can never span further back
+    # than the document's own length, so combined with segment masking a
+    # window of this size is *exact* — and lets the flash kernel run its
+    # banded sweep (O(seq x bound) FLOPs and DMA) instead of the causal
+    # triangle. scripts/train.py sets it from the measured corpus when
+    # packing. Ignored for unpacked batches.
+    packed_attention_window: int = 0
     # Serving decode over the paged cache: "auto" uses the Pallas in-place
     # block-table kernel on TPU and the XLA gather path elsewhere;
     # "kernel" forces the kernel (interpreted off-TPU, for tests);

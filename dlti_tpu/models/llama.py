@@ -80,6 +80,22 @@ class LlamaAttention(nn.Module):
     # (SURVEY.md §5.7); here it is first-class.
     mesh: Optional[Any] = None
 
+    def _effective_window(self, segment_ids) -> Optional[int]:
+        """Sliding window combined with the packed doc-length bound.
+
+        For packed batches a window of ``packed_attention_window`` is
+        *exact*: intra-document attention can never reach further back
+        than the document's own length, and the segment mask handles the
+        rest — so the flash kernel's banded sweep (or the ring's chunk
+        skip) applies without changing any logit.
+        """
+        cfg = self.cfg
+        window = cfg.sliding_window
+        if segment_ids is not None and cfg.packed_attention_window:
+            window = (min(window, cfg.packed_attention_window)
+                      if window else cfg.packed_attention_window)
+        return window
+
     @nn.compact
     def __call__(
         self,
@@ -179,14 +195,15 @@ class LlamaAttention(nn.Module):
             # 'sequence' mesh axis. RoPE positions are passed through so
             # the ring's causal mask always agrees with the embedded
             # positions; packed batches travel their segment ids around
-            # the ring, and sliding-window models skip chunks outside
-            # the window band.
+            # the ring, and window-banded chunks (sliding window or the
+            # packed doc-length bound) skip their matmuls entirely.
             from dlti_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, self.mesh, positions=positions,
                                  segment_ids=segment_ids, causal=True,
-                                 window=cfg.sliding_window)
+                                 window=self._effective_window(segment_ids))
         else:
+            window = self._effective_window(segment_ids)
             if cfg.attention_impl in ("flash", "auto"):
                 from dlti_tpu.ops.attention import multi_head_attention
 
@@ -194,11 +211,12 @@ class LlamaAttention(nn.Module):
                     q, k, v, causal=True, segment_ids=segment_ids,
                     impl=cfg.attention_impl,
                     block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
-                    window=cfg.sliding_window,
+                    window=window,
                 )
             else:
-                out = reference_attention(q, k, v, causal=True, segment_ids=segment_ids,
-                                          window=cfg.sliding_window)
+                out = reference_attention(q, k, v, causal=True,
+                                          segment_ids=segment_ids,
+                                          window=window)
 
         # Remat seam: with remat_policy="save_attn_out", the backward reuses
         # this (b, s, h*d) tensor instead of re-running the whole attention

@@ -30,6 +30,7 @@ keep the reference defaults (1, 16, 2e-4, 16).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -120,6 +121,17 @@ def load_texts(path: str) -> list:
         return [line.rstrip("\n") for line in f if line.strip()]
 
 
+def _apply_packed_window(cfg, max_doc_len: int):
+    """Exact banded attention for packed batches (see
+    ModelConfig.packed_attention_window)."""
+    if max_doc_len and max_doc_len < cfg.data.max_seq_len:
+        cfg = cfg.replace(model=dataclasses.replace(
+            cfg.model, packed_attention_window=max_doc_len))
+        print(f"packed attention window: {max_doc_len} "
+              f"(corpus max doc length)")
+    return cfg
+
+
 def build_config(args):
     import jax
 
@@ -149,8 +161,6 @@ def build_config(args):
 
     model_cfg = cfg.model
     if args.fp16:
-        import dataclasses
-
         # fp16 parity mode: compute and store in fp16 (the scaler handles
         # overflow); without --fp16 the TPU default bf16 stays.
         model_cfg = dataclasses.replace(model_cfg, dtype="float16",
@@ -256,6 +266,8 @@ def main() -> None:
         print(f"dataset: memory-mapped token store {args.dataset_path} "
               f"({dataset._ids.shape[0]} rows x {dataset.seq_len}, "
               f"packed={dataset.packed})")
+        if dataset.packed:
+            cfg = _apply_packed_window(cfg, meta.get("max_doc_len", 0))
     else:
         texts = load_texts(args.dataset_path)
         print(f"dataset: {len(texts)} examples from {args.dataset_path}")
@@ -268,6 +280,9 @@ def main() -> None:
             shuffle_seed=cfg.data.shuffle_seed,
             pack=cfg.data.pack_sequences,
         )
+        if cfg.data.pack_sequences and dataset.sequences:
+            cfg = _apply_packed_window(cfg, max(
+                min(len(s), cfg.data.max_seq_len) for s in dataset.sequences))
     print(f"steps/epoch: {dataset.steps_per_epoch()}")
 
     trainer = Trainer(cfg, base_params=base_params)

@@ -210,3 +210,36 @@ def test_remat_stride_preserves_training_math(rng):
         losses.append(float(m["loss"]))
     assert losses[0] == pytest.approx(losses[1], rel=1e-6)
     assert losses[0] == pytest.approx(losses[2], rel=1e-6)
+
+
+def test_packed_attention_window_is_exact(rng):
+    """packed_attention_window = max doc length must not change logits:
+    intra-doc attention never spans further back than the doc itself, so
+    the banded sweep + segment mask equals plain segment masking."""
+    import dataclasses
+
+    import numpy as np
+
+    from conftest import make_packed_segments
+    from dlti_tpu.data.pipeline import packed_positions
+
+    base = dataclasses.replace(MODEL_PRESETS["llama_tiny"],
+                               attention_impl="reference")
+    segs = make_packed_segments(2, 64, n_docs=4)
+    max_doc = int(max(np.diff(np.flatnonzero(np.concatenate([
+        [True], np.asarray(segs)[b, 1:] != np.asarray(segs)[b, :-1], [True]])))
+        .max() for b in range(2)))
+    ids = jax.random.randint(rng, (2, 64), 0, base.vocab_size)
+    pos = jnp.asarray(packed_positions(np.asarray(segs)))
+
+    logits = {}
+    for name, window in [("plain", 0), ("banded", max_doc)]:
+        cfg = dataclasses.replace(base, packed_attention_window=window)
+        model = LlamaForCausalLM(cfg, None)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        out, _ = model.apply({"params": params}, ids, positions=pos,
+                             segment_ids=segs, deterministic=True)
+        logits[name] = np.asarray(out)
+    valid = np.asarray(segs != 0)[:, :, None]
+    np.testing.assert_allclose(logits["banded"] * valid,
+                               logits["plain"] * valid, atol=1e-5)
