@@ -195,13 +195,16 @@ class LlamaAttention(nn.Module):
             # 'sequence' mesh axis. RoPE positions are passed through so
             # the ring's causal mask always agrees with the embedded
             # positions; packed batches travel their segment ids around
-            # the ring, and window-banded chunks (sliding window or the
-            # packed doc-length bound) skip their matmuls entirely.
+            # the ring and segment-disjoint chunks skip their matmuls.
+            # The packed doc-length bound is NOT passed here: the ring
+            # masks by *per-document* positions (always < the bound), so
+            # as a window it could never fire — segment disjointness is
+            # the mechanism that prunes packed chunks on this path.
             from dlti_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, self.mesh, positions=positions,
                                  segment_ids=segment_ids, causal=True,
-                                 window=self._effective_window(segment_ids))
+                                 window=cfg.sliding_window)
         else:
             window = self._effective_window(segment_ids)
             if cfg.attention_impl in ("flash", "auto"):

@@ -226,9 +226,12 @@ def test_packed_attention_window_is_exact(rng):
     base = dataclasses.replace(MODEL_PRESETS["llama_tiny"],
                                attention_impl="reference")
     segs = make_packed_segments(2, 64, n_docs=4)
-    max_doc = int(max(np.diff(np.flatnonzero(np.concatenate([
-        [True], np.asarray(segs)[b, 1:] != np.asarray(segs)[b, :-1], [True]])))
-        .max() for b in range(2)))
+    # True max document length: count run lengths of real segments only
+    # (the trailing padding run, id 0, is not a document).
+    segs_np = np.asarray(segs)
+    max_doc = max(int(np.sum(segs_np[b] == sid))
+                  for b in range(2)
+                  for sid in np.unique(segs_np[b]) if sid != 0)
     ids = jax.random.randint(rng, (2, 64), 0, base.vocab_size)
     pos = jnp.asarray(packed_positions(np.asarray(segs)))
 

@@ -508,3 +508,21 @@ def test_replicated_engine_rejects_overcommit(tiny_model_and_params):
                                                    num_blocks=32,
                                                    max_model_len=48),
                          replicas=5, tensor=2)
+
+
+def test_engine_commits_host_params_to_device(tiny_model_and_params):
+    """Checkpoint restores hand back host (numpy) arrays; the engine must
+    pin them to its device once at construction — otherwise every compiled
+    call re-uploads the whole tree (measured ~40 s/step for a 300M model
+    over the remote relay)."""
+    model, params = tiny_model_and_params
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
+                      max_model_len=48, cache_dtype="float32", eos_token_id=-1)
+    eng = InferenceEngine(CFG, host_params, ec)
+    leaves = jax.tree_util.tree_leaves(eng.params)
+    assert all(isinstance(v, jax.Array) for v in leaves)
+    dev = jax.devices()[0]
+    assert all(next(iter(v.devices())) == dev for v in leaves)
+    out = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=3))
+    assert len(out[0].output_token_ids) == 3
