@@ -28,6 +28,11 @@ import time
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from dlti_tpu.utils.platform import enable_compilation_cache
+
+enable_compilation_cache()
+
 V100_BASELINE_TOK_S = 2.93 * 512  # ~1500 tok/s (BASELINE.md)
 SEQ = int(os.environ.get("BENCH_SEQ", 512))
 STEPS = int(os.environ.get("BENCH_STEPS", 10))

@@ -13,10 +13,12 @@ import os
 
 
 def honor_platform_env() -> None:
-    """Re-assert ``JAX_PLATFORMS`` from the environment (no-op if unset).
+    """Re-assert ``JAX_PLATFORMS`` from the environment (no-op if unset)
+    and enable the persistent compilation cache.
 
     Call before any jax backend use in an entry point.
     """
+    enable_compilation_cache()
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
         return
@@ -26,6 +28,29 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass  # backend already up; the env var had its chance
+
+
+def enable_compilation_cache() -> None:
+    """Point XLA's persistent compilation cache at a stable location.
+
+    A 7B train-step compile costs minutes on the remote relay but replays
+    from this cache in milliseconds across processes (measured), so every
+    entry point enables it. Explicit ``JAX_COMPILATION_CACHE_DIR`` (or
+    ``DLTI_NO_COMPILE_CACHE=1``) wins.
+    """
+    if os.environ.get("DLTI_NO_COMPILE_CACHE", "").lower() in (
+            "1", "true", "yes"):
+        return
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dlti_tpu", "xla"))
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without these knobs
 
 
 def host_platform_env(n_devices: int, env: dict) -> dict:
