@@ -272,6 +272,11 @@ class InferenceEngine:
             raise ValueError(f"unknown speculative mode {ec.speculative!r}")
         self._sample_fn = jax.jit(sample_tokens)
 
+        # Batched per-slot key folding (the same fold the decode program
+        # applies to raw uint32 key data): one async dispatch instead of a
+        # synchronous device round trip per admitted row.
+        self._fold_keys = jax.jit(jax.vmap(jax.random.fold_in))
+
         # Aggregate stats for the /stats endpoint and load reports.
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
@@ -323,15 +328,19 @@ class InferenceEngine:
 
     def _build_prefill_fn(self, bucket: int):
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill(params, cache_kv, input_ids, positions, block_table, last_idx):
-            # input_ids/positions: (1, bucket); block_table: (1, nblk) —
+        def prefill(params, cache_kv, input_ids, positions, block_table,
+                    last_idx):
+            # input_ids/positions: (B, bucket); block_table: (B, nblk) —
             # sliced so attention's gathered window is bucket-sized, not
-            # max_model_len-sized.
+            # max_model_len-sized. B > 1 batches several admissions into
+            # one program call (padding rows carry position -1, whose
+            # writes slot_mapping drops); last_idx (B,) selects each
+            # row's final real logit.
             logits, new_kv = self._model_cache_call(
                 params, cache_kv, block_table, input_ids, positions
             )
-            last = jax.lax.dynamic_index_in_dim(logits[0], last_idx, axis=0,
-                                                keepdims=False)
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0]
             return new_kv, last
 
         return prefill
@@ -483,7 +492,14 @@ class InferenceEngine:
         return self.block_manager.allocate(n)
 
     def _admit(self) -> None:
-        """Admit waiting requests into free slots via bucketed prefill."""
+        """Admit waiting requests into free slots via bucketed prefill.
+
+        Admissions collected in one pass are prefilled in *batched*
+        program calls (grouped by suffix bucket): on a deep queue the
+        admission stall is a handful of model calls instead of one per
+        request — the dominant TTFT term once decode windows are long.
+        """
+        admissions: List[tuple] = []
         for slot in self.slots:
             if not self.waiting or not slot.free:
                 continue
@@ -507,27 +523,26 @@ class InferenceEngine:
                 self.stats["prefix_cached_tokens"] += n_cached
                 self.prefix_cache.record_hit(cached_blocks)
             self.waiting.popleft()
-            self._prefill_into(slot, req, cached_blocks + blocks, n_cached)
+            admissions.append((slot, req, cached_blocks + blocks, n_cached))
 
-    def _prefill_into(self, slot: _Slot, req: Request, blocks: List[int],
-                      n_cached: int = 0) -> None:
+        by_bucket: Dict[int, List[tuple]] = {}
+        for adm in admissions:
+            slot, req, blocks, n_cached = adm
+            suffix_len = (len(req.prompt_token_ids)
+                          + len(req.output_token_ids) - n_cached)
+            by_bucket.setdefault(self._bucket_for(suffix_len), []).append(adm)
+        for bucket, group in by_bucket.items():
+            # Chunk very wide admission waves: past ~8 rows the batched
+            # program's marginal win flattens while its padded work and
+            # jit-shape surface keep growing.
+            for i in range(0, len(group), 8):
+                self._prefill_group(bucket, group[i:i + 8])
+
+    def _register_slot(self, slot: _Slot, req: Request, blocks: List[int],
+                       n: int) -> None:
+        """Host-side bookkeeping for an admitted request (block table row,
+        sampling params, per-slot key + generated-token count)."""
         ec = self.cfg
-        # On re-admission after preemption the generated-so-far tokens are
-        # part of the recomputed prompt (vLLM recompute semantics). With a
-        # prefix-cache hit the first n_cached tokens' KV already sit in
-        # shared blocks — only the suffix is prefilled.
-        tokens = req.prompt_token_ids + req.output_token_ids
-        n = len(tokens)
-        suffix = tokens[n_cached:]
-        bucket = self._bucket_for(len(suffix))
-        # Block-table width for this call: quantized so jit specializations
-        # stay O(log^2) over (suffix bucket, table bucket).
-        nblk_needed = self.block_manager.blocks_needed(n)
-        nblk_bucket = 1
-        while nblk_bucket < nblk_needed:
-            nblk_bucket *= 2
-        nblk_bucket = min(nblk_bucket, ec.max_blocks_per_seq)
-
         slot.request = req
         slot.blocks = blocks
         slot.seq_len = n
@@ -548,32 +563,76 @@ class InferenceEngine:
         # preemption, so the seeded draw stream continues where it left off).
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
 
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, : len(suffix)] = suffix
-        pos = np.full((1, bucket), -1, np.int32)
-        pos[0, : len(suffix)] = np.arange(n_cached, n)
-        bt = np.zeros((1, nblk_bucket), np.int32)
-        bt[0, : min(len(blocks), nblk_bucket)] = blocks[:nblk_bucket]
+    def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
+        """Batched bucketed prefill: one program call for every admission
+        sharing a suffix bucket.
+
+        On re-admission after preemption the generated-so-far tokens are
+        part of the recomputed prompt (vLLM recompute semantics); with a
+        prefix-cache hit only the suffix past the cached blocks is
+        prefilled. Rows are padded to a power of two — padding rows carry
+        position -1 everywhere, which slot_mapping turns into dropped
+        writes — and each row's first generated token is sampled from its
+        final real logit in one batched sample call.
+        """
+        ec = self.cfg
+        B = 1
+        while B < len(group):
+            B *= 2
+        rows = []
+        nblk_needed = 1
+        for slot, req, blocks, n_cached in group:
+            tokens = req.prompt_token_ids + req.output_token_ids
+            n = len(tokens)
+            self._register_slot(slot, req, blocks, n)
+            rows.append((slot, req, tokens[n_cached:], n, n_cached))
+            nblk_needed = max(nblk_needed, self.block_manager.blocks_needed(n))
+        # Block-table width quantized so jit specializations stay
+        # O(log^2) over (suffix bucket, table bucket) x O(log) batch.
+        nblk_bucket = 1
+        while nblk_bucket < nblk_needed:
+            nblk_bucket *= 2
+        nblk_bucket = min(nblk_bucket, ec.max_blocks_per_seq)
+
+        ids = np.zeros((B, bucket), np.int32)
+        pos = np.full((B, bucket), -1, np.int32)  # -1 -> write dropped
+        bt = np.zeros((B, nblk_bucket), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        slot_keys = np.zeros((B, 2), np.uint32)
+        counts = np.zeros((B,), np.int32)
+        temps = np.ones((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        for r, (slot, req, suffix, n, n_cached) in enumerate(rows):
+            ids[r, : len(suffix)] = suffix
+            pos[r, : len(suffix)] = np.arange(n_cached, n)
+            bt[r, : min(len(slot.blocks), nblk_bucket)] = \
+                slot.blocks[:nblk_bucket]
+            last_idx[r] = len(suffix) - 1
+            slot_keys[r] = self._slot_keys[slot.slot_id]
+            counts[r] = self._gen_counts[slot.slot_id]
+            temps[r] = req.params.temperature
+            top_k[r] = req.params.top_k
+            top_p[r] = req.params.top_p
+            self.stats["prefill_tokens"] += len(suffix)
 
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
         self.cache, last_logits = self._prefill_fns[bucket](
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(bt), jnp.int32(len(suffix) - 1),
+            jnp.asarray(bt), jnp.asarray(last_idx),
         )
-        self.stats["prefill_tokens"] += len(suffix)
-
-        # Sample the first generated token from the prefill logits, using the
-        # same per-slot key + count stream the decode path uses.
-        sub = jax.random.fold_in(jnp.asarray(self._slot_keys[slot.slot_id]),
-                                 int(self._gen_counts[slot.slot_id]))
-        tok, lp = self._sample_fn(
-            last_logits[None, :], sub,
-            jnp.asarray([req.params.temperature], jnp.float32),
-            jnp.asarray([req.params.top_k], jnp.int32),
-            jnp.asarray([req.params.top_p], jnp.float32),
+        # Same per-slot key + count stream the decode path uses, folded in
+        # one async dispatch (no host round trip per row).
+        keys = self._fold_keys(jnp.asarray(slot_keys), jnp.asarray(counts))
+        toks, lps = self._sample_fn(
+            last_logits, keys, jnp.asarray(temps),
+            jnp.asarray(top_k), jnp.asarray(top_p),
         )
-        self._append_token(slot, int(tok[0]), float(lp[0]))
+        toks = np.asarray(jax.device_get(toks))
+        lps = np.asarray(jax.device_get(lps))
+        for r, (slot, req, suffix, n, n_cached) in enumerate(rows):
+            self._append_token(slot, int(toks[r]), float(lps[r]))
 
     def _decode_step(self) -> List[Request]:
         ec = self.cfg

@@ -526,3 +526,26 @@ def test_engine_commits_host_params_to_device(tiny_model_and_params):
     assert all(next(iter(v.devices())) == dev for v in leaves)
     out = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=3))
     assert len(out[0].output_token_ids) == 3
+
+
+def test_batched_admission_matches_sequential(tiny_model_and_params):
+    """Admitting N requests in one step (one batched prefill call per
+    bucket) must produce the same greedy tokens as admitting them one at
+    a time (stepping between submissions)."""
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=64, max_model_len=48,
+                      cache_dtype="float32", eos_token_id=-1)
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8], [9, 9, 8], [1, 2, 3, 4]]
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    batched = InferenceEngine(CFG, params, ec).generate(prompts, sp)
+
+    seq_engine = InferenceEngine(CFG, params, ec)
+    reqs = []
+    for p in prompts:  # force one-at-a-time admission
+        reqs.append(seq_engine.submit(p, sp))
+        seq_engine.step()
+    while seq_engine.has_work:
+        seq_engine.step()
+    for b, r in zip(batched, reqs):
+        assert b.output_token_ids == r.output_token_ids
