@@ -33,7 +33,9 @@ pytestmark = pytest.mark.slow
 
 def _cfg(tmp_path, **train_kwargs):
     defaults = dict(num_epochs=1, micro_batch_size=8, grad_accum_steps=2,
-                    logging_steps=100, max_steps=8)
+                    logging_steps=100, max_steps=8,
+                    # never append to the repo's committed metrics CSV
+                    metrics_csv=str(tmp_path / "metrics.csv"))
     defaults.update(train_kwargs)
     return Config(
         model=MODEL_PRESETS["llama_tiny"],
