@@ -11,3 +11,7 @@ from dlti_tpu.data.pipeline import (  # noqa: F401
     make_batches,
     tokenize_and_truncate,
 )
+from dlti_tpu.data.streaming import (  # noqa: F401
+    StreamingTokenDataset,
+    write_token_store,
+)

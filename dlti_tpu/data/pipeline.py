@@ -188,8 +188,78 @@ def packed_positions(segment_ids: np.ndarray) -> np.ndarray:
     return (idx - start_idx).astype(np.int32)
 
 
+class HostShardedSchedule:
+    """Per-host sharding + seeded epoch shuffle + ``skip_steps`` resume.
+
+    Shared by :class:`TokenBatchDataset` and
+    :class:`~dlti_tpu.data.streaming.StreamingTokenDataset` so the row
+    *schedule* (shard split, epoch permutation, resume skip) cannot
+    desynchronize between the in-memory and disk-backed paths. Note the
+    shared piece is the schedule over rows, not row construction: in packed
+    mode the two paths build rows from different document orders
+    (TokenBatchDataset pre-shuffles the corpus before packing; the store
+    writer packs in arrival order), so a packed checkpoint resumes
+    byte-identically only against the same dataset kind it was trained
+    with. Unpacked rows are identical either way.
+
+    Subclasses call :meth:`_init_procs` early (fail fast, before any
+    expensive row construction), then :meth:`_init_host_shard` with their
+    row count, and implement
+    ``_gather(row_indices) -> {field: (n, seq_len) array}``.
+    """
+
+    def _init_procs(self, shard_by_host: bool) -> None:
+        import jax
+
+        self._procs = jax.process_count() if shard_by_host else 1
+        self._proc_id = jax.process_index() if shard_by_host else 0
+        if self.micro_batch_size % self._procs != 0:
+            raise ValueError(
+                f"global micro_batch_size {self.micro_batch_size} must be "
+                f"divisible by process_count {self._procs}"
+            )
+
+    def _init_host_shard(self, n_rows: int, shard_by_host: bool) -> None:
+        if not hasattr(self, "_procs"):
+            self._init_procs(shard_by_host)
+        # Equal per-host shard (every host must agree on steps_per_epoch:
+        # a ragged split would deadlock collectives on the last step).
+        per_host = n_rows // self._procs
+        self._row_range = (self._proc_id * per_host,
+                           (self._proc_id + 1) * per_host)
+
+    @property
+    def samples_per_step(self) -> int:
+        """Global samples consumed per optimizer step."""
+        return self.micro_batch_size * self.grad_accum_steps
+
+    @property
+    def _host_samples_per_step(self) -> int:
+        return self.samples_per_step // self._procs
+
+    def steps_per_epoch(self) -> int:
+        lo, hi = self._row_range
+        return (hi - lo) // self._host_samples_per_step
+
+    def epoch(self, epoch_idx: int = 0, skip_steps: int = 0) -> Iterator[dict]:
+        lo, hi = self._row_range
+        order = np.arange(lo, hi)
+        if self.shuffle_seed is not None:
+            # Same permutation on every host of the *local* range.
+            rng = np.random.default_rng(self.shuffle_seed + epoch_idx)
+            rng.shuffle(order)
+        chunk = self._host_samples_per_step
+        bs_local = self.micro_batch_size // self._procs
+        shape = (self.grad_accum_steps, bs_local, self.seq_len)
+        for step_i, start in enumerate(range(0, len(order) - chunk + 1, chunk)):
+            if step_i < skip_steps:
+                continue
+            fields = self._gather(order[start : start + chunk])
+            yield {k: v.reshape(shape) for k, v in fields.items()}
+
+
 @dataclasses.dataclass
-class TokenBatchDataset:
+class TokenBatchDataset(HostShardedSchedule):
     """In-memory tokenized dataset yielding train-step-shaped batches.
 
     Yields dicts with ``input_ids`` / ``loss_mask`` (and, when packing,
@@ -211,16 +281,7 @@ class TokenBatchDataset:
     pack: bool = False
 
     def __post_init__(self) -> None:
-        import jax
-
-        self._procs = jax.process_count() if self.shard_by_host else 1
-        self._proc_id = jax.process_index() if self.shard_by_host else 0
-        if self.micro_batch_size % self._procs != 0:
-            raise ValueError(
-                f"global micro_batch_size {self.micro_batch_size} must be "
-                f"divisible by process_count {self._procs}"
-            )
-        rows: List[List[int]]
+        self._init_procs(self.shard_by_host)  # validate before packing
         if self.pack:
             # Pack once over the (seed-shuffled) corpus; epochs reshuffle rows.
             order = np.arange(len(self.sequences))
@@ -234,23 +295,7 @@ class TokenBatchDataset:
         else:
             self._packed = None
             n_rows = len(self.sequences)
-        # Equal per-host shard (every host must agree on steps_per_epoch:
-        # a ragged split would deadlock collectives on the last step).
-        per_host = n_rows // self._procs
-        self._row_range = (self._proc_id * per_host, (self._proc_id + 1) * per_host)
-
-    @property
-    def samples_per_step(self) -> int:
-        """Global samples consumed per optimizer step."""
-        return self.micro_batch_size * self.grad_accum_steps
-
-    @property
-    def _host_samples_per_step(self) -> int:
-        return self.samples_per_step // self._procs
-
-    def steps_per_epoch(self) -> int:
-        lo, hi = self._row_range
-        return (hi - lo) // self._host_samples_per_step
+        self._init_host_shard(n_rows, self.shard_by_host)
 
     def _row(self, j: int) -> tuple:
         if self._packed is not None:
@@ -260,28 +305,16 @@ class TokenBatchDataset:
         ids, mask = pad_to_batch([s], self.seq_len, self.pad_id)
         return ids[0], mask[0], None, None
 
-    def epoch(self, epoch_idx: int = 0, skip_steps: int = 0) -> Iterator[dict]:
-        lo, hi = self._row_range
-        order = np.arange(lo, hi)
-        if self.shuffle_seed is not None:
-            # Same permutation on every host of the *local* range.
-            rng = np.random.default_rng(self.shuffle_seed + epoch_idx)
-            rng.shuffle(order)
-        chunk = self._host_samples_per_step
-        bs_local = self.micro_batch_size // self._procs
-        shape = (self.grad_accum_steps, bs_local, self.seq_len)
-        for step_i, start in enumerate(range(0, len(order) - chunk + 1, chunk)):
-            if step_i < skip_steps:
-                continue
-            rows = [self._row(j) for j in order[start : start + chunk]]
-            batch = {
-                "input_ids": np.stack([r[0] for r in rows]).reshape(shape),
-                "loss_mask": np.stack([r[1] for r in rows]).reshape(shape),
-            }
-            if self._packed is not None:
-                batch["segment_ids"] = np.stack([r[2] for r in rows]).reshape(shape)
-                batch["positions"] = np.stack([r[3] for r in rows]).reshape(shape)
-            yield batch
+    def _gather(self, row_indices: np.ndarray) -> dict:
+        rows = [self._row(j) for j in row_indices]
+        fields = {
+            "input_ids": np.stack([r[0] for r in rows]),
+            "loss_mask": np.stack([r[1] for r in rows]),
+        }
+        if self._packed is not None:
+            fields["segment_ids"] = np.stack([r[2] for r in rows])
+            fields["positions"] = np.stack([r[3] for r in rows])
+        return fields
 
 
 def make_batches(

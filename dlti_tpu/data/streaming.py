@@ -1,0 +1,192 @@
+"""Disk-backed (memory-mapped) token store for corpus-scale training.
+
+The reference memory-maps its dataset through the HF ``datasets`` Arrow
+backend (``/root/reference/scripts/prepare_dataset.py:92`` ``save_to_disk``
++ ``load_from_disk`` in every trainer) — the corpus never has to fit in
+host RAM. :class:`~dlti_tpu.data.pipeline.TokenBatchDataset` holds the
+tokenized corpus in memory, which is fine at the reference's 136k docs but
+not the honest equivalent at corpus scale. This module is that equivalent:
+
+* :func:`write_token_store` streams documents (an *iterator* of token
+  lists — nothing is accumulated) into flat binary row files, packing in
+  bounded chunks along the way, so the writer's working set is one chunk
+  regardless of corpus size.
+* :class:`StreamingTokenDataset` ``np.memmap``-s the row files and yields
+  batches through the same schedule machinery as :class:`TokenBatchDataset`
+  (shared :class:`~dlti_tpu.data.pipeline.HostShardedSchedule`: per-host
+  sharding, seeded epoch shuffle, ``skip_steps`` resume) while holding only
+  O(rows) index memory (8 bytes per row for the epoch permutation), never
+  the tokens. Unpacked batches are byte-identical to the in-memory
+  dataset's; packed rows are built in arrival order (the in-memory packer
+  pre-shuffles first), so packed checkpoints resume against the same
+  dataset kind they were trained with.
+
+Store layout (``<dir>/``):
+    meta.json     {"n_rows", "seq_len", "pad_id", "packed", "version"}
+    ids.bin       int32 (n_rows, seq_len) row tokens (padded)
+    lengths.bin   int32 (n_rows,)         real-token count   [unpacked]
+    segs.bin      int32 (n_rows, seq_len) segment ids        [packed]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from dlti_tpu.data.pipeline import (
+    HostShardedSchedule,
+    pack_sequences,
+    packed_loss_mask,
+    packed_positions,
+    pad_to_batch,
+)
+
+_VERSION = 1
+
+
+def write_token_store(
+    token_docs: Iterable[List[int]],
+    directory: str,
+    *,
+    seq_len: int,
+    pad_id: int,
+    pack: bool = False,
+    chunk_docs: int = 8192,
+    tokenizer: Optional[str] = None,
+) -> dict:
+    """Stream ``token_docs`` into a memory-mappable row store.
+
+    Documents are consumed strictly one chunk (``chunk_docs``) at a time;
+    packed mode packs each chunk independently (the C++ packer when built),
+    so packing efficiency is within one open-row window of the in-memory
+    packer at a fraction of its footprint. Returns the meta dict.
+    """
+    os.makedirs(directory, exist_ok=True)
+    ids_path = os.path.join(directory, "ids.bin")
+    aux_path = os.path.join(directory, "segs.bin" if pack else "lengths.bin")
+    n_rows = 0
+    max_doc_len = 0
+    with open(ids_path, "wb") as f_ids, open(aux_path, "wb") as f_aux:
+        chunk: List[List[int]] = []
+
+        def flush():
+            nonlocal n_rows
+            if not chunk:
+                return
+            if pack:
+                ids, _, segs = pack_sequences(chunk, seq_len, pad_id)
+                f_aux.write(np.ascontiguousarray(segs, np.int32).tobytes())
+            else:
+                # Same padding/truncation code path as the in-memory
+                # dataset — the parity contract is structural, not copied.
+                ids, mask = pad_to_batch(chunk, seq_len, pad_id)
+                f_aux.write(mask.sum(1, dtype=np.int32).tobytes())
+            f_ids.write(np.ascontiguousarray(ids, np.int32).tobytes())
+            n_rows += ids.shape[0]
+            chunk.clear()
+
+        for doc in token_docs:
+            # pack_sequences drops empty docs; unpacked mode must keep them
+            # as all-pad rows for row-count parity with TokenBatchDataset.
+            if pack and not doc:
+                continue
+            chunk.append(list(doc))
+            max_doc_len = max(max_doc_len, min(len(doc), seq_len))
+            if len(chunk) >= chunk_docs:
+                flush()
+        flush()
+
+    meta = {"n_rows": n_rows, "seq_len": seq_len, "pad_id": pad_id,
+            "packed": pack, "version": _VERSION,
+            # Bound on any (truncated) document's tokens: lets training
+            # run packed attention with an exact window of this size
+            # (ModelConfig.packed_attention_window).
+            "max_doc_len": max_doc_len}
+    if tokenizer is not None:
+        # Recorded so consumers can fail fast on a tokenizer mismatch
+        # (ids from the wrong vocab gather-clamp silently under jit).
+        meta["tokenizer"] = tokenizer
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+@dataclasses.dataclass
+class StreamingTokenDataset(HostShardedSchedule):
+    """Memory-mapped drop-in for :class:`TokenBatchDataset`.
+
+    Same batch shapes ((accum, micro_bs, seq_len) dicts), same per-host
+    sharding (equal shard per process), same seeded epoch shuffle and
+    ``skip_steps`` resume contract — but rows are read from disk on
+    demand; host RAM holds only the epoch permutation.
+
+    ``expect_tokenizer``: when the store's meta records the tokenizer it
+    was written with, a mismatch raises here instead of gather-clamping
+    wrong-vocab ids silently under jit.
+    """
+
+    directory: str
+    micro_batch_size: int
+    grad_accum_steps: int = 1
+    shuffle_seed: Optional[int] = 0
+    shard_by_host: bool = True
+    expect_tokenizer: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        with open(os.path.join(self.directory, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("version") != _VERSION:
+            raise ValueError(f"unknown token-store version {meta.get('version')}")
+        self.tokenizer_name = meta.get("tokenizer")
+        self.max_doc_len = int(meta.get("max_doc_len", 0))
+        if (self.expect_tokenizer is not None
+                and self.tokenizer_name is not None
+                and self.tokenizer_name != self.expect_tokenizer):
+            raise ValueError(
+                f"token store at {self.directory!r} was written with "
+                f"tokenizer {self.tokenizer_name!r} but the run expects "
+                f"{self.expect_tokenizer!r}; ids from the wrong vocab "
+                f"would be clamped silently"
+            )
+        self.seq_len = int(meta["seq_len"])
+        self.pad_id = int(meta["pad_id"])
+        self.packed = bool(meta["packed"])
+        n_rows = int(meta["n_rows"])
+        if n_rows == 0:
+            raise ValueError(
+                f"token store at {self.directory!r} is empty (n_rows=0) — "
+                "was write_token_store given an empty document iterator?"
+            )
+
+        self._ids = np.memmap(os.path.join(self.directory, "ids.bin"),
+                              np.int32, "r", shape=(n_rows, self.seq_len))
+        if self.packed:
+            self._segs = np.memmap(os.path.join(self.directory, "segs.bin"),
+                                   np.int32, "r", shape=(n_rows, self.seq_len))
+            self._lens = None
+        else:
+            self._segs = None
+            self._lens = np.memmap(os.path.join(self.directory, "lengths.bin"),
+                                   np.int32, "r", shape=(n_rows,))
+
+        self._init_host_shard(n_rows, self.shard_by_host)
+
+    def _gather(self, row_indices: np.ndarray) -> dict:
+        rows = np.sort(row_indices)  # monotone reads off the memmap
+        unsort = np.argsort(np.argsort(row_indices))
+        ids = np.asarray(self._ids[rows])[unsort]
+        fields = {"input_ids": ids}
+        if self.packed:
+            segs = np.asarray(self._segs[rows])[unsort]
+            fields["loss_mask"] = packed_loss_mask(segs)
+            fields["segment_ids"] = segs
+            fields["positions"] = packed_positions(segs)
+        else:
+            lens = np.asarray(self._lens[rows])[unsort]
+            fields["loss_mask"] = (np.arange(self.seq_len)[None, :]
+                                   < lens[:, None]).astype(np.int32)
+        return fields

@@ -238,36 +238,31 @@ def main() -> None:
         # --write-token-store): corpus-scale input, O(rows) host RAM.
         from dlti_tpu.data.streaming import StreamingTokenDataset
 
-        dataset = StreamingTokenDataset(
-            args.dataset_path,
-            micro_batch_size=cfg.train.micro_batch_size,
-            grad_accum_steps=cfg.train.grad_accum_steps,
-            shuffle_seed=cfg.data.shuffle_seed,
-        )
         # Fail fast on config mismatches: the rows are baked at prepare
-        # time, so the run config must match them (wrong-vocab ids would
-        # gather-clamp silently; a different seq_len silently changes the
-        # workload).
-        with open(os.path.join(args.dataset_path, "meta.json")) as f:
-            meta = json.load(f)
+        # time, so the run config must match them (a tokenizer mismatch
+        # raises inside the dataset; a different seq_len silently changes
+        # the workload).
+        try:
+            dataset = StreamingTokenDataset(
+                args.dataset_path,
+                micro_batch_size=cfg.train.micro_batch_size,
+                grad_accum_steps=cfg.train.grad_accum_steps,
+                shuffle_seed=cfg.data.shuffle_seed,
+                expect_tokenizer=cfg.data.tokenizer,
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))
         if dataset.seq_len != cfg.data.max_seq_len:
             raise SystemExit(
                 f"token store {args.dataset_path} was written with "
                 f"seq_len={dataset.seq_len}, but --max-seq-len is "
                 f"{cfg.data.max_seq_len}; re-prepare or pass the matching "
                 f"--max-seq-len")
-        store_tok = meta.get("tokenizer")
-        if store_tok is not None and store_tok != cfg.data.tokenizer:
-            raise SystemExit(
-                f"token store {args.dataset_path} was tokenized with "
-                f"{store_tok!r} but --tokenizer is "
-                f"{cfg.data.tokenizer!r}; ids from the wrong vocab would "
-                f"be clamped silently")
         print(f"dataset: memory-mapped token store {args.dataset_path} "
               f"({dataset._ids.shape[0]} rows x {dataset.seq_len}, "
               f"packed={dataset.packed})")
         if dataset.packed:
-            cfg = _apply_packed_window(cfg, meta.get("max_doc_len", 0))
+            cfg = _apply_packed_window(cfg, dataset.max_doc_len)
     else:
         texts = load_texts(args.dataset_path)
         print(f"dataset: {len(texts)} examples from {args.dataset_path}")

@@ -58,6 +58,33 @@ def test_streaming_matches_in_memory(tmp_path, pack):
             np.testing.assert_array_equal(b_disk[k], b_mem[k], err_msg=k)
 
 
+def test_streaming_keeps_empty_docs_in_unpacked_mode(tmp_path):
+    """Row-count parity: TokenBatchDataset keeps empty docs as all-pad rows
+    in unpacked mode, so the writer must too (packed mode drops them, same
+    as pack_sequences)."""
+    docs = _docs(16)
+    docs[5] = []
+    store = str(tmp_path / "store")
+    meta = write_token_store(iter(docs), store, seq_len=32, pad_id=0)
+    assert meta["n_rows"] == len(docs)
+    mem = TokenBatchDataset(sequences=docs, seq_len=32, pad_id=0,
+                            micro_batch_size=4, shuffle_seed=5,
+                            shard_by_host=False)
+    disk = StreamingTokenDataset(store, micro_batch_size=4, shuffle_seed=5,
+                                 shard_by_host=False)
+    assert disk.steps_per_epoch() == mem.steps_per_epoch()
+    for b_mem, b_disk in zip(mem.epoch(0), disk.epoch(0)):
+        np.testing.assert_array_equal(b_disk["input_ids"], b_mem["input_ids"])
+        np.testing.assert_array_equal(b_disk["loss_mask"], b_mem["loss_mask"])
+
+
+def test_empty_store_raises_clearly(tmp_path):
+    store = str(tmp_path / "store")
+    write_token_store(iter([]), store, seq_len=32, pad_id=0)
+    with pytest.raises(ValueError, match="empty"):
+        StreamingTokenDataset(store, micro_batch_size=4, shard_by_host=False)
+
+
 def test_streaming_resume_skip_steps(tmp_path):
     store = str(tmp_path / "store")
     write_token_store(iter(_docs()), store, seq_len=32, pad_id=0)
