@@ -28,7 +28,12 @@ import time
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# Source checkout wins over any installed copy; an installed dlti-tpu
+# serves scripts run from outside a checkout.
+_repo_root = os.path.dirname(os.path.abspath(__file__))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
 from dlti_tpu.utils.platform import enable_compilation_cache
 
 enable_compilation_cache()
@@ -38,18 +43,40 @@ SEQ = int(os.environ.get("BENCH_SEQ", 512))
 STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
 
-def _try_run(model_name: str, micro_bs: int):
+def _try_run(model_name: str, micro_bs: int, quant: str = "",
+             remat_policy: str = "", remat_stride: int = 0):
+    import dataclasses
+
     from dlti_tpu.config import MODEL_PRESETS, LoRAConfig, OptimizerConfig
     from dlti_tpu.models import LlamaForCausalLM, count_params
     from dlti_tpu.training import build_optimizer, create_train_state, make_train_step
 
+    if quant not in ("", "int8"):
+        raise ValueError(f"unknown BENCH_QUANT={quant!r} (only '' or 'int8')")
     cfg = MODEL_PRESETS[model_name]
+    overrides = {}
+    if remat_policy == "none":
+        overrides["remat"] = False
+    elif remat_policy:
+        overrides["remat_policy"] = remat_policy
+    if remat_stride:
+        overrides["remat_stride"] = remat_stride
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
     model = LlamaForCausalLM(cfg, LoRAConfig())
     tx = build_optimizer(OptimizerConfig())
     rng = jax.random.PRNGKey(0)
     state = create_train_state(rng, model, tx, (micro_bs, SEQ))
     jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
     trainable, total = count_params(state.params)
+    if quant == "int8":
+        # Frozen-base weight-only int8 (TrainConfig.quantize_frozen_base):
+        # halves base-weight HBM so activation saving fits.
+        from dlti_tpu.models.quantization import quantize_params_int8
+
+        state = state.replace(
+            params=quantize_params_int8(state.params, donate=True))
+        jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
 
     step = jax.jit(make_train_step(model, accum_steps=1), donate_argnums=(0,))
     batch = {
@@ -77,22 +104,49 @@ def _try_run(model_name: str, micro_bs: int):
 def main() -> None:
     from dlti_tpu.utils.metrics import compute_mfu, detect_chip_peak_flops
 
-    candidates = []
     if "BENCH_MODEL" in os.environ:
-        bs = int(os.environ.get("BENCH_BS", 1))
-        candidates = [(os.environ["BENCH_MODEL"], bs)]
+        quant = os.environ.get("BENCH_QUANT", "")
+        if quant not in ("", "int8"):
+            # Fail loudly here: the try-loop below treats exceptions as
+            # OOMs and would report "no config fit" with exit 0.
+            raise SystemExit(f"unknown BENCH_QUANT={quant!r} (only '' or 'int8')")
+        candidates = [dict(model=os.environ["BENCH_MODEL"],
+                           bs=int(os.environ.get("BENCH_BS", 1)),
+                           quant=quant,
+                           remat_policy=os.environ.get("BENCH_REMAT", ""),
+                           remat_stride=int(os.environ.get("BENCH_STRIDE", 0)))]
     else:
-        candidates = [("llama2_7b", 4), ("llama2_7b", 2), ("llama2_7b", 1),
-                      ("llama_1b", 8)]
+        # Ordered by measured throughput on the v5e-class 16 GB chip
+        # (results/mfu_investigation_r03.json): int8 frozen base frees
+        # ~6.7 GB of base-weight HBM, which buys activation saving
+        # (remat_policy / stride) — the binding constraint at bf16
+        # (results/mfu_investigation_r02.json). Winner: 51.6% MFU at bs4
+        # with matmul outputs saved (vs 40.8% bf16 in r02).
+        candidates = [
+            dict(model="llama2_7b", bs=4, quant="int8",
+                 remat_policy="dots_with_no_batch_dims_saveable"),
+            dict(model="llama2_7b", bs=8, quant="int8",
+                 remat_policy="save_attn_out", remat_stride=4),
+            dict(model="llama2_7b", bs=8, quant="int8",
+                 remat_policy="save_attn_out"),
+            dict(model="llama2_7b", bs=4, quant="int8"),
+            dict(model="llama2_7b", bs=4),
+            dict(model="llama2_7b", bs=2),
+            dict(model="llama2_7b", bs=1),
+            dict(model="llama_1b", bs=8),
+        ]
 
     result = None
-    for model_name, bs in candidates:
+    for c in candidates:
         try:
-            tok_s, dt, trainable, total, loss = _try_run(model_name, bs)
-            result = (model_name, bs, tok_s, dt, trainable, total, loss)
+            tok_s, dt, trainable, total, loss = _try_run(
+                c["model"], c["bs"], quant=c.get("quant", ""),
+                remat_policy=c.get("remat_policy", ""),
+                remat_stride=c.get("remat_stride", 0))
+            result = (c, tok_s, dt, trainable, total, loss)
             break
         except Exception as e:  # OOM or compile failure: try the next config
-            print(f"# bench: {model_name} bs={bs} failed: {type(e).__name__}: "
+            print(f"# bench: {c} failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", file=sys.stderr)
             continue
     if result is None:
@@ -101,7 +155,8 @@ def main() -> None:
                           "vs_baseline": 0.0, "error": "no config fit"}))
         return
 
-    model_name, bs, tok_s, dt, trainable, total, loss = result
+    c, tok_s, dt, trainable, total, loss = result
+    model_name, bs = c["model"], c["bs"]
     peak = detect_chip_peak_flops()
     mfu = compute_mfu(tok_s, total, peak, trainable_params=trainable)
 
@@ -125,6 +180,9 @@ def main() -> None:
         "mfu_percent": round(mfu, 2),
         "flops_normalized": normalized,
         "loss": round(loss, 4),
+        "quantize_frozen_base": c.get("quant", ""),
+        "remat_policy": c.get("remat_policy", ""),
+        "remat_stride": c.get("remat_stride", 0),
     }))
 
 

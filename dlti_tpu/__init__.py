@@ -21,7 +21,7 @@ The package name abbreviates the reference repo name
 since hyphens are not importable in Python.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from dlti_tpu.config import (  # noqa: F401
     Config,

@@ -267,6 +267,14 @@ class TrainConfig:
     # (pair with ModelConfig dtype="float16").
     fp16: bool = False
     fp16_initial_scale_power: int = 16
+    # Weight-only quantization of the *frozen* base params during LoRA
+    # training ("" = off, "int8" = symmetric per-channel int8 — the QLoRA
+    # idea, TPU-style). Grads flow only to the LoRA factors, so the base
+    # may rest compressed: a 7B bf16 base is ~13.5 GB of a 16 GB chip,
+    # int8 is ~6.8 GB — the freed HBM buys back remat recompute
+    # (activation saving), the measured MFU ceiling at bf16
+    # (results/mfu_investigation_r02.json). Requires lora.enabled.
+    quantize_frozen_base: str = ""
     fp16_scale_window: int = 1000
     fp16_hysteresis: int = 2
     fp16_min_scale: float = 1.0

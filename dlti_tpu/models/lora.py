@@ -120,7 +120,14 @@ def merge_lora_params(params, scaling: Optional[float] = None, alpha: int = 32):
     if isinstance(params, FrozenDict):
         params = params.unfreeze()
 
+    from dlti_tpu.models.quantization import is_quant_node, maybe_dequantize
+
     def _merge(tree):
+        if is_quant_node(tree):
+            # int8-frozen-base training: expand back to bf16 so the merged
+            # export is a standard compute-dtype tree (serving re-quantizes
+            # on load; int8->bf16->int8 round-trips to the same grid).
+            return maybe_dequantize(tree, jnp.bfloat16)
         if not isinstance(tree, dict):
             return tree
         out = {}
@@ -129,6 +136,8 @@ def merge_lora_params(params, scaling: Optional[float] = None, alpha: int = 32):
             if has_lora and k in ("lora_a", "lora_b"):
                 continue
             if has_lora and k == "kernel":
+                if is_quant_node(v):
+                    v = maybe_dequantize(v, jnp.bfloat16)
                 a = tree["lora_a"].astype(jnp.float32)
                 b = tree["lora_b"].astype(jnp.float32)
                 r = a.shape[-1]

@@ -37,7 +37,7 @@ from dlti_tpu.utils.metrics import (
     MetricsRecord,
     compute_mfu,
     detect_chip_peak_flops,
-    device_peak_memory_gb,
+    device_peak_memory,
     print_metrics_summary,
     save_training_metrics,
 )
@@ -66,6 +66,7 @@ class Trainer:
         # Preemption flag: set by SIGTERM (cluster eviction) or
         # request_stop(); honored at the next step boundary.
         self._stop_requested = False
+        self._last_eval_loss = float("nan")
 
     # ------------------------------------------------------------------
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -86,6 +87,21 @@ class Trainer:
 
             state = state.replace(
                 params=graft_base_params(state.params, self.base_params))
+        if self.cfg.train.quantize_frozen_base:
+            if self.cfg.train.quantize_frozen_base != "int8":
+                raise ValueError(
+                    f"unknown quantize_frozen_base="
+                    f"{self.cfg.train.quantize_frozen_base!r} (only 'int8')")
+            if not self.cfg.lora.enabled:
+                raise ValueError(
+                    "quantize_frozen_base requires LoRA: it compresses the "
+                    "frozen base params, and a full fine-tune has none")
+            from dlti_tpu.models.quantization import quantize_params_int8
+
+            # donate=True retires each bf16 source as its int8 twin lands,
+            # so quantizing a 7B tree never holds both copies in HBM.
+            state = state.replace(
+                params=quantize_params_int8(state.params, donate=True))
         if self.mesh is not None:
             state = shard_train_state(state, self.cfg, self.mesh)
         return state
@@ -134,6 +150,7 @@ class Trainer:
         import signal as _signal
 
         self._stop_requested = False  # a reused Trainer trains again
+        self._last_eval_loss = float("nan")
         prev_handler = None
         sigterm_installed = False
         try:
@@ -310,7 +327,7 @@ class Trainer:
         boundary (what the SIGTERM handler calls on preemption)."""
         self._stop_requested = True
 
-    def _run_eval(self, eval_fn, state, eval_dataset, step: int) -> None:
+    def _run_eval(self, eval_fn, state, eval_dataset, step: int) -> float:
         losses, toks = [], 0.0
         for batch in eval_dataset.epoch(0):
             flat = {
@@ -319,8 +336,11 @@ class Trainer:
             m = jax.device_get(eval_fn(state, flat))
             losses.append(float(m["loss"]) * float(m["num_tokens"]))
             toks += float(m["num_tokens"])
+        eval_loss = sum(losses) / toks if toks else float("nan")
         if toks and is_main_process():
-            self.logger.info("eval @ step %d | loss %.4f", step, sum(losses) / toks)
+            self.logger.info("eval @ step %d | loss %.4f", step, eval_loss)
+        self._last_eval_loss = eval_loss
+        return eval_loss
 
     def _maybe_save(self, state: TrainState, step: int, epoch_end: bool) -> None:
         cfg = self.cfg.checkpoint
@@ -354,6 +374,7 @@ class Trainer:
                        if cfg.model.num_experts > 0 else total)
         mfu = compute_mfu(tok_s_chip, n_for_flops, peak_flops,
                           trainable_params=trainable)
+        peak_gb, peak_src = device_peak_memory()
         return MetricsRecord(
             experiment=experiment_name_from_config(cfg),
             num_gpus=cfg.parallel.num_devices,
@@ -364,8 +385,10 @@ class Trainer:
             ),
             training_time_hours=wall / 3600.0,
             samples_per_second=sps,
-            peak_memory_gb=device_peak_memory_gb(),
+            peak_memory_gb=peak_gb,
             final_loss=final_loss,
             tokens_per_second_per_chip=tok_s_chip,
             mfu_percent=mfu,
+            peak_memory_source=peak_src,
+            eval_loss=getattr(self, "_last_eval_loss", float("nan")),
         )

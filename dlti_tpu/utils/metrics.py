@@ -45,6 +45,12 @@ class MetricsRecord:
     final_loss: float
     tokens_per_second_per_chip: float = 0.0
     mfu_percent: float = 0.0
+    # Where peak_memory_gb came from: "device" (PJRT memory stats — real
+    # HBM) or "host_rss" (process VmHWM fallback) — two different
+    # quantities that must not be read as one (see device_peak_memory).
+    peak_memory_source: str = "none"
+    # Held-out eval loss at the last eval (nan when eval never ran).
+    eval_loss: float = float("nan")
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -86,13 +92,16 @@ def detect_chip_peak_flops() -> float:
     return TPU_PEAK_FLOPS["cpu"]
 
 
-def device_peak_memory_gb() -> float:
-    """Peak device memory (the ``torch.cuda.max_memory_allocated`` analog,
-    reference ``train_baseline.py:253``).
+def device_peak_memory() -> tuple:
+    """Peak memory as ``(gb, source)`` (the
+    ``torch.cuda.max_memory_allocated`` analog, reference
+    ``train_baseline.py:253``).
 
-    CPU-simulated runs (and PJRT plugins that return no stats, like the
-    remote relay) fall back to the process's peak RSS so the reference CSV
-    schema's ``peak_memory_gb`` column is never silently zero.
+    ``source`` is ``"device"`` (PJRT memory stats — real HBM),
+    ``"host_rss"`` (process VmHWM fallback for CPU-simulated runs and PJRT
+    plugins that return no stats, like the remote relay), or ``"none"``.
+    Device HBM and host RSS are different quantities; consumers of the CSV
+    must be able to tell them apart, hence the explicit source.
     """
     import jax
 
@@ -101,28 +110,58 @@ def device_peak_memory_gb() -> float:
         if stats:
             peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
             if peak:
-                return peak / 1024**3
+                return peak / 1024**3, "device"
     except Exception:
         pass
     try:  # host fallback: peak resident set (VmHWM), linux procfs
         with open("/proc/self/status") as f:
             for line in f:
                 if line.startswith("VmHWM:"):
-                    return int(line.split()[1]) / 1024**2  # kB -> GB
+                    return int(line.split()[1]) / 1024**2, "host_rss"  # kB->GB
     except Exception:
         pass
-    return 0.0
+    return 0.0, "none"
 
 
 def save_training_metrics(metrics: MetricsRecord | dict,
                           csv_path: str = "results/training_metrics.csv") -> None:
-    """Append a row; write header on first write (``training/utils.py:51-69``)."""
+    """Append a row; write header on first write (``training/utils.py:51-69``).
+
+    Schema-tolerant: when the existing file's header differs (a column was
+    added since it was written), the file is rewritten under the union of
+    columns instead of appending misaligned rows.
+    """
     row = metrics.to_dict() if isinstance(metrics, MetricsRecord) else dict(metrics)
     os.makedirs(os.path.dirname(csv_path) or ".", exist_ok=True)
-    exists = os.path.isfile(csv_path)
+    old_fields: list = []
+    if os.path.isfile(csv_path):
+        with open(csv_path, newline="") as f:
+            old_fields = next(csv.reader(f), []) or []
+    if old_fields and set(old_fields) == set(row):
+        # Same columns (possibly reordered keys in a dict row): plain
+        # append in the file's own column order.
+        with open(csv_path, "a", newline="") as f:
+            csv.DictWriter(f, fieldnames=old_fields).writerow(row)
+        return
+    if old_fields and old_fields != list(row.keys()):
+        # Header changed (a column was added since the file was written):
+        # rewrite under the union of columns — via a temp file + atomic
+        # replace, so a preemption mid-rewrite can never destroy history.
+        with open(csv_path, newline="") as f:
+            old_rows = list(csv.DictReader(f))
+        fields = old_fields + [k for k in row if k not in old_fields]
+        tmp_path = csv_path + ".tmp"
+        with open(tmp_path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=fields, restval="")
+            writer.writeheader()
+            for r in old_rows:
+                writer.writerow(r)
+            writer.writerow(row)
+        os.replace(tmp_path, csv_path)
+        return
     with open(csv_path, "a", newline="") as f:
         writer = csv.DictWriter(f, fieldnames=list(row.keys()))
-        if not exists:
+        if not old_fields:
             writer.writeheader()
         writer.writerow(row)
 

@@ -35,7 +35,12 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Source checkout wins over any installed copy; an installed dlti-tpu
+# serves scripts run from outside a checkout.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
 
 from dlti_tpu.data import format_conversation_for_llama2
 

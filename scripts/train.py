@@ -35,7 +35,12 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Source checkout wins over any installed copy; an installed dlti-tpu
+# serves scripts run from outside a checkout.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
 
 from dlti_tpu.utils.platform import honor_platform_env
 
@@ -78,6 +83,10 @@ def parse_args():
     p.add_argument("--fp16", action="store_true",
                    help="fp16 + dynamic loss scaling parity mode (TPU default is "
                         "bf16, which needs no scaler — ds_config fp16 block)")
+    p.add_argument("--quantize-base", default="", choices=["", "int8"],
+                   help="store the frozen base params weight-only quantized "
+                        "during LoRA training (QLoRA-style); halves base "
+                        "HBM and buys activation-saving headroom")
     # Checkpointing (reference: save_steps=100, keep 3 — zero1:243-245).
     p.add_argument("--save-strategy", default="steps", choices=["steps", "epoch", "no"])
     p.add_argument("--save-steps", type=int, default=100)
@@ -93,6 +102,12 @@ def parse_args():
                    help="write the merged model as an HF-layout checkpoint after training")
     p.add_argument("--export-peft", default=None, metavar="DIR",
                    help="write the LoRA factors as a PEFT adapter after training")
+    p.add_argument("--eval-dataset", default=None, metavar="PATH",
+                   help="held-out dataset (same formats as --dataset-path); "
+                        "evaluated every --eval-steps optimizer steps")
+    p.add_argument("--eval-steps", type=int, default=0,
+                   help="eval cadence in steps (0 = never; requires "
+                        "--eval-dataset)")
     p.add_argument("--metrics-csv", default="results/training_metrics.csv")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logging-steps", type=int, default=10)
@@ -186,6 +201,8 @@ def build_config(args):
                           grad_accum_steps=args.gradient_accumulation_steps,
                           logging_steps=args.logging_steps, seed=args.seed,
                           metrics_csv=args.metrics_csv, fp16=args.fp16,
+                          quantize_frozen_base=args.quantize_base,
+                          eval_steps=args.eval_steps,
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,
                           profile_num_steps=args.profile_num_steps),
@@ -280,8 +297,63 @@ def main() -> None:
                 min(len(s), cfg.data.max_seq_len) for s in dataset.sequences))
     print(f"steps/epoch: {dataset.steps_per_epoch()}")
 
+    eval_dataset = None
+    if args.eval_dataset:
+        if not cfg.train.eval_steps:
+            raise SystemExit("--eval-dataset needs --eval-steps > 0")
+        if os.path.isfile(os.path.join(args.eval_dataset, "meta.json")):
+            # Same formats as --dataset-path: a token store evals directly.
+            from dlti_tpu.data import StreamingTokenDataset
+
+            try:
+                eval_dataset = StreamingTokenDataset(
+                    args.eval_dataset,
+                    micro_batch_size=cfg.train.micro_batch_size,
+                    grad_accum_steps=1,
+                    shuffle_seed=None,  # fixed order: eval loss is comparable
+                    expect_tokenizer=cfg.data.tokenizer,
+                )
+            except ValueError as e:
+                raise SystemExit(str(e))
+            if eval_dataset.seq_len != cfg.data.max_seq_len:
+                raise SystemExit(
+                    f"eval token store {args.eval_dataset} was written with "
+                    f"seq_len={eval_dataset.seq_len}, but --max-seq-len is "
+                    f"{cfg.data.max_seq_len}")
+            print(f"eval dataset: token store {args.eval_dataset} "
+                  f"({eval_dataset._ids.shape[0]} rows)")
+            if (eval_dataset.packed and cfg.model.packed_attention_window
+                    and eval_dataset.max_doc_len
+                    > cfg.model.packed_attention_window):
+                # The banded window is exact only if it covers the longest
+                # document either split contains; widen it to stay exact
+                # for eval (>= seq_len disables the band entirely).
+                widened = (0 if eval_dataset.max_doc_len
+                           >= cfg.data.max_seq_len
+                           else eval_dataset.max_doc_len)
+                cfg = cfg.replace(model=dataclasses.replace(
+                    cfg.model, packed_attention_window=widened))
+                print(f"packed attention window widened to {widened or 'off'}"
+                      f" (eval corpus max doc length)")
+        else:
+            eval_texts = load_texts(args.eval_dataset)
+            print(f"eval dataset: {len(eval_texts)} examples from "
+                  f"{args.eval_dataset}")
+            eval_dataset = make_batches(
+                eval_texts, get_tokenizer(cfg.data.tokenizer),
+                seq_len=cfg.data.max_seq_len,
+                micro_batch_size=cfg.train.micro_batch_size,
+                grad_accum_steps=1,
+                shuffle_seed=None,  # fixed order: eval loss is comparable
+            )
+        if eval_dataset.steps_per_epoch() == 0:
+            raise SystemExit(
+                f"eval dataset yields zero batches: it has fewer rows than "
+                f"one global batch ({cfg.train.micro_batch_size}); shrink "
+                f"--per-device-batch-size or grow the eval split")
+
     trainer = Trainer(cfg, base_params=base_params)
-    state, record = trainer.train(dataset=dataset)
+    state, record = trainer.train(dataset=dataset, eval_dataset=eval_dataset)
 
     if args.export_dir:
         from dlti_tpu.checkpoint import export_merged_model

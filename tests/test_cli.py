@@ -96,6 +96,30 @@ def test_train_cli_writes_reference_schema(trained_csv):
     assert df["final_loss"].notna().all()
 
 
+def test_train_cli_eval_loop(workdir, prepared_data):
+    """--eval-dataset/--eval-steps reach Trainer._run_eval and the metrics
+    CSV carries the eval_loss column (VERDICT r02 weak #7)."""
+    csv = workdir / "metrics_eval.csv"
+    proc = _run([
+        "scripts/train.py", "--preset", "baseline", "--num-devices", "1",
+        "--model", "llama_tiny", "--tokenizer", "byte",
+        "--dataset-path", str(prepared_data),
+        "--eval-dataset", str(prepared_data), "--eval-steps", "2",
+        "--max-steps", "2", "--max-seq-len", "64", "--lora-r", "4",
+        "--gradient-accumulation-steps", "1", "--warmup-steps", "1",
+        "--save-strategy", "no", "--metrics-csv", str(csv),
+        "--output-dir", str(workdir / "ckpt_eval"),
+    ])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "eval @ step 2" in proc.stderr + proc.stdout
+    import pandas as pd
+
+    df = pd.read_csv(csv)
+    assert "eval_loss" in df.columns and df["eval_loss"].notna().all()
+    assert "peak_memory_source" in df.columns
+    assert df["peak_memory_source"].isin(["device", "host_rss", "none"]).all()
+
+
 def test_compare_cli(workdir, trained_csv):
     plot = workdir / "plots" / "cmp.png"
     proc = _run(["scripts/compare_training.py", "--csv", str(trained_csv),
