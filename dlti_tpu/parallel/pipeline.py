@@ -298,3 +298,28 @@ def make_pipeline_train_step(
         ), metrics
 
     return jax.jit(step, donate_argnums=(0,))
+
+
+def make_pipeline_eval_step(cfg: Config, mesh: Mesh) -> Callable:
+    """``eval_step(state, batch) -> metrics`` on pipeline-layout params.
+
+    Runs :func:`pipeline_forward` deterministically with a single
+    microbatch (the full eval batch flows through the stages once; the
+    (P-1)/P bubble is irrelevant at eval cadence) — the pipe-mesh analog
+    of :func:`dlti_tpu.training.step.make_eval_step`.
+    """
+    from dlti_tpu.training.step import causal_lm_loss
+
+    lora = cfg.lora if cfg.lora.enabled else None
+
+    def eval_step(state, batch):
+        logits = pipeline_forward(
+            state.params, batch["input_ids"], cfg.model, mesh, lora=lora,
+            num_microbatches=1, deterministic=True,
+        )
+        loss_sum, n_tok = causal_lm_loss(
+            logits, batch["input_ids"], batch.get("loss_mask"))
+        return {"loss": loss_sum / jnp.maximum(n_tok, 1.0),
+                "num_tokens": n_tok}
+
+    return jax.jit(eval_step)

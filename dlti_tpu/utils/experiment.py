@@ -53,4 +53,8 @@ def get_zero_stage_from_config(config_path: str) -> Optional[int]:
 def experiment_name_from_config(cfg: Config) -> str:
     if cfg.experiment_name:
         return cfg.experiment_name
+    if cfg.parallel.pipe > 1:
+        # Pipeline runs must not masquerade as the single-device baseline
+        # in the metrics CSV (zero_stage is 0 under pure pipe).
+        return f"pipe{cfg.parallel.pipe}_{cfg.parallel.num_devices}dev"
     return create_experiment_name(cfg.parallel.num_devices, cfg.parallel.zero_stage)

@@ -76,6 +76,10 @@ def parse_args():
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel extent")
     p.add_argument("--sequence", type=int, default=1,
                    help="sequence-parallel (ring attention) extent")
+    p.add_argument("--pipe", type=int, default=1,
+                   help="pipeline-parallel stages (GPipe schedule; "
+                        "microbatches = --gradient-accumulation-steps). "
+                        "Does not compose with ZeRO/TP/SP — pure pipe only")
     p.add_argument("--offload-optimizer", action="store_true",
                    help="ZeRO-3 host-offload parity (ds_config_zero3.json:19-23)")
     p.add_argument("--offload-params", action="store_true",
@@ -157,19 +161,39 @@ def build_config(args):
 
     cfg = preset(args.preset, model=args.model)
     par = cfg.parallel
-    n = args.num_devices or max(
-        jax.device_count() // (args.tensor * args.sequence), 1
-    )
-    if int(par.zero_stage) == 3:
-        par = par.__class__(zero_stage=par.zero_stage, fsdp=n,
-                            tensor=args.tensor, sequence=args.sequence,
+    if args.pipe > 1:
+        # Pure GPipe over the 'pipe' axis. Every flag the user passed is
+        # forwarded so Trainer._validate_pipeline_config rejects illegal
+        # combinations loudly instead of them being silently dropped.
+        if args.preset != "baseline":
+            raise SystemExit(
+                f"--pipe does not compose with --preset {args.preset} "
+                f"(ZeRO shards do not ride the pipe axis); use the "
+                f"baseline preset")
+        if args.num_devices and args.num_devices != (
+                args.pipe * args.tensor * args.sequence):
+            raise SystemExit(
+                f"--num-devices {args.num_devices} conflicts with --pipe "
+                f"{args.pipe} (a pure pipe mesh uses exactly "
+                f"pipe*tensor*sequence devices; drop --num-devices)")
+        par = par.__class__(pipe=args.pipe, tensor=args.tensor,
+                            sequence=args.sequence,
                             offload_optimizer=args.offload_optimizer,
                             offload_params=args.offload_params)
     else:
-        par = par.__class__(zero_stage=par.zero_stage, data=n,
-                            tensor=args.tensor, sequence=args.sequence,
-                            offload_optimizer=args.offload_optimizer,
-                            offload_params=args.offload_params)
+        n = args.num_devices or max(
+            jax.device_count() // (args.tensor * args.sequence), 1
+        )
+        if int(par.zero_stage) == 3:
+            par = par.__class__(zero_stage=par.zero_stage, fsdp=n,
+                                tensor=args.tensor, sequence=args.sequence,
+                                offload_optimizer=args.offload_optimizer,
+                                offload_params=args.offload_params)
+        else:
+            par = par.__class__(zero_stage=par.zero_stage, data=n,
+                                tensor=args.tensor, sequence=args.sequence,
+                                offload_optimizer=args.offload_optimizer,
+                                offload_params=args.offload_params)
 
     dp = par.data * par.fsdp
     from dlti_tpu.utils.experiment import create_experiment_name
@@ -248,7 +272,8 @@ def main() -> None:
 
     print(f"experiment: {cfg.experiment_name}")
     print(f"mesh: data={cfg.parallel.data} fsdp={cfg.parallel.fsdp} "
-          f"tensor={cfg.parallel.tensor} sequence={cfg.parallel.sequence}")
+          f"tensor={cfg.parallel.tensor} sequence={cfg.parallel.sequence} "
+          f"pipe={cfg.parallel.pipe}")
 
     if os.path.isfile(os.path.join(args.dataset_path, "meta.json")):
         # Memory-mapped token store (scripts/prepare_dataset.py
@@ -278,6 +303,11 @@ def main() -> None:
         print(f"dataset: memory-mapped token store {args.dataset_path} "
               f"({dataset._ids.shape[0]} rows x {dataset.seq_len}, "
               f"packed={dataset.packed})")
+        if dataset.packed and cfg.parallel.pipe > 1:
+            raise SystemExit(
+                "this token store is packed, and packed batches are not "
+                "supported under --pipe (the pipelined stage body takes "
+                "no segment mask); re-prepare without --pack")
         if dataset.packed:
             cfg = _apply_packed_window(cfg, dataset.max_doc_len)
     else:
@@ -322,6 +352,11 @@ def main() -> None:
                     f"{cfg.data.max_seq_len}")
             print(f"eval dataset: token store {args.eval_dataset} "
                   f"({eval_dataset._ids.shape[0]} rows)")
+            if eval_dataset.packed and cfg.parallel.pipe > 1:
+                raise SystemExit(
+                    "the eval token store is packed, and packed batches "
+                    "are not supported under --pipe; re-prepare the eval "
+                    "split without --pack")
             if (eval_dataset.packed and cfg.model.packed_attention_window
                     and eval_dataset.max_doc_len
                     > cfg.model.packed_attention_window):
