@@ -123,12 +123,13 @@ def main() -> None:
         # (results/mfu_investigation_r02.json). Winner: 51.6% MFU at bs4
         # with matmul outputs saved (vs 40.8% bf16 in r02).
         candidates = [
+            dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none"),
             dict(model="llama2_7b", bs=4, quant="int8",
                  remat_policy="dots_with_no_batch_dims_saveable"),
+            dict(model="llama2_7b", bs=4, quant="int8",
+                 remat_policy="dots_saveable"),
             dict(model="llama2_7b", bs=8, quant="int8",
                  remat_policy="save_attn_out", remat_stride=4),
-            dict(model="llama2_7b", bs=8, quant="int8",
-                 remat_policy="save_attn_out"),
             dict(model="llama2_7b", bs=4, quant="int8"),
             dict(model="llama2_7b", bs=4),
             dict(model="llama2_7b", bs=2),

@@ -83,6 +83,13 @@ class EngineConfig:
     speculative: str = "none"          # "none" | "ngram"
     num_draft_tokens: int = 4
     ngram_size: int = 2
+    # Chunked prefill (the vLLM latency lever the throughput headline
+    # lacks): cap prompt tokens prefilled per engine step, so admission
+    # never stalls running decodes for a whole prompt length — partially
+    # prefilled slots carry their remaining suffix across steps and join
+    # the decode batch when it lands. 0 = unbounded (throughput mode:
+    # whole prompts in one batched call per bucket).
+    max_prefill_tokens_per_step: int = 0
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -142,10 +149,20 @@ class _Slot:
         self.blocks: List[int] = []
         self.seq_len = 0  # tokens written to the KV cache
         self.last_token = 0
+        # Chunked prefill bookkeeping, as positions into the request's
+        # (prompt + output) token list: next_pos = where the next chunk
+        # starts, prefill_end = one past the last prompt token. A slot
+        # with next_pos < prefill_end is admitted but not yet decodable.
+        self.next_pos = 0
+        self.prefill_end = 0
 
     @property
     def free(self) -> bool:
         return self.request is None
+
+    @property
+    def prefilling(self) -> bool:
+        return self.request is not None and self.next_pos < self.prefill_end
 
 
 class InferenceEngine:
@@ -478,7 +495,9 @@ class InferenceEngine:
         """
         newly_finished: List[Request] = []
         self._admit()
-        if self.num_active > 0:
+        if self.cfg.max_prefill_tokens_per_step > 0:
+            self._prefill_work()
+        if any(not s.free and not s.prefilling for s in self.slots):
             newly_finished.extend(self._decode_step())
         return newly_finished
 
@@ -525,6 +544,15 @@ class InferenceEngine:
             self.waiting.popleft()
             admissions.append((slot, req, cached_blocks + blocks, n_cached))
 
+        if self.cfg.max_prefill_tokens_per_step > 0:
+            # Chunked mode: register now, prefill in bounded chunks from
+            # _prefill_work — decode slots never stall for a prompt length.
+            for slot, req, blocks, n_cached in admissions:
+                tokens = req.prompt_token_ids + req.output_token_ids
+                self._register_slot(slot, req, blocks, len(tokens))
+                slot.next_pos = n_cached  # _register_slot set it to the end
+            return
+
         by_bucket: Dict[int, List[tuple]] = {}
         for adm in admissions:
             slot, req, blocks, n_cached = adm
@@ -538,6 +566,34 @@ class InferenceEngine:
             for i in range(0, len(group), 8):
                 self._prefill_group(bucket, group[i:i + 8])
 
+    def _prefill_work(self) -> None:
+        """Chunked prefill: spend up to ``max_prefill_tokens_per_step``
+        prompt tokens on partially-prefilled slots (FCFS by arrival), in
+        per-bucket batched program calls. A slot whose suffix completes
+        samples its first token and joins the next decode step."""
+        budget = self.cfg.max_prefill_tokens_per_step
+        chunks: List[tuple] = []  # (slot, tokens, start_pos, is_last)
+        for slot in sorted((s for s in self.slots if s.prefilling),
+                           key=lambda s: s.request.arrival_time):
+            if budget <= 0:
+                break
+            req = slot.request
+            remaining = slot.prefill_end - slot.next_pos
+            take = min(remaining, budget)
+            # Position p holds (prompt + output)[p], so the chunk is an
+            # index slice — no per-slot token copy is carried between steps.
+            tokens = req.prompt_token_ids + req.output_token_ids
+            piece = tokens[slot.next_pos: slot.next_pos + take]
+            chunks.append((slot, piece, slot.next_pos, take == remaining))
+            slot.next_pos += take
+            budget -= take
+        by_bucket: Dict[int, List[tuple]] = {}
+        for ch in chunks:
+            by_bucket.setdefault(self._bucket_for(len(ch[1])), []).append(ch)
+        for bucket, group in by_bucket.items():
+            for i in range(0, len(group), 8):
+                self._run_prefill_batch(bucket, group[i:i + 8])
+
     def _register_slot(self, slot: _Slot, req: Request, blocks: List[int],
                        n: int) -> None:
         """Host-side bookkeeping for an admitted request (block table row,
@@ -546,6 +602,10 @@ class InferenceEngine:
         slot.request = req
         slot.blocks = blocks
         slot.seq_len = n
+        # Fully prefilled by default (throughput mode); the chunked-admit
+        # path rewinds next_pos to the cached-prefix boundary.
+        slot.next_pos = n
+        slot.prefill_end = n
         row = np.zeros((ec.max_blocks_per_seq,), np.int32)
         row[: len(blocks)] = blocks
         self._block_tables[slot.slot_id] = row
@@ -565,28 +625,38 @@ class InferenceEngine:
 
     def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
         """Batched bucketed prefill: one program call for every admission
-        sharing a suffix bucket.
+        sharing a suffix bucket (throughput mode: whole suffixes at once).
 
         On re-admission after preemption the generated-so-far tokens are
         part of the recomputed prompt (vLLM recompute semantics); with a
         prefix-cache hit only the suffix past the cached blocks is
-        prefilled. Rows are padded to a power of two — padding rows carry
-        position -1 everywhere, which slot_mapping turns into dropped
-        writes — and each row's first generated token is sampled from its
-        final real logit in one batched sample call.
+        prefilled.
+        """
+        chunks = []
+        for slot, req, blocks, n_cached in group:
+            tokens = req.prompt_token_ids + req.output_token_ids
+            self._register_slot(slot, req, blocks, len(tokens))
+            chunks.append((slot, tokens[n_cached:], n_cached, True))
+        self._run_prefill_batch(bucket, chunks)
+
+    def _run_prefill_batch(self, bucket: int, chunks: List[tuple]) -> None:
+        """One prefill program call over ``chunks``: rows of
+        ``(slot, tokens, start_pos, is_last)`` sharing a length bucket.
+
+        Rows are padded to a power of two — padding rows carry position -1
+        everywhere, which slot_mapping turns into dropped writes. Each
+        *final* chunk's first generated token is sampled from its last
+        real logit in one batched sample call; non-final chunks (chunked
+        prefill) write KV only.
         """
         ec = self.cfg
         B = 1
-        while B < len(group):
+        while B < len(chunks):
             B *= 2
-        rows = []
         nblk_needed = 1
-        for slot, req, blocks, n_cached in group:
-            tokens = req.prompt_token_ids + req.output_token_ids
-            n = len(tokens)
-            self._register_slot(slot, req, blocks, n)
-            rows.append((slot, req, tokens[n_cached:], n, n_cached))
-            nblk_needed = max(nblk_needed, self.block_manager.blocks_needed(n))
+        for slot, tokens, start, _ in chunks:
+            nblk_needed = max(nblk_needed, self.block_manager.blocks_needed(
+                start + len(tokens)))
         # Block-table width quantized so jit specializations stay
         # O(log^2) over (suffix bucket, table bucket) x O(log) batch.
         nblk_bucket = 1
@@ -603,18 +673,19 @@ class InferenceEngine:
         temps = np.ones((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
-        for r, (slot, req, suffix, n, n_cached) in enumerate(rows):
-            ids[r, : len(suffix)] = suffix
-            pos[r, : len(suffix)] = np.arange(n_cached, n)
+        for r, (slot, tokens, start, is_last) in enumerate(chunks):
+            req = slot.request
+            ids[r, : len(tokens)] = tokens
+            pos[r, : len(tokens)] = np.arange(start, start + len(tokens))
             bt[r, : min(len(slot.blocks), nblk_bucket)] = \
                 slot.blocks[:nblk_bucket]
-            last_idx[r] = len(suffix) - 1
+            last_idx[r] = len(tokens) - 1
             slot_keys[r] = self._slot_keys[slot.slot_id]
             counts[r] = self._gen_counts[slot.slot_id]
             temps[r] = req.params.temperature
             top_k[r] = req.params.top_k
             top_p[r] = req.params.top_p
-            self.stats["prefill_tokens"] += len(suffix)
+            self.stats["prefill_tokens"] += len(tokens)
 
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
@@ -622,6 +693,8 @@ class InferenceEngine:
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
             jnp.asarray(bt), jnp.asarray(last_idx),
         )
+        if not any(is_last for *_, is_last in chunks):
+            return  # mid-prompt chunks: KV writes only, nothing to sample
         # Same per-slot key + count stream the decode path uses, folded in
         # one async dispatch (no host round trip per row).
         keys = self._fold_keys(jnp.asarray(slot_keys), jnp.asarray(counts))
@@ -631,16 +704,32 @@ class InferenceEngine:
         )
         toks = np.asarray(jax.device_get(toks))
         lps = np.asarray(jax.device_get(lps))
-        for r, (slot, req, suffix, n, n_cached) in enumerate(rows):
-            self._append_token(slot, int(toks[r]), float(lps[r]))
+        for r, (slot, tokens, start, is_last) in enumerate(chunks):
+            if is_last:
+                self._append_token(slot, int(toks[r]), float(lps[r]))
+
+    def _decode_block_tables(self) -> np.ndarray:
+        """Block tables as the decode-side programs may see them: rows of
+        partially-prefilled slots are zeroed (the reserved trash block), so
+        a decode call can never scribble on KV those slots have written —
+        decode fills their rows with position 0, and block 0 absorbs it."""
+        if not any(s.prefilling for s in self.slots):
+            return self._block_tables
+        bt = self._block_tables.copy()
+        for s in self.slots:
+            if s.prefilling:
+                bt[s.slot_id] = 0
+        return bt
 
     def _decode_step(self) -> List[Request]:
         ec = self.cfg
         # Multi-step decode only when every active slot has room for the
         # whole window (writing past max_model_len would clip block-table
-        # lookups back into a slot's own live blocks).
+        # lookups back into a slot's own live blocks). Prefilling slots are
+        # admitted but not yet decodable: excluded everywhere below, with
+        # their block-table rows masked to the trash block.
         k_steps = 1
-        active0 = [s for s in self.slots if not s.free]
+        active0 = [s for s in self.slots if not s.free and not s.prefilling]
         # Speculative decode: greedy-only batches with at least one
         # non-empty n-gram draft verify k drafts + 1 token per model call.
         drafts: Dict[int, List[int]] = {}
@@ -662,9 +751,10 @@ class InferenceEngine:
             k_steps = ec.steps_per_sync
 
         # Grow block tables to cover the decode window; preempt the
-        # youngest if the pool is exhausted.
+        # youngest if the pool is exhausted. (Prefilling slots already own
+        # blocks for prompt+1 from admission and are not decoding yet.)
         for slot in sorted(
-            (s for s in self.slots if not s.free),
+            (s for s in self.slots if not s.free and not s.prefilling),
             key=lambda s: s.request.arrival_time,
         ):
             if slot.free:  # preempted by an earlier iteration of this loop
@@ -682,7 +772,8 @@ class InferenceEngine:
                 slot.blocks.extend(got)
                 self._block_tables[slot.slot_id, len(slot.blocks) - 1] = got[0]
 
-        active = [s for s in self.slots if not s.free]
+        active = [s for s in self.slots
+                  if not s.free and not s.prefilling]
         if not active:
             return []
         if drafts:
@@ -690,13 +781,13 @@ class InferenceEngine:
 
         ids = np.zeros((ec.max_seqs, 1), np.int32)
         pos = np.zeros((ec.max_seqs, 1), np.int32)  # inactive -> trash block
-        for s in self.slots:
-            if not s.free:
-                ids[s.slot_id, 0] = s.last_token
-                pos[s.slot_id, 0] = s.seq_len  # position of the new token
+        for s in active:
+            ids[s.slot_id, 0] = s.last_token
+            pos[s.slot_id, 0] = s.seq_len  # position of the new token
         args = (
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(self._block_tables), jnp.asarray(self._slot_keys),
+            jnp.asarray(self._decode_block_tables()),
+            jnp.asarray(self._slot_keys),
             jnp.asarray(self._gen_counts),
             jnp.asarray(self._temperature), jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
@@ -750,7 +841,7 @@ class InferenceEngine:
         width = min(width, ec.max_blocks_per_seq)
         self.cache, toks, lps = self._verify_fn(
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(self._block_tables[:, :width]),
+            jnp.asarray(self._decode_block_tables()[:, :width]),
         )
         toks = np.asarray(jax.device_get(toks))
         lps = np.asarray(jax.device_get(lps))
@@ -806,15 +897,20 @@ class InferenceEngine:
     def _release(self, slot: _Slot) -> None:
         if self.prefix_cache is not None and slot.request is not None:
             # Register the written full blocks for reuse (shared blocks get
-            # their refcount dropped; the partial tail goes back to the pool).
+            # their refcount dropped; the partial tail goes back to the
+            # pool). A preempted mid-prefill slot has written only
+            # next_pos tokens — caching past that would serve unwritten KV.
             req = slot.request
-            written = (req.prompt_token_ids + req.output_token_ids)[: slot.seq_len]
+            n_written = slot.next_pos if slot.prefilling else slot.seq_len
+            written = (req.prompt_token_ids + req.output_token_ids)[:n_written]
             self.prefix_cache.release_sequence(written, slot.blocks)
         else:
             self.block_manager.free(slot.blocks)
         slot.request = None
         slot.blocks = []
         slot.seq_len = 0
+        slot.next_pos = 0
+        slot.prefill_end = 0
         self._block_tables[slot.slot_id] = 0
         self._temperature[slot.slot_id] = 1.0
         self._top_k[slot.slot_id] = 0

@@ -63,6 +63,11 @@ def parse_args():
                    help="n-gram prompt-lookup speculative decoding (exact "
                         "greedy outputs, multiple tokens per model call)")
     p.add_argument("--num-draft-tokens", type=int, default=4)
+    p.add_argument("--max-prefill-tokens", type=int, default=0,
+                   help="chunked prefill: cap prompt tokens prefilled per "
+                        "engine step so decode never stalls a full prompt "
+                        "length (latency mode; 0 = unbounded throughput "
+                        "mode)")
     p.add_argument("--ngram-size", type=int, default=2,
                    help="trailing n-gram length matched for prompt lookup")
     return p.parse_args()
@@ -112,6 +117,7 @@ def main() -> None:
         speculative=args.speculative,
         num_draft_tokens=args.num_draft_tokens,
         ngram_size=args.ngram_size,
+        max_prefill_tokens_per_step=args.max_prefill_tokens,
     )
     mesh = None
     if args.tensor > 1:
