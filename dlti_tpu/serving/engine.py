@@ -72,17 +72,43 @@ class EngineConfig:
     quantization: str = "none"
     # Speculative decoding: "ngram" proposes draft tokens by prompt lookup
     # (match the trailing n-gram against earlier context, copy what
-    # followed) and verifies them in ONE forward over draft+1 positions —
-    # greedy-exact up to batched-matmul numerics (a (k+1)-position forward
-    # tiles differently than a 1-position one, the same ~1e-2 bf16 logit
-    # delta any batch-shape change causes; ties only flip on near-ties,
-    # which trained models rarely produce at the argmax). Several tokens
-    # per model call on repetitive text (code, extraction, chat
-    # templates). Engaged only when every active slot decodes greedily;
-    # sampling batches use the normal path.
+    # followed) and verifies them in a (k+1)-position forward — greedy-exact
+    # up to batched-matmul numerics (a (k+1)-position forward tiles
+    # differently than a 1-position one, the same ~1e-2 bf16 logit delta any
+    # batch-shape change causes; ties only flip on near-ties, which trained
+    # models rarely produce at the argmax). Proposal, verification, and
+    # acceptance all run ON DEVICE, and ``steps_per_sync`` such rounds chain
+    # inside one compiled program (lax.scan over a token-history buffer), so
+    # speculation COMPOSES with multi-step decode: up to
+    # steps_per_sync*(num_draft_tokens+1) tokens per host sync on
+    # repetitive text. Gating is PER SLOT: greedy slots accept draft
+    # prefixes while sampling slots in the same batch take their
+    # single-step sampled token (same fold_in rng stream), so one sampling
+    # request no longer disables speculation batch-wide. Caveat of that
+    # composition: a sampling slot's position-0 logits then come from a
+    # (k+1)-position forward, which tiles differently than the 1-position
+    # plain decode — the same ~1e-2 bf16 logit delta as above. Greedy
+    # argmax only flips on near-ties, but a categorical draw can flip
+    # whenever the shifted CDF crosses the rng uniform, so under
+    # speculative mode a seeded sampling request's tokens are reproducible
+    # for a fixed engine config but not bitwise-independent of batch
+    # composition on bf16 (exact on f32). speculative="none" keeps the
+    # strict batch-independence promise.
     speculative: str = "none"          # "none" | "ngram"
     num_draft_tokens: int = 4
     ngram_size: int = 2
+    # Adaptive gate: a greedy slot-round wins (emitted-1) extra tokens over
+    # plain decode. When the mean win over the last >=spec_probe_window
+    # greedy slot-rounds drops below spec_min_acceptance (extra tokens per
+    # round — rounds where prompt lookup finds no match count as 0), pause
+    # proposing for spec_cooldown engine rounds (which run the plain
+    # multi-step path), then re-probe. 0.0 disables the gate (always
+    # speculate). On by default: on text where prompt lookup never hits,
+    # the (k+1)-position forwards are pure overhead, and the gate is what
+    # makes --speculative ngram safe to leave enabled.
+    spec_min_acceptance: float = 0.25
+    spec_probe_window: int = 64
+    spec_cooldown: int = 32
     # Chunked prefill (the vLLM latency lever the throughput headline
     # lacks): cap prompt tokens prefilled per engine step, so admission
     # never stalls running decodes for a whole prompt length — partially
@@ -283,8 +309,28 @@ class InferenceEngine:
         self._multi_decode_fn = (
             self._build_multi_decode_fn(ec.steps_per_sync)
             if ec.steps_per_sync > 1 else None)
-        self._verify_fn = (self._build_verify_fn(ec.num_draft_tokens)
-                          if ec.speculative == "ngram" else None)
+        # Speculative program: rounds = steps_per_sync (>=1), so spec and
+        # multi-step are one composed program, not alternatives.
+        self._spec_rounds = max(1, ec.steps_per_sync)
+        # Token-history rows: positions 0..max_model_len-1, one slack cell
+        # for the in-flight input token, one scratch cell absorbing masked
+        # scatter writes (see _build_spec_decode_fn).
+        self._spec_hist_width = ec.max_model_len + ec.num_draft_tokens + 2
+        self._spec_fn = (
+            self._build_spec_decode_fn(ec.num_draft_tokens, self._spec_rounds)
+            if ec.speculative == "ngram" else None)
+        # Host mirror of every slot's token history at its context
+        # positions, maintained incrementally at admission/append — the
+        # spec program's proposal input, without rebuilding O(context)
+        # arrays from Python lists every sync. Rows beyond a slot's
+        # seq_len are never read (proposal masks on seq_len), so stale
+        # tails from previous occupants need no zeroing.
+        self._spec_hist = (
+            np.zeros((ec.max_seqs, self._spec_hist_width), np.int32)
+            if ec.speculative == "ngram" else None)
+        self._spec_pause = 0      # decode rounds left in adaptive cooldown
+        self._spec_win_prop = 0   # proposals since last gate decision
+        self._spec_win_acc = 0    # acceptances since last gate decision
         if ec.speculative not in ("none", "ngram"):
             raise ValueError(f"unknown speculative mode {ec.speculative!r}")
         self._sample_fn = jax.jit(sample_tokens)
@@ -298,7 +344,8 @@ class InferenceEngine:
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
                       "prefix_cached_tokens": 0,
-                      "spec_proposed": 0, "spec_accepted": 0}
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_paused_rounds": 0}
 
     # ------------------------------------------------------------------
     def _shard_for_tp(self, mesh) -> None:
@@ -407,40 +454,109 @@ class InferenceEngine:
 
         return decode_multi
 
-    def _build_verify_fn(self, k: int):
-        """One forward over (S, k+1) positions: the current input token
-        plus k draft tokens per slot. Returns greedy argmax tokens and
-        logprobs at every position; acceptance happens on the host."""
-        @partial(jax.jit, donate_argnums=(1,))
-        def verify(params, cache_kv, input_ids, positions, block_tables):
-            logits, new_kv = self._model_cache_call(
-                params, cache_kv, block_tables, input_ids, positions
-            )
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (S, k+1)
-            lps = jnp.take_along_axis(logp, toks[:, :, None], axis=-1)[:, :, 0]
-            return new_kv, toks, lps
+    def _build_spec_decode_fn(self, k: int, rounds: int):
+        """``rounds`` propose→verify→accept iterations in ONE program.
 
-        return verify
+        Each round, entirely on device (no host round-trip between rounds):
 
-    @staticmethod
-    def _propose_ngram(context: List[int], n: int, k: int) -> List[int]:
-        """Prompt-lookup drafts: find the most recent earlier occurrence of
-        the trailing n-gram and copy up to k tokens that followed it.
+        1. **Propose** (prompt lookup): per slot, match the trailing
+           ``ngram_size``-gram of the token history against every earlier
+           position (one vectorized window comparison on the VPU) and copy
+           the k tokens that followed the most recent hit; no hit → an
+           all-(-1) draft, which degrades that slot to single-step.
+        2. **Verify**: one forward over (S, k+1) positions — the current
+           input token plus the k drafts.
+        3. **Accept**: greedy slots emit the longest draft prefix matching
+           the argmax plus one bonus token (exact greedy decoding);
+           sampling slots emit their position-0 ``sample_tokens`` draw
+           (identical fold_in rng stream to plain decode). Accepted tokens
+           are scattered back into the history so the *next* round's
+           proposal sees them — this is what makes speculation compose
+           with multi-step instead of excluding it.
 
-        Vectorized (one sliding-window comparison in C) — this runs per
-        slot per decode step on the host critical path.
+        The host syncs once per call: up to rounds*(k+1) tokens. KV writes
+        past a slot's accepted prefix are garbage but live at positions its
+        next round (or next plain decode) overwrites before any query can
+        attend to them (causal masking; same invariant as chunked prefill's
+        trash-block masking).
         """
-        if len(context) <= n:
-            return []
-        ctx = np.asarray(context, np.int32)
-        tail = ctx[-n:]
-        windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
-        hits = np.flatnonzero(np.all(windows == tail, axis=1))
-        if hits.size == 0:
-            return []
-        start = int(hits[-1])  # most recent earlier occurrence
-        return [int(t) for t in ctx[start + n:start + n + k]]
+        n = self.cfg.ngram_size
+        W = self._spec_hist_width
+
+        def propose(hist, seq_len):
+            # hist rows hold context tokens at their positions (the input
+            # token already placed at seq_len); valid length = seq_len+1.
+            S = hist.shape[0]
+            tails = jax.vmap(
+                lambda row, sl: jax.lax.dynamic_slice(row, (sl + 1 - n,), (n,))
+            )(hist, seq_len)                                     # (S, n)
+            win = jnp.stack(
+                [hist[:, j:W - n + 1 + j] for j in range(n)], axis=-1
+            )                                                    # (S, W-n+1, n)
+            eq = jnp.all(win == tails[:, None, :], axis=-1)
+            ii = jnp.arange(W - n + 1)[None, :]
+            # A hit must be an *earlier* occurrence fully inside known
+            # context: window ends at ii+n-1 <= seq_len-1.
+            valid = eq & (ii <= (seq_len - n)[:, None]) & (seq_len >= n)[:, None]
+            found = jnp.any(valid, axis=1)
+            best = jnp.argmax(jnp.where(valid, ii, -1), axis=1)  # most recent
+            drafts = jax.vmap(
+                lambda row, b: jax.lax.dynamic_slice(row, (b,), (k,))
+            )(hist, best + n)                                    # (S, k)
+            j = jnp.arange(k)[None, :]
+            ok = found[:, None] & ((best + n)[:, None] + j <= seq_len[:, None])
+            return jnp.where(ok, drafts, -1)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def spec_decode(params, cache_kv, hist, t_in, seq_len, block_tables,
+                        slot_keys, gen_counts, temperature, top_k, top_p):
+            S = t_in.shape[0]
+            rows = jnp.arange(S)
+            is_greedy = temperature == 0.0
+
+            def body(carry, _):
+                cache, hist, t_in, seq_len, cnt = carry
+                hist = hist.at[rows, seq_len].set(t_in)
+                drafts = propose(hist, seq_len)                  # (S, k)
+                ids = jnp.concatenate(
+                    [t_in[:, None], jnp.maximum(drafts, 0)], axis=1)
+                pos = seq_len[:, None] + jnp.arange(k + 1)[None, :]
+                logits, new_kv = self._model_cache_call(
+                    params, cache, block_tables, ids, pos)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, k+1)
+                g_lp = jnp.take_along_axis(
+                    logp, g[..., None], axis=-1)[..., 0]
+                # Position-0 emission via sample_tokens for EVERY slot:
+                # greedy rows reduce to the same argmax, sampling rows get
+                # exactly the plain-decode draw for fold_in(key, cnt).
+                rngs = jax.vmap(jax.random.fold_in)(slot_keys, cnt)
+                s_tok, s_lp = sample_tokens(
+                    logits[:, 0, :], rngs, temperature, top_k, top_p)
+                eq = (drafts == g[:, :k]) & (drafts >= 0)
+                m = jnp.sum(jnp.cumprod(eq.astype(jnp.int32), axis=1), axis=1)
+                emit = jnp.where(is_greedy, m + 1, 1).astype(jnp.int32)
+                toks = g.at[:, 0].set(s_tok)
+                lps = g_lp.at[:, 0].set(s_lp)
+                # Scatter emitted tokens into the history at context
+                # positions seq_len+1+j; masked lanes hit the scratch cell.
+                cols = seq_len[:, None] + 1 + jnp.arange(k + 1)[None, :]
+                cols = jnp.where(
+                    jnp.arange(k + 1)[None, :] < emit[:, None], cols, W - 1)
+                hist = hist.at[rows[:, None], cols].set(toks)
+                t_in2 = toks[rows, emit - 1]
+                prop_cnt = jnp.sum(drafts >= 0, axis=1).astype(jnp.int32)
+                carry = (new_kv, hist, t_in2, seq_len + emit, cnt + emit)
+                return carry, (toks, lps, emit, prop_cnt, m)
+
+            (new_kv, _, _, _, _), (toks, lps, emit, prop, acc) = jax.lax.scan(
+                body, (cache_kv, hist, t_in, seq_len, gen_counts),
+                None, length=rounds)
+            # (R, S, ...) -> slot-major for the host walk.
+            return (new_kv, toks.transpose(1, 0, 2), lps.transpose(1, 0, 2),
+                    emit.T, prop.T, acc.T)
+
+        return spec_decode
 
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.buckets():
@@ -622,6 +738,9 @@ class InferenceEngine:
         # Count of tokens generated so far (nonzero on re-admission after
         # preemption, so the seeded draw stream continues where it left off).
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
+        if self._spec_hist is not None:
+            ctx = req.prompt_token_ids + req.output_token_ids
+            self._spec_hist[slot.slot_id, :len(ctx)] = ctx
 
     def _prefill_group(self, bucket: int, group: List[tuple]) -> None:
         """Batched bucketed prefill: one program call for every admission
@@ -730,21 +849,24 @@ class InferenceEngine:
         # their block-table rows masked to the trash block.
         k_steps = 1
         active0 = [s for s in self.slots if not s.free and not s.prefilling]
-        # Speculative decode: greedy-only batches with at least one
-        # non-empty n-gram draft verify k drafts + 1 token per model call.
-        drafts: Dict[int, List[int]] = {}
-        if self._verify_fn is not None and active0 and all(
-                s.request.params.temperature == 0.0 for s in active0) and all(
-                s.seq_len + ec.num_draft_tokens + 1 <= ec.max_model_len
-                for s in active0):
-            for s in active0:
-                ctx = s.request.prompt_token_ids + s.request.output_token_ids
-                drafts[s.slot_id] = self._propose_ngram(
-                    ctx, ec.ngram_size, ec.num_draft_tokens)
-            if not any(drafts.values()):
-                drafts = {}
-        if drafts:
-            k_steps = ec.num_draft_tokens + 1  # window for block growth
+        # Speculative decode engages per ROUND when any active slot is
+        # greedy (per-slot gating inside the program handles the rest) and
+        # every active slot has room for the worst-case window; the
+        # adaptive gate pauses it while draft acceptance is poor.
+        # Trade-off: the room check is batch-wide (R is compile-static),
+        # so one slot within R*(k+1) tokens of max_model_len falls the
+        # whole batch back to plain multi-step until it retires — at most
+        # its last R*(k+1) decode rounds. A per-slot R would need one
+        # compiled variant per window size; not worth the compile surface.
+        spec_window = self._spec_rounds * (ec.num_draft_tokens + 1)
+        use_spec = (
+            self._spec_fn is not None and active0
+            and any(s.request.params.temperature == 0.0 for s in active0)
+            and all(s.seq_len + spec_window <= ec.max_model_len
+                    for s in active0)
+            and self._spec_gate_open())
+        if use_spec:
+            k_steps = spec_window  # block-growth window
         elif self._multi_decode_fn is not None and active0 and all(
                 s.seq_len + ec.steps_per_sync <= ec.max_model_len
                 for s in active0):
@@ -759,7 +881,14 @@ class InferenceEngine:
         ):
             if slot.free:  # preempted by an earlier iteration of this loop
                 continue
-            need = self.block_manager.blocks_needed(slot.seq_len + k_steps)
+            window = k_steps
+            if use_spec and slot.request.params.temperature != 0.0:
+                # Sampling slots advance exactly one real token per spec
+                # round; their draft-position writes past that land on the
+                # trash block (unallocated table entries are 0), so don't
+                # allocate — and possibly preempt for — the full window.
+                window = self._spec_rounds
+            need = self.block_manager.blocks_needed(slot.seq_len + window)
             while need > len(slot.blocks):
                 got = self._alloc(1)
                 if got is None:
@@ -776,8 +905,8 @@ class InferenceEngine:
                   if not s.free and not s.prefilling]
         if not active:
             return []
-        if drafts:
-            return self._speculative_step(active, drafts)
+        if use_spec:
+            return self._spec_step(active)
 
         ids = np.zeros((ec.max_seqs, 1), np.int32)
         pos = np.zeros((ec.max_seqs, 1), np.int32)  # inactive -> trash block
@@ -816,55 +945,91 @@ class InferenceEngine:
                     break
         return finished
 
-    def _speculative_step(self, active: List[_Slot],
-                          drafts: Dict[int, List[int]]) -> List[Request]:
-        """Verify each slot's draft in one (S, k+1)-position forward and
-        emit the accepted prefix plus one bonus token — exact greedy
-        decoding, m+1 tokens per model call when m drafts match."""
+    def _spec_gate_open(self) -> bool:
+        """Adaptive acceptance gate (``spec_min_acceptance``): pause
+        proposing for ``spec_cooldown`` rounds after a probe window of
+        mostly-rejected drafts, then probe again."""
+        if self.cfg.spec_min_acceptance <= 0.0:
+            return True
+        if self._spec_pause > 0:
+            self._spec_pause -= 1
+            self.stats["spec_paused_rounds"] += 1
+            return False
+        return True
+
+    def _spec_note_acceptance(self, slot_rounds: int, extra: int) -> None:
+        self._spec_win_prop += slot_rounds
+        self._spec_win_acc += extra
+        if (self.cfg.spec_min_acceptance > 0.0
+                and self._spec_win_prop >= self.cfg.spec_probe_window):
+            rate = self._spec_win_acc / self._spec_win_prop
+            if rate < self.cfg.spec_min_acceptance:
+                self._spec_pause = self.cfg.spec_cooldown
+            self._spec_win_prop = 0
+            self._spec_win_acc = 0
+
+    def _spec_step(self, active: List[_Slot]) -> List[Request]:
+        """Run the fused propose→verify→accept program and walk its
+        emissions. Per slot per round the device reports how many tokens
+        were emitted (greedy: accepted prefix + bonus; sampling: exactly
+        one); the host consumes them in order, stopping a slot at
+        EOS/limit and discarding the rest of its window (same contract as
+        multi-step decode)."""
         ec = self.cfg
-        k = ec.num_draft_tokens
-        ids = np.zeros((ec.max_seqs, k + 1), np.int32)
-        pos = np.zeros((ec.max_seqs, k + 1), np.int32)  # inactive -> trash
+        k, R = ec.num_draft_tokens, self._spec_rounds
+        t_in = np.zeros((ec.max_seqs,), np.int32)
+        seq_len = np.zeros((ec.max_seqs,), np.int32)
         for s in active:
-            d = drafts.get(s.slot_id, [])
-            ids[s.slot_id, 0] = s.last_token
-            ids[s.slot_id, 1:1 + len(d)] = d
-            pos[s.slot_id] = np.arange(s.seq_len, s.seq_len + k + 1)
+            t_in[s.slot_id] = s.last_token
+            seq_len[s.slot_id] = s.seq_len
         # Multi-query attention takes the gather path (the Pallas paged
-        # kernel is single-token); bound its window to the blocks actually
-        # live now, quantized pow2 so jit specializations stay O(log).
-        nblk = max(self.block_manager.blocks_needed(s.seq_len + k + 1)
+        # kernel is single-token); bound its window to the blocks the
+        # whole spec window can touch, quantized pow2 so jit
+        # specializations stay O(log).
+        nblk = max(self.block_manager.blocks_needed(s.seq_len + R * (k + 1))
                    for s in active)
         width = 1
         while width < nblk:
             width *= 2
         width = min(width, ec.max_blocks_per_seq)
-        self.cache, toks, lps = self._verify_fn(
-            self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
+        self.cache, toks, lps, emit, prop, acc = self._spec_fn(
+            self.params, self.cache, jnp.asarray(self._spec_hist), jnp.asarray(t_in),
+            jnp.asarray(seq_len),
             jnp.asarray(self._decode_block_tables()[:, :width]),
+            jnp.asarray(self._slot_keys), jnp.asarray(self._gen_counts),
+            jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
         )
-        toks = np.asarray(jax.device_get(toks))
+        toks = np.asarray(jax.device_get(toks))   # (S, R, k+1)
         lps = np.asarray(jax.device_get(lps))
-        self.stats["decode_steps"] += 1
+        emit = np.asarray(jax.device_get(emit))   # (S, R)
+        prop = np.asarray(jax.device_get(prop))
+        acc = np.asarray(jax.device_get(acc))
+        self.stats["decode_steps"] += R
 
         finished = []
+        gate_rounds = 0
+        gate_extra = 0
         for s in active:
-            d = drafts.get(s.slot_id, [])
-            m = 0
-            while m < len(d) and d[m] == int(toks[s.slot_id, m]):
-                m += 1
-            self.stats["spec_proposed"] += len(d)
-            self.stats["spec_accepted"] += m
-            # Emit the m accepted tokens plus the bonus token; positions
-            # past the accepted prefix hold wrong-input KV and are simply
-            # overwritten when those positions are truly decoded.
-            for j in range(m + 1):
-                s.seq_len += 1
-                done = self._append_token(s, int(toks[s.slot_id, j]),
-                                          float(lps[s.slot_id, j]))
+            sid = s.slot_id
+            greedy = s.request.params.temperature == 0.0
+            done = False
+            for r in range(R):
+                if greedy:
+                    gate_rounds += 1
+                    gate_extra += int(emit[sid, r]) - 1
+                    self.stats["spec_proposed"] += int(prop[sid, r])
+                    self.stats["spec_accepted"] += int(acc[sid, r])
+                for j in range(int(emit[sid, r])):
+                    s.seq_len += 1
+                    done = self._append_token(s, int(toks[sid, r, j]),
+                                              float(lps[sid, r, j]))
+                    if done:
+                        finished.append(s.request)
+                        break
                 if done:
-                    finished.append(s.request)
                     break
+        self._spec_note_acceptance(gate_rounds, gate_extra)
         return finished
 
     def _append_token(self, slot: _Slot, token: int, logprob: float) -> bool:
@@ -876,6 +1041,9 @@ class InferenceEngine:
         req.output_token_ids.append(token)
         req.output_logprobs.append(logprob)
         slot.last_token = token
+        if self._spec_hist is not None:
+            self._spec_hist[slot.slot_id, len(req.prompt_token_ids)
+                            + len(req.output_token_ids) - 1] = token
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
         self.stats["generated_tokens"] += 1
 

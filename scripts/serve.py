@@ -70,6 +70,15 @@ def parse_args():
                         "mode)")
     p.add_argument("--ngram-size", type=int, default=2,
                    help="trailing n-gram length matched for prompt lookup")
+    p.add_argument("--spec-min-acceptance", type=float, default=0.25,
+                   help="adaptive speculative gate: pause proposing when "
+                        "mean extra tokens per greedy slot-round fall below "
+                        "this (0 = always speculate)")
+    p.add_argument("--spec-probe-window", type=int, default=64,
+                   help="greedy slot-rounds measured before each gate "
+                        "decision")
+    p.add_argument("--spec-cooldown", type=int, default=32,
+                   help="engine rounds the gate pauses proposing for")
     return p.parse_args()
 
 
@@ -117,6 +126,9 @@ def main() -> None:
         speculative=args.speculative,
         num_draft_tokens=args.num_draft_tokens,
         ngram_size=args.ngram_size,
+        spec_min_acceptance=args.spec_min_acceptance,
+        spec_probe_window=args.spec_probe_window,
+        spec_cooldown=args.spec_cooldown,
         max_prefill_tokens_per_step=args.max_prefill_tokens,
     )
     mesh = None

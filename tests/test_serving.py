@@ -423,9 +423,11 @@ def test_speculative_ngram_matches_plain_greedy(tiny_model_and_params):
     assert spec_engine.stats["decode_steps"] < total_tokens
 
 
-def test_speculative_disabled_for_sampling_batches(tiny_model_and_params):
-    """A batch containing a sampling request falls back to normal decode
-    (still correct, deterministic per seed)."""
+def test_speculative_mixed_batch_per_slot_gating(tiny_model_and_params):
+    """Per-slot gating: a greedy slot speculates while a sampling slot in
+    the SAME batch takes its exact single-step draw — one sampling request
+    no longer disables speculation batch-wide, and both requests emit
+    exactly what the plain engine emits."""
     model, params = tiny_model_and_params
     ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=64,
                       max_model_len=64, cache_dtype="float32",
@@ -438,6 +440,8 @@ def test_speculative_disabled_for_sampling_batches(tiny_model_and_params):
     while engine.has_work:
         engine.step()
     assert len(r1.output_token_ids) == 8 and len(r2.output_token_ids) == 8
+    # The greedy slot really did speculate despite the sampling neighbor.
+    assert engine.stats["spec_proposed"] > 0
 
     plain = InferenceEngine(CFG, params, EngineConfig(
         max_seqs=2, block_size=8, num_blocks=64, max_model_len=64,
@@ -450,6 +454,59 @@ def test_speculative_disabled_for_sampling_batches(tiny_model_and_params):
         plain.step()
     assert r1.output_token_ids == p1.output_token_ids
     assert r2.output_token_ids == p2.output_token_ids
+
+
+def test_speculative_composes_with_multi_step(tiny_model_and_params):
+    """speculative="ngram" + steps_per_sync=4 chains 4 propose→verify
+    rounds in ONE compiled program: emissions match plain greedy exactly
+    and the host syncs far less than once per token."""
+    model, params = tiny_model_and_params
+
+    def mk(spec, steps):
+        return InferenceEngine(CFG, params, EngineConfig(
+            max_seqs=2, block_size=8, num_blocks=128, max_model_len=192,
+            cache_dtype="float32", eos_token_id=-1,
+            speculative="ngram" if spec else "none",
+            steps_per_sync=steps, num_draft_tokens=4, ngram_size=2))
+
+    prompts = [[7, 8, 9, 7, 8, 9, 7, 8], [4, 5, 4, 5, 4, 5, 4]]
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    want = mk(False, 1).generate(prompts, sp)
+    eng = mk(True, 4)
+    got = eng.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+        np.testing.assert_allclose(g.output_logprobs, w.output_logprobs,
+                                   atol=1e-4)
+    assert eng.stats["spec_accepted"] > 0
+    # 4 rounds/sync and multi-token acceptance: model calls well under
+    # one per emitted token.
+    total = sum(len(r.output_token_ids) for r in got)
+    assert eng.stats["decode_steps"] < total
+
+
+def test_speculative_adaptive_gate_stays_exact(tiny_model_and_params):
+    """With an unreachably high acceptance threshold the gate pauses
+    proposing (plain multi-step rounds) and periodically re-probes —
+    outputs stay exactly greedy throughout."""
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=128,
+                      max_model_len=192, cache_dtype="float32",
+                      eos_token_id=-1, speculative="ngram",
+                      steps_per_sync=2, spec_min_acceptance=100.0,
+                      spec_probe_window=2, spec_cooldown=3)
+    prompts = [[7, 8, 9, 7, 8, 9, 7, 8], [4, 5, 4, 5, 4, 5, 4]]
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    eng = InferenceEngine(CFG, params, ec)
+    got = eng.generate(prompts, sp)
+    plain = InferenceEngine(CFG, params, EngineConfig(
+        max_seqs=2, block_size=8, num_blocks=128, max_model_len=192,
+        cache_dtype="float32", eos_token_id=-1))
+    want = plain.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+    # The gate must have actually paused at least once (tracked stat).
+    assert eng.stats["spec_paused_rounds"] > 0
 
 
 # ----------------------------------------------------------------------
