@@ -164,6 +164,7 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
         if headers.get("content-type", "").startswith("text/event-stream"):
             # SSE: scan dechunked stream for `data:` lines.
             n_data = 0
+            usage_tokens = 0
             buf = b""
             async for chunk in _iter_body(reader, headers, timeout_s):
                 buf += chunk
@@ -187,11 +188,15 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                         if rec.first_token is None:
                             rec.first_token = time.monotonic()
                         n_data += 1
-            # Tokens != SSE chunks in general; chunk count is the stream's
-            # visible progress unit and the per-chunk latency is the TPOT
-            # proxy. Usage-accurate counts come from non-stream mode.
-            rec.output_tokens = n_data
-            rec.ok = rec.ok or n_data > 0
+                    if obj.get("usage"):
+                        usage_tokens = int(
+                            obj["usage"].get("completion_tokens", 0))
+            # Prefer the final chunk's usage (token-accurate; our server
+            # always sends it — stream_options.include_usage semantics).
+            # Fallback: SSE event count, the stream's visible progress
+            # unit (!= tokens when multi-step decode batches per sync).
+            rec.output_tokens = usage_tokens if usage_tokens else n_data
+            rec.ok = rec.ok or n_data > 0 or usage_tokens > 0
         else:
             raw = b"".join([c async for c in _iter_body(reader, headers, timeout_s)])
             obj = json.loads(raw)

@@ -373,7 +373,19 @@ class _Handler(BaseHTTPRequestHandler):
                 chunk(json.dumps({
                     "id": req.request_id, "object": obj, "created": created,
                     "model": self.cfg.model_name,
-                    "choices": [{"index": 0, key: val, "finish_reason": finish}]}))
+                    "choices": [{"index": 0, key: val, "finish_reason": finish}],
+                    # Token-accurate usage in the final chunk (OpenAI
+                    # stream_options.include_usage semantics, always on):
+                    # SSE event count != token count (multi-step decode
+                    # batches tokens per sync; detokenization can emit
+                    # empty deltas), so load tests need this for honest
+                    # streaming throughput numbers.
+                    "usage": {
+                        "prompt_tokens": len(req.prompt_token_ids),
+                        "completion_tokens": len(req.output_token_ids),
+                        "total_tokens": len(req.prompt_token_ids)
+                        + len(req.output_token_ids),
+                    }}))
             chunk("[DONE]")
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()

@@ -42,6 +42,10 @@ def main() -> None:
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--prompt", default="Write a function that reverses a linked list.")
+    p.add_argument("--prompt-file", default=None,
+                   help="file with one prompt per line: requests draw from "
+                        "this pool (seeded), exercising varied prefill "
+                        "lengths instead of one fixed prompt")
     p.add_argument("--chat", action="store_true", help="use /v1/chat/completions")
     p.add_argument("--no-stream", action="store_true",
                    help="non-streaming (usage-accurate token counts, no TTFT)")
@@ -50,12 +54,16 @@ def main() -> None:
     p.add_argument("--json-out", default=None, help="also write the report as JSON")
     args = p.parse_args()
 
+    prompts = ()
+    if args.prompt_file:
+        with open(args.prompt_file) as f:
+            prompts = tuple(line.rstrip("\r\n") for line in f if line.strip())
     cfg = LoadGenConfig(
         host=args.host, port=args.port, num_requests=args.num_requests,
         concurrency=args.concurrency, qps=args.qps, stream=not args.no_stream,
         max_tokens=args.max_tokens, temperature=args.temperature,
-        prompt=args.prompt, chat=args.chat, timeout_s=args.timeout,
-        seed=args.seed,
+        prompt=args.prompt, prompts=prompts, chat=args.chat,
+        timeout_s=args.timeout, seed=args.seed,
     )
     report = run_load_test(cfg)
     d = report.to_dict()
