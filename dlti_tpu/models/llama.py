@@ -348,7 +348,13 @@ class LlamaModel(nn.Module):
 
         # RoPE tables sized to cache capacity when decoding, else seq len.
         if cache is None:
-            table_len = cfg.max_seq_len
+            # Cover the actual sequence even past the preset's design
+            # length: the table is computed (not learned), so extending it
+            # is exact for in-range positions — without this, positions
+            # >= max_seq_len hit jnp.take's NaN fill and training at a
+            # longer seq_len silently NaNs (caught by the r03 experiment
+            # matrix at llama_tiny seq 512 > max_seq_len 128).
+            table_len = max(cfg.max_seq_len, s)
         elif "block_tables" in cache[0]:
             # Paged: capacity = logical window = blocks/seq * block_size.
             table_len = cache[0]["block_tables"].shape[1] * cache[0]["k"].shape[1]

@@ -54,6 +54,8 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=512)
     p.add_argument("--lora-r", type=int, default=16)
     p.add_argument("--metrics-csv", default="results/training_metrics.csv")
+    p.add_argument("--plot-out", default="results/plots/training_comparison.png",
+                   help="where the post-matrix comparison plot is written")
     p.add_argument("--output-root", default="checkpoints")
     p.add_argument("--log-dir", default="logs")
     p.add_argument("--simulate-devices", type=int, default=0,
@@ -97,6 +99,7 @@ def main() -> None:
         specs, train_args, metrics_csv=args.metrics_csv,
         simulate_devices=args.simulate_devices,
         output_root=args.output_root, analyze=not args.no_analyze,
+        plot_path=args.plot_out,
         dry_run=args.dry_run, log_dir=args.log_dir)
     failures = [r for r in results if r["returncode"] not in (0, None)]
     if failures:

@@ -246,3 +246,18 @@ def test_packed_attention_window_is_exact(rng):
     valid = np.asarray(segs != 0)[:, :, None]
     np.testing.assert_allclose(logits["banded"] * valid,
                                logits["plain"] * valid, atol=1e-5)
+
+
+def test_forward_finite_past_preset_max_seq_len():
+    """Training longer than a preset's design length must extend the
+    (computed) RoPE table, not hit jnp.take's NaN fill — regression for
+    the r03 experiment matrix silently NaN-ing at llama_tiny seq 512 >
+    max_seq_len 128."""
+    cfg = MODEL_PRESETS["llama_tiny"]
+    assert cfg.max_seq_len < 512
+    model = LlamaForCausalLM(cfg, None)
+    ids = jnp.ones((1, 512), jnp.int32) * 5
+    params = model.init(jax.random.PRNGKey(0), ids, deterministic=True)["params"]
+    out = model.apply({"params": params}, ids, deterministic=True)
+    logits = out[0] if isinstance(out, tuple) else out
+    assert bool(jnp.isfinite(logits).all())
