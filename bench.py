@@ -44,7 +44,8 @@ STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
 
 def _try_run(model_name: str, micro_bs: int, quant: str = "",
-             remat_policy: str = "", remat_stride: int = 0):
+             remat_policy: str = "", remat_stride: int = 0,
+             loss_chunk: int = 0):
     import dataclasses
 
     from dlti_tpu.config import MODEL_PRESETS, LoRAConfig, OptimizerConfig
@@ -78,7 +79,9 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
             params=quantize_params_int8(state.params, donate=True))
         jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
 
-    step = jax.jit(make_train_step(model, accum_steps=1), donate_argnums=(0,))
+    step = jax.jit(make_train_step(model, accum_steps=1,
+                                   loss_chunk=loss_chunk),
+                   donate_argnums=(0,))
     batch = {
         "input_ids": jax.random.randint(rng, (1, micro_bs, SEQ), 0, cfg.vocab_size),
         "loss_mask": jnp.ones((1, micro_bs, SEQ), jnp.int32),
@@ -114,7 +117,8 @@ def main() -> None:
                            bs=int(os.environ.get("BENCH_BS", 1)),
                            quant=quant,
                            remat_policy=os.environ.get("BENCH_REMAT", ""),
-                           remat_stride=int(os.environ.get("BENCH_STRIDE", 0)))]
+                           remat_stride=int(os.environ.get("BENCH_STRIDE", 0)),
+                           loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 0)))]
     else:
         # Ordered by measured throughput on the v5e-class 16 GB chip
         # (results/mfu_investigation_r03.json): int8 frozen base frees
@@ -143,7 +147,8 @@ def main() -> None:
             tok_s, dt, trainable, total, loss = _try_run(
                 c["model"], c["bs"], quant=c.get("quant", ""),
                 remat_policy=c.get("remat_policy", ""),
-                remat_stride=c.get("remat_stride", 0))
+                remat_stride=c.get("remat_stride", 0),
+                loss_chunk=c.get("loss_chunk", 0))
             result = (c, tok_s, dt, trainable, total, loss)
             break
         except Exception as e:  # OOM or compile failure: try the next config

@@ -275,6 +275,13 @@ class TrainConfig:
     # (activation saving), the measured MFU ceiling at bf16
     # (results/mfu_investigation_r02.json). Requires lora.enabled.
     quantize_frozen_base: str = ""
+    # Sequence-chunked cross-entropy (0 = off): compute the LM-head matmul
+    # + softmax-CE loss_chunk positions at a time inside a rematerialized
+    # scan, so (B, S, vocab) fp32 logits are never whole in HBM — at
+    # 7B/seq-512 that is ~2 GB of the post-int8 memory headroom
+    # (results/mfu_investigation_r03.json). Not for sequence-parallel or
+    # MoE runs.
+    loss_chunk: int = 0
     fp16_scale_window: int = 1000
     fp16_hysteresis: int = 2
     fp16_min_scale: float = 1.0

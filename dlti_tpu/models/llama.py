@@ -401,12 +401,21 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
-                 deterministic: bool = True, token_mask=None):
+                 deterministic: bool = True, token_mask=None,
+                 return_hidden: bool = False):
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         x, new_cache = LlamaModel(cfg, self.lora, self.mesh, name="model")(
             input_ids, positions, segment_ids, cache, deterministic, token_mask
         )
+        if return_hidden:
+            # Skip the LM head: the caller computes a seq-chunked loss so
+            # (B, S, V) fp32 logits are never materialized whole
+            # (training.step.chunked_causal_lm_loss). The head params must
+            # still be grafted when this module owns them, so init traces
+            # the normal path.
+            if not self.is_initializing():
+                return x, new_cache
         if cfg.tie_embeddings:
             from dlti_tpu.models.quantization import maybe_dequantize
 
@@ -429,6 +438,25 @@ class LlamaForCausalLM(nn.Module):
         return logits.astype(jnp.float32), new_cache
 
     # ------------------------------------------------------------------
+    def head_matrix(self, params, anchor):
+        """The (hidden, vocab) projection __call__ applies after the body,
+        as an explicit matrix — the input to the sequence-chunked loss
+        (``training.step.chunked_causal_lm_loss``), kept here so head
+        changes cannot desynchronize from the chunked path. Dtypes match
+        __call__ exactly: tied embeddings project in float32
+        (the einsum above), untied heads in the activation dtype with
+        fp32 accumulation."""
+        from dlti_tpu.models.quantization import maybe_dequantize
+
+        if self.cfg.tie_embeddings:
+            embed = maybe_dequantize(
+                params["model"]["embed_tokens"], jnp.float32, anchor=anchor)
+            return embed.astype(jnp.float32).T
+        head = params["lm_head"]
+        if isinstance(head, dict):
+            head = maybe_dequantize(head, anchor.dtype, anchor=anchor)
+        return head.astype(anchor.dtype)
+
     def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> list:
         """Allocate a fixed-capacity KV cache for decode."""
         cfg = self.cfg

@@ -321,6 +321,11 @@ def make_sharded_train_step(
                                              "sequence" if cfg.parallel.sequence > 1 else None))
         )
 
+    if cfg.train.loss_chunk and cfg.parallel.sequence > 1:
+        raise ValueError(
+            "train.loss_chunk does not compose with sequence parallelism "
+            "(the chunk reshape would regather the 'sequence'-sharded "
+            "activations); set loss_chunk=0")
     step_fn = make_train_step(
         model,
         accum_steps=accum_steps,
@@ -329,6 +334,7 @@ def make_sharded_train_step(
         fp16_scale_window=cfg.train.fp16_scale_window,
         fp16_min_scale=cfg.train.fp16_min_scale,
         fp16_hysteresis=cfg.train.fp16_hysteresis,
+        loss_chunk=cfg.train.loss_chunk,
     )
 
     # Host offload (ds_config_zero3.json:19-27 parity): offloaded leaves

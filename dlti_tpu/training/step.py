@@ -54,6 +54,56 @@ def causal_lm_loss(
     return jnp.sum(token_loss * mask), jnp.sum(mask)
 
 
+def chunked_causal_lm_loss(
+    hidden: jnp.ndarray,
+    lm_head: jnp.ndarray,
+    input_ids: jnp.ndarray,
+    loss_mask: Optional[jnp.ndarray] = None,
+    chunk: int = 128,
+) -> tuple:
+    """:func:`causal_lm_loss` without ever materializing (B, S, V) logits.
+
+    The LM-head matmul + softmax-CE run per sequence chunk inside a
+    rematerialized ``lax.scan``: peak fp32 logit memory drops from
+    S*vocab to chunk*vocab per example, and the backward recomputes each
+    chunk's logits instead of storing them. Identical math to the
+    unchunked loss up to summation order. At 7B/seq-512/vocab-32k this
+    frees ~2 GB of what ``results/mfu_investigation_r03.json`` measured
+    as the binding HBM constraint once the frozen base is int8.
+
+    Not for sequence-parallel runs: the chunk reshape would regather a
+    'sequence'-sharded activation.
+    """
+    x = hidden[:, :-1, :]
+    targets = input_ids[:, 1:]
+    if loss_mask is None:
+        mask = jnp.ones_like(targets, dtype=jnp.float32)
+    else:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+    b, s1, h = x.shape
+    n = -(-s1 // chunk)
+    pad = n * chunk - s1
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    xs = x.reshape(b, n, chunk, h).transpose(1, 0, 2, 3)
+    ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    ms = mask.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    def body(carry, inp):
+        xc, tc, mc = inp
+        logits = jnp.dot(xc, lm_head,
+                         preferred_element_type=jnp.float32).astype(jnp.float32)
+        tl = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return (carry[0] + jnp.sum(tl * mc), carry[1] + jnp.sum(mc)), None
+
+    (loss_sum, n_tok), _ = jax.lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
+        (xs, ts, ms))
+    return loss_sum, n_tok
+
+
 def make_train_step(
     model,
     *,
@@ -63,6 +113,7 @@ def make_train_step(
     fp16_scale_window: int = 1000,
     fp16_min_scale: float = 1.0,
     fp16_hysteresis: int = 2,
+    loss_chunk: int = 0,
 ) -> Callable:
     """Build ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -90,6 +141,10 @@ def make_train_step(
     model_cfg = getattr(model, "cfg", None)
     moe_coef = (model_cfg.router_aux_loss_coef
                 if model_cfg is not None and model_cfg.num_experts > 0 else 0.0)
+    if loss_chunk and moe_coef:
+        raise ValueError(
+            "loss_chunk does not compose with MoE aux-loss collection; "
+            "set train.loss_chunk=0 for MoE models")
 
     def microbatch_loss(trainable, frozen, micro, rng):
         params = combine_params(trainable, frozen)
@@ -120,10 +175,19 @@ def make_train_step(
             from dlti_tpu.models.moe import collect_aux_loss
 
             aux = collect_aux_loss(variables.get("intermediates", {}))
+        elif loss_chunk:  # MoE+loss_chunk rejected at build time above
+            hidden, _ = model.apply({"params": params}, input_ids,
+                                    return_hidden=True, **apply_kwargs)
+            aux = 0.0
         else:
             logits, _ = model.apply({"params": params}, input_ids, **apply_kwargs)
             aux = 0.0
-        loss_sum, n_tok = causal_lm_loss(logits, input_ids, loss_mask)
+        if loss_chunk:
+            loss_sum, n_tok = chunked_causal_lm_loss(
+                hidden, model.head_matrix(params, hidden),
+                input_ids, loss_mask, loss_chunk)
+        else:
+            loss_sum, n_tok = causal_lm_loss(logits, input_ids, loss_mask)
         # Weight the (per-microbatch mean) aux loss by tokens so the final
         # /n_tok gives ce_mean + coef * token-weighted-mean(aux). The
         # differentiated objective carries the aux term; reported metrics
@@ -235,19 +299,33 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model) -> Callable:
-    """Build ``eval_step(state, batch) -> metrics`` (no dropout, no update)."""
+def make_eval_step(model, loss_chunk: int = 0) -> Callable:
+    """Build ``eval_step(state, batch) -> metrics`` (no dropout, no update).
+
+    ``loss_chunk`` mirrors the train step: a run whose HBM budget depends
+    on never materializing full fp32 logits must not OOM at its first
+    periodic eval.
+    """
 
     def eval_step(state: TrainState, batch: dict):
-        logits, _ = model.apply(
-            {"params": state.params}, batch["input_ids"],
+        kwargs = dict(
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"),
             deterministic=True,
         )
-        loss_sum, n_tok = causal_lm_loss(
-            logits, batch["input_ids"], batch.get("loss_mask")
-        )
+        if loss_chunk:
+            hidden, _ = model.apply(
+                {"params": state.params}, batch["input_ids"],
+                return_hidden=True, **kwargs)
+            loss_sum, n_tok = chunked_causal_lm_loss(
+                hidden, model.head_matrix(state.params, hidden),
+                batch["input_ids"], batch.get("loss_mask"), loss_chunk)
+        else:
+            logits, _ = model.apply(
+                {"params": state.params}, batch["input_ids"], **kwargs)
+            loss_sum, n_tok = causal_lm_loss(
+                logits, batch["input_ids"], batch.get("loss_mask")
+            )
         return {"loss": loss_sum / jnp.maximum(n_tok, 1.0), "num_tokens": n_tok}
 
     return eval_step

@@ -62,6 +62,9 @@ def _validate_pipeline_config(cfg: Config) -> None:
     if cfg.train.quantize_frozen_base:
         illegal.append("quantize_frozen_base (the pipelined embed/head "
                        "consume raw arrays)")
+    if cfg.train.loss_chunk:
+        illegal.append("loss_chunk (the pipelined last stage computes its "
+                       "own full-logits loss)")
     if cfg.model.num_experts > 0:
         illegal.append("MoE experts")
     if cfg.data.pack_sequences:
@@ -210,6 +213,7 @@ class Trainer:
                 fp16_scale_window=self.cfg.train.fp16_scale_window,
                 fp16_min_scale=self.cfg.train.fp16_min_scale,
                 fp16_hysteresis=self.cfg.train.fp16_hysteresis,
+                loss_chunk=self.cfg.train.loss_chunk,
             ),
             donate_argnums=(0,),
         )
@@ -314,7 +318,8 @@ class Trainer:
             else:
                 from dlti_tpu.training.step import make_eval_step
 
-                eval_fn = jax.jit(make_eval_step(self.model))
+                eval_fn = jax.jit(make_eval_step(
+                    self.model, loss_chunk=self.cfg.train.loss_chunk))
 
         # Profiler window state: "pending" -> "active" -> "done" (at most
         # one trace per run; ">=" so a resume past the start step still

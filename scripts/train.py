@@ -91,6 +91,11 @@ def parse_args():
                    help="store the frozen base params weight-only quantized "
                         "during LoRA training (QLoRA-style); halves base "
                         "HBM and buys activation-saving headroom")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="sequence-chunked cross-entropy: compute LM head + "
+                        "CE this many positions at a time so full fp32 "
+                        "logits never sit in HBM (0 = off; not for "
+                        "--sequence > 1 or MoE)")
     # Checkpointing (reference: save_steps=100, keep 3 — zero1:243-245).
     p.add_argument("--save-strategy", default="steps", choices=["steps", "epoch", "no"])
     p.add_argument("--save-steps", type=int, default=100)
@@ -226,6 +231,7 @@ def build_config(args):
                           logging_steps=args.logging_steps, seed=args.seed,
                           metrics_csv=args.metrics_csv, fp16=args.fp16,
                           quantize_frozen_base=args.quantize_base,
+                          loss_chunk=args.loss_chunk,
                           eval_steps=args.eval_steps,
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,

@@ -223,3 +223,53 @@ def test_preemption_checkpoint_and_resume(tmp_path, rng):
 
     s2 = restore_train_state(cfg.checkpoint.output_dir, stopped_at, s2)
     assert int(s2.step) == stopped_at
+
+
+def test_chunked_ce_matches_unchunked(rng):
+    """loss_chunk computes the identical loss and produces the identical
+    training trajectory as the full-logits path (up to summation order),
+    including the chunk-padding tail and with LoRA grads flowing."""
+    model, state_a = make_state(rng)
+    _, state_b = make_state(rng)
+    step_full = jax.jit(make_train_step(model, accum_steps=2))
+    # chunk=10 does not divide seq 32 -> exercises the padded tail.
+    step_chunk = jax.jit(make_train_step(model, accum_steps=2, loss_chunk=10))
+    batch = {
+        "input_ids": jax.random.randint(rng, (2, 2, 32), 0, CFG.vocab_size),
+        "loss_mask": jnp.ones((2, 2, 32), jnp.int32),
+    }
+    for i in range(5):
+        r = jax.random.fold_in(rng, i)
+        state_a, ma = step_full(state_a, batch, r)
+        state_b, mb = step_chunk(state_b, batch, r)
+        np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                                   rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-4)
+
+
+def test_chunked_ce_matches_unchunked_tied_int8(rng):
+    """head_matrix must track __call__'s head exactly for the other two
+    head variants: tied embeddings (fp32 projection) and an int8-quantized
+    frozen head."""
+    import dataclasses
+
+    from dlti_tpu.models.quantization import quantize_params_int8
+
+    cfg = dataclasses.replace(CFG, tie_embeddings=True)
+    lora_cfg = LoRAConfig(r=4, alpha=8, dropout=0.0)
+    model = LlamaForCausalLM(cfg, lora_cfg)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=2))
+    state = create_train_state(rng, model, tx, (2, 32), lora_enabled=True)
+    state = state.replace(params=quantize_params_int8(state.params))
+    batch = {
+        "input_ids": jax.random.randint(rng, (1, 2, 32), 0, cfg.vocab_size),
+        "loss_mask": jnp.ones((1, 2, 32), jnp.int32),
+    }
+    step_full = jax.jit(make_train_step(model, accum_steps=1))
+    step_chunk = jax.jit(make_train_step(model, accum_steps=1, loss_chunk=8))
+    _, ma = step_full(state, batch, rng)
+    _, mb = step_chunk(state, batch, rng)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=2e-5)
