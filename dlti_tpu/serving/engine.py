@@ -609,13 +609,22 @@ class InferenceEngine:
 
         Returns requests that finished during this step.
         """
-        newly_finished: List[Request] = []
+        # Async scheduling: dispatch the decode program FIRST (JAX dispatch
+        # is asynchronous — the host gets control back while the device
+        # works), then do admission prefills, whose host-side cost (and
+        # per-call RTT on relay-attached chips) hides under the in-flight
+        # decode; sync decode results last. Admitted slots were free when
+        # the decode was dispatched, so its block-table snapshot writes
+        # their rows to the trash block — no KV interleaving hazard — and
+        # they join the NEXT round's decode batch (their first token comes
+        # from prefill sampling either way, so TTFT only improves).
+        pending = None
+        if any(not s.free and not s.prefilling for s in self.slots):
+            pending = self._decode_dispatch()
         self._admit()
         if self.cfg.max_prefill_tokens_per_step > 0:
             self._prefill_work()
-        if any(not s.free and not s.prefilling for s in self.slots):
-            newly_finished.extend(self._decode_step())
-        return newly_finished
+        return self._decode_complete(pending) if pending is not None else []
 
     # ------------------------------------------------------------------
     # Scheduling internals
@@ -840,7 +849,12 @@ class InferenceEngine:
                 bt[s.slot_id] = 0
         return bt
 
-    def _decode_step(self) -> List[Request]:
+    def _decode_dispatch(self):
+        """Schedule this round's decode work and dispatch its program call
+        WITHOUT syncing: returns an opaque pending tuple whose device
+        arrays are still being computed, for :meth:`_decode_complete`.
+        All host mirrors are snapshotted here (jnp.asarray copies at call
+        time), so admission may mutate them while the call is in flight."""
         ec = self.cfg
         # Multi-step decode only when every active slot has room for the
         # whole window (writing past max_model_len would clip block-table
@@ -904,9 +918,9 @@ class InferenceEngine:
         active = [s for s in self.slots
                   if not s.free and not s.prefilling]
         if not active:
-            return []
+            return None
         if use_spec:
-            return self._spec_step(active)
+            return self._spec_dispatch(active)
 
         ids = np.zeros((ec.max_seqs, 1), np.int32)
         pos = np.zeros((ec.max_seqs, 1), np.int32)  # inactive -> trash block
@@ -927,6 +941,13 @@ class InferenceEngine:
             self.cache, tokens, logprobs = self._decode_fn(*args)
             tokens = tokens[:, None]
             logprobs = logprobs[:, None]
+        return ("plain", active, k_steps, tokens, logprobs)
+
+    def _decode_complete(self, pending) -> List[Request]:
+        """Sync a dispatched decode round's results and walk emissions."""
+        if pending[0] == "spec":
+            return self._spec_complete(pending)
+        _, active, k_steps, tokens, logprobs = pending
         tokens = np.asarray(jax.device_get(tokens))      # (S, k_steps)
         logprobs = np.asarray(jax.device_get(logprobs))
         self.stats["decode_steps"] += k_steps
@@ -968,13 +989,8 @@ class InferenceEngine:
             self._spec_win_prop = 0
             self._spec_win_acc = 0
 
-    def _spec_step(self, active: List[_Slot]) -> List[Request]:
-        """Run the fused propose→verify→accept program and walk its
-        emissions. Per slot per round the device reports how many tokens
-        were emitted (greedy: accepted prefix + bonus; sampling: exactly
-        one); the host consumes them in order, stopping a slot at
-        EOS/limit and discarding the rest of its window (same contract as
-        multi-step decode)."""
+    def _spec_dispatch(self, active: List[_Slot]):
+        """Dispatch the fused propose→verify→accept program (no sync)."""
         ec = self.cfg
         k, R = ec.num_draft_tokens, self._spec_rounds
         t_in = np.zeros((ec.max_seqs,), np.int32)
@@ -1000,6 +1016,16 @@ class InferenceEngine:
             jnp.asarray(self._temperature), jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
+        return ("spec", active, toks, lps, emit, prop, acc)
+
+    def _spec_complete(self, pending) -> List[Request]:
+        """Sync a dispatched spec round and walk its emissions. Per slot
+        per round the device reports how many tokens were emitted (greedy:
+        accepted prefix + bonus; sampling: exactly one); the host consumes
+        them in order, stopping a slot at EOS/limit and discarding the
+        rest of its window (same contract as multi-step decode)."""
+        _, active, toks, lps, emit, prop, acc = pending
+        R = self._spec_rounds
         toks = np.asarray(jax.device_get(toks))   # (S, R, k+1)
         lps = np.asarray(jax.device_get(lps))
         emit = np.asarray(jax.device_get(emit))   # (S, R)

@@ -91,6 +91,16 @@ def parse_args():
                    help="store the frozen base params weight-only quantized "
                         "during LoRA training (QLoRA-style); halves base "
                         "HBM and buys activation-saving headroom")
+    p.add_argument("--remat-policy", default=None,
+                   choices=["none", "nothing_saveable", "dots_saveable",
+                            "dots_with_no_batch_dims_saveable",
+                            "save_attn_out"],
+                   help="activation-saving policy for jax.checkpoint "
+                        "('none' disables remat entirely — fits at 7B bs4 "
+                        "once the base is int8; default: preset's)")
+    p.add_argument("--remat-stride", type=int, default=0,
+                   help="keep every Nth block's activations (selective "
+                        "remat; 0 = preset)")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help="sequence-chunked cross-entropy: compute LM head + "
                         "CE this many positions at a time so full fp32 "
@@ -209,6 +219,14 @@ def build_config(args):
         # overflow); without --fp16 the TPU default bf16 stays.
         model_cfg = dataclasses.replace(model_cfg, dtype="float16",
                                         param_dtype="float16")
+    if args.remat_policy == "none":
+        model_cfg = dataclasses.replace(model_cfg, remat=False)
+    elif args.remat_policy:
+        model_cfg = dataclasses.replace(model_cfg,
+                                        remat_policy=args.remat_policy)
+    if args.remat_stride:
+        model_cfg = dataclasses.replace(model_cfg,
+                                        remat_stride=args.remat_stride)
 
     return cfg.replace(
         model=model_cfg,
