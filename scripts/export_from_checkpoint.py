@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Offline consolidated export: training checkpoint -> servable model.
+
+The sharded-training-state -> portable-single-artifact capability
+(``stage3_gather_16bit_weights_on_model_save`` parity, SURVEY.md §5.4)
+WITHOUT a live device: the checkpoint is restored host-side from disk
+(shapes come from ``jax.eval_shape`` over the same state constructor
+``scripts/train.py`` uses, so int8 ``{q, scale}`` leaves line up), LoRA
+is merged, int8 dequantized, and the result written as a normal export
+that ``scripts/serve.py --model-dir`` loads.
+
+Exists for links where fetching a 7B tree from the device is slow or
+flaky (the checkpoint already on disk is the source of truth), and for
+exporting on machines with no accelerator at all.
+
+Usage:
+    python scripts/export_from_checkpoint.py --checkpoint-dir runs/7b \
+        --model llama2_7b --lora-r 16 --quantize-base int8 \
+        --out exports/merged_7b
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Source checkout wins over any installed copy; an installed dlti-tpu
+# serves scripts run from outside a checkout.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="checkpoint -> merged servable export (host-side)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--step", type=int, default=0, help="0 = latest")
+    p.add_argument("--model", default="llama2_7b")
+    p.add_argument("--lora-r", type=int, default=16)
+    p.add_argument("--quantize-base", default="", choices=["", "int8"])
+    p.add_argument("--seq-len", type=int, default=512,
+                   help="example shape used at train init (shapes only)")
+    p.add_argument("--out", required=True)
+    p.add_argument("--keep-lora", action="store_true",
+                   help="export unmerged (adapter factors kept as leaves)")
+    p.add_argument("--fp16", action="store_true",
+                   help="checkpoint came from an --fp16 run (its state "
+                        "carries the dynamic loss scaler subtree)")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dlti_tpu.checkpoint import (
+        export_merged_model, latest_step, restore_train_state,
+    )
+    from dlti_tpu.config import Config, LoRAConfig, OptimizerConfig, preset
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.training import build_optimizer, create_train_state
+
+    cfg: Config = preset("baseline", model=args.model)
+    cfg = cfg.replace(
+        lora=LoRAConfig(enabled=args.lora_r > 0, r=max(args.lora_r, 1),
+                        alpha=2 * max(args.lora_r, 1)))
+
+    def make_state():
+        model = LlamaForCausalLM(cfg.model, cfg.lora if cfg.lora.enabled else None)
+        tx = build_optimizer(OptimizerConfig())
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, (1, args.seq_len),
+            lora_enabled=cfg.lora.enabled,
+            fp16_initial_scale=2.0 ** 16 if args.fp16 else None)
+        if args.quantize_base:
+            from dlti_tpu.models.quantization import quantize_params_int8
+
+            state = state.replace(
+                params=quantize_params_int8(state.params))
+        return state
+
+    # eval_shape materializes nothing; orbax needs each abstract leaf to
+    # carry a concrete sharding, so pin them all to host CPU.
+    host = jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0])
+    template = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=host)
+        if hasattr(s, "shape") else s,
+        jax.eval_shape(make_state))
+    step = args.step or latest_step(args.checkpoint_dir)
+    if step is None:
+        raise SystemExit(f"no checkpoints under {args.checkpoint_dir}")
+    print(f"restoring step {step} from {args.checkpoint_dir} (host-side)")
+    state = restore_train_state(args.checkpoint_dir, step, template)
+    out = export_merged_model(args.out, state.params, cfg,
+                              merge_lora=not args.keep_lora)
+    print(f"export -> {out}")
+
+
+if __name__ == "__main__":
+    main()
