@@ -45,7 +45,7 @@ STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
 def _try_run(model_name: str, micro_bs: int, quant: str = "",
              remat_policy: str = "", remat_stride: int = 0,
-             loss_chunk: int = 0):
+             loss_chunk: int = 0, sync: int = 1):
     import dataclasses
 
     from dlti_tpu.config import MODEL_PRESETS, LoRAConfig, OptimizerConfig
@@ -79,27 +79,47 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
             params=quantize_params_int8(state.params, donate=True))
         jax.block_until_ready(jax.tree_util.tree_leaves(state.params)[0])
 
-    step = jax.jit(make_train_step(model, accum_steps=1,
-                                   loss_chunk=loss_chunk),
-                   donate_argnums=(0,))
+    base_step = make_train_step(model, accum_steps=1, loss_chunk=loss_chunk)
     batch = {
         "input_ids": jax.random.randint(rng, (1, micro_bs, SEQ), 0, cfg.vocab_size),
         "loss_mask": jnp.ones((1, micro_bs, SEQ), jnp.int32),
     }
-    # Warmup (compile + 2 steps). NOTE: on the remote-relay PJRT backend in
+    # Warmup (compile + 2 calls). NOTE: on the remote-relay PJRT backend in
     # this image, jax.block_until_ready returns before device work finishes,
     # so all timing synchronizes via device_get (a real data dependency) —
     # slightly pessimistic (no host/device pipelining) but honest.
-    state, m = step(state, batch, rng)
-    float(jax.device_get(m["loss"]))
-    state, m = step(state, batch, rng)
-    float(jax.device_get(m["loss"]))
+    if sync > 1:
+        # Trainer's steps_per_sync path (the same make_multi_step the
+        # Trainer scans): `sync` whole optimizer steps per compiled
+        # program, one host sync per window — amortizes the fixed
+        # per-call dispatch/relay round-trip.
+        from dlti_tpu.training import make_multi_step
+
+        step = make_multi_step(base_step)
+        batches = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (sync,) + x.shape), batch)
+
+        def run(state, i):
+            rngs = jax.vmap(
+                lambda j: jax.random.fold_in(rng, i * sync + j)
+            )(jnp.arange(sync))
+            state, ms = step(state, batches, rngs)
+            return state, float(jax.device_get(ms["loss"][-1]))
+    else:
+        step = jax.jit(base_step, donate_argnums=(0,))
+
+        def run(state, i):
+            state, m = step(state, batch, jax.random.fold_in(rng, i))
+            return state, float(jax.device_get(m["loss"]))
+
+    # Warmup (indices past the timed range: fold_in rejects negatives).
+    state, loss_val = run(state, STEPS)
+    state, loss_val = run(state, STEPS + 1)
 
     t0 = time.perf_counter()
     for i in range(STEPS):
-        state, m = step(state, batch, jax.random.fold_in(rng, i))
-        loss_val = float(jax.device_get(m["loss"]))
-    dt = (time.perf_counter() - t0) / STEPS
+        state, loss_val = run(state, i)
+    dt = (time.perf_counter() - t0) / (STEPS * sync)
     tok_s = micro_bs * SEQ / dt
     return tok_s, dt, trainable, total, loss_val
 
@@ -118,15 +138,20 @@ def main() -> None:
                            quant=quant,
                            remat_policy=os.environ.get("BENCH_REMAT", ""),
                            remat_stride=int(os.environ.get("BENCH_STRIDE", 0)),
-                           loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 0)))]
+                           loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 0)),
+                           sync=int(os.environ.get("BENCH_SYNC", 1)))]
     else:
         # Ordered by measured throughput on the v5e-class 16 GB chip
         # (results/mfu_investigation_r03.json): int8 frozen base frees
-        # ~6.7 GB of base-weight HBM, which buys activation saving
-        # (remat_policy / stride) — the binding constraint at bf16
-        # (results/mfu_investigation_r02.json). Winner: 51.6% MFU at bs4
-        # with matmul outputs saved (vs 40.8% bf16 in r02).
+        # ~6.7 GB of base-weight HBM so remat can be disabled entirely
+        # (the binding constraint at bf16 —
+        # results/mfu_investigation_r02.json), and steps_per_sync=10
+        # scans 10 optimizer steps per compiled call, amortizing the
+        # fixed dispatch/relay round-trip. Winner: 64.2% MFU / 4,677
+        # tok/s at int8 bs4 no-remat sync=10 (vs 40.8% bf16 in r02).
         candidates = [
+            dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none",
+                 sync=10),
             dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none"),
             dict(model="llama2_7b", bs=4, quant="int8",
                  remat_policy="dots_with_no_batch_dims_saveable"),
@@ -148,7 +173,8 @@ def main() -> None:
                 c["model"], c["bs"], quant=c.get("quant", ""),
                 remat_policy=c.get("remat_policy", ""),
                 remat_stride=c.get("remat_stride", 0),
-                loss_chunk=c.get("loss_chunk", 0))
+                loss_chunk=c.get("loss_chunk", 0),
+                sync=c.get("sync", 1))
             result = (c, tok_s, dt, trainable, total, loss)
             break
         except Exception as e:  # OOM or compile failure: try the next config
@@ -189,6 +215,7 @@ def main() -> None:
         "quantize_frozen_base": c.get("quant", ""),
         "remat_policy": c.get("remat_policy", ""),
         "remat_stride": c.get("remat_stride", 0),
+        "steps_per_sync": c.get("sync", 1),
     }))
 
 

@@ -2,7 +2,11 @@
 
 from dlti_tpu.training.optimizer import build_optimizer, build_schedule  # noqa: F401
 from dlti_tpu.training.state import TrainState, create_train_state  # noqa: F401
-from dlti_tpu.training.step import make_train_step, causal_lm_loss  # noqa: F401
+from dlti_tpu.training.step import (  # noqa: F401
+    causal_lm_loss,
+    make_multi_step,
+    make_train_step,
+)
 
 
 def __getattr__(name):

@@ -299,6 +299,30 @@ def make_train_step(
     return train_step
 
 
+def make_multi_step(step_fn: Callable) -> Callable:
+    """Scan K whole train steps into ONE compiled program.
+
+    ``multi(state, batches, rngs)``: ``batches`` is a step-stacked batch
+    pytree (leading axis K) and ``rngs`` a (K, ...) key array; returns the
+    state after K steps plus step-stacked metrics. The training analog of
+    the serving engine's multi-step decode: every compiled-program call
+    pays a fixed dispatch/round-trip cost (~95 ms on this image's
+    relay-attached chip — results/mfu_investigation_r03.json), and the
+    scan amortizes it K-fold. The trajectory equals K separate calls when
+    the caller pre-splits the same per-step rngs; a jitted ``step_fn`` is
+    traced inline, keeping its sharding constraints.
+    """
+
+    def multi(state, batches, rngs):
+        def body(st, inp):
+            b, r = inp
+            return step_fn(st, b, r)
+
+        return jax.lax.scan(body, state, (batches, rngs))
+
+    return jax.jit(multi, donate_argnums=(0,))
+
+
 def make_eval_step(model, loss_chunk: int = 0) -> Callable:
     """Build ``eval_step(state, batch) -> metrics`` (no dropout, no update).
 

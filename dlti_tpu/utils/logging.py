@@ -47,17 +47,24 @@ class StepTimer:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self) -> None:
+    def stop(self, steps: int = 1) -> None:
+        """``steps`` > 1: the timed span covered that many train steps
+        (a steps_per_sync window); record the per-step time. Warm-up is
+        counted in *steps*, so a scanned window past the warm-up budget
+        still records (else steps_per_sync=K with max_steps=2K would
+        discard every window and report 0 tok/s)."""
         dt = time.perf_counter() - self._t0
-        self._count += 1
-        if self._count > self.warmup_steps:
-            self._times.append(dt)
+        steps = max(steps, 1)
+        warm = self._count < self.warmup_steps
+        self._count += steps
+        if not warm:
+            self._times.append(dt / steps)
 
     @contextmanager
-    def measure(self):
+    def measure(self, steps: int = 1):
         self.start()
         yield
-        self.stop()
+        self.stop(steps)
 
     @property
     def mean_step_seconds(self) -> float:

@@ -106,6 +106,11 @@ def parse_args():
                         "CE this many positions at a time so full fp32 "
                         "logits never sit in HBM (0 = off; not for "
                         "--sequence > 1 or MoE)")
+    p.add_argument("--steps-per-sync", type=int, default=1,
+                   help="optimizer steps per compiled program call (scanned "
+                        "window; same trajectory as 1, metrics stay "
+                        "per-step, eval/saves land at window boundaries; "
+                        "not with --offload-* or multi-host)")
     # Checkpointing (reference: save_steps=100, keep 3 — zero1:243-245).
     p.add_argument("--save-strategy", default="steps", choices=["steps", "epoch", "no"])
     p.add_argument("--save-steps", type=int, default=100)
@@ -250,6 +255,7 @@ def build_config(args):
                           metrics_csv=args.metrics_csv, fp16=args.fp16,
                           quantize_frozen_base=args.quantize_base,
                           loss_chunk=args.loss_chunk,
+                          steps_per_sync=args.steps_per_sync,
                           eval_steps=args.eval_steps,
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,

@@ -189,3 +189,45 @@ def test_pipeline_train_step_matches_single_device(pipe_mesh):
     want = np.asarray(
         ref_state.params["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_steps_per_sync_matches(tmp_path):
+    """steps_per_sync composes with the GPipe Trainer path: a scanned
+    2-step window reproduces the per-step pipelined trajectory."""
+    from dlti_tpu.config import CheckpointConfig, MODEL_PRESETS
+    from dlti_tpu.training.trainer import Trainer
+
+    rng = jax.random.PRNGKey(0)
+
+    def run(k):
+        cfg = Config(
+            model=MODEL_PRESETS["llama_tiny"],
+            lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+            optimizer=OptimizerConfig(warmup_steps=1),
+            parallel=ParallelConfig(pipe=2),
+            data=DataConfig(max_seq_len=16),
+            train=TrainConfig(num_epochs=1, micro_batch_size=2,
+                              grad_accum_steps=8, logging_steps=100,
+                              steps_per_sync=k,
+                              metrics_csv=str(tmp_path / f"mp{k}.csv")),
+            checkpoint=CheckpointConfig(save_strategy="no"),
+        )
+        batches = [
+            {"input_ids": np.asarray(jax.random.randint(
+                jax.random.fold_in(rng, i), (8, 2, 16), 0,
+                cfg.model.vocab_size)),
+             "loss_mask": np.ones((8, 2, 16), np.int32)}
+            for i in range(4)]
+        t = Trainer(cfg)
+        state, rec = t.train(batches_per_epoch=batches,
+                             state=t.init_state(jax.random.fold_in(rng, 99)))
+        return state, rec
+
+    s1, r1 = run(1)
+    s2, r2 = run(2)
+    assert int(jax.device_get(s1.step)) == int(jax.device_get(s2.step)) == 4
+    np.testing.assert_allclose(r1.final_loss, r2.final_loss, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)

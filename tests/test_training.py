@@ -273,3 +273,158 @@ def test_chunked_ce_matches_unchunked_tied_int8(rng):
     _, ma = step_full(state, batch, rng)
     _, mb = step_chunk(state, batch, rng)
     np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=2e-5)
+
+
+def test_steps_per_sync_matches_per_step(tmp_path, rng):
+    """TrainConfig.steps_per_sync: a scanned K-step window must produce the
+    SAME trajectory as K separate calls (same data + per-step rng split),
+    including the epoch-tail partial window that runs per-step."""
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    def run(k):
+        cfg = Config(
+            model=MODEL_PRESETS["llama_tiny"],
+            lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+            optimizer=OptimizerConfig(warmup_steps=1),
+            parallel=ParallelConfig(),
+            data=DataConfig(max_seq_len=16),
+            train=TrainConfig(num_epochs=1, micro_batch_size=2,
+                              grad_accum_steps=1, logging_steps=100,
+                              steps_per_sync=k,
+                              metrics_csv=str(tmp_path / f"m{k}.csv")),
+            checkpoint=CheckpointConfig(save_strategy="no"),
+        )
+        # 7 batches with K=3: two full scanned windows + a 1-step tail
+        # through the per-step path.
+        batches = [
+            {"input_ids": np.asarray(jax.random.randint(
+                jax.random.fold_in(rng, i), (1, 2, 16), 0,
+                cfg.model.vocab_size)),
+             "loss_mask": np.ones((1, 2, 16), np.int32)}
+            for i in range(7)
+        ]
+        trainer = Trainer(cfg)
+        state, record = trainer.train(batches_per_epoch=batches,
+                                      state=trainer.init_state(
+                                          jax.random.fold_in(rng, 99)))
+        return state, record
+
+    s1, r1 = run(1)
+    s3, r3 = run(3)
+    assert int(s1.step) == int(s3.step) == 7
+    np.testing.assert_allclose(r1.final_loss, r3.final_loss, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s3.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_steps_per_sync_max_steps_cap(tmp_path, rng):
+    """A window never overshoots max_steps: the last window shrinks to the
+    remaining step budget (and runs per-step, shape-stable)."""
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        model=MODEL_PRESETS["llama_tiny"],
+        lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=1),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16),
+        train=TrainConfig(num_epochs=1, max_steps=5, micro_batch_size=2,
+                          grad_accum_steps=1, logging_steps=100,
+                          steps_per_sync=3,
+                          metrics_csv=str(tmp_path / "m.csv")),
+        checkpoint=CheckpointConfig(save_strategy="no"),
+    )
+    batch = {"input_ids": np.zeros((1, 2, 16), np.int32) + 5,
+             "loss_mask": np.ones((1, 2, 16), np.int32)}
+    trainer = Trainer(cfg)
+    state, record = trainer.train(batches_per_epoch=[batch] * 20)
+    assert int(state.step) == 5
+
+
+def test_steps_per_sync_sharded_zero3(tmp_path, rng):
+    """steps_per_sync composes with the sharded (ZeRO-3 FSDP) step: the
+    scanned window traces the jitted sharded step inline, keeping its
+    sharding constraints; trajectory matches the per-step sharded run."""
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig, ZeROStage)
+    from dlti_tpu.training.trainer import Trainer
+
+    if jax.device_count() < 4:
+        pytest.skip("needs the 4+-device CPU mesh")
+
+    def run(k):
+        cfg = Config(
+            model=MODEL_PRESETS["llama_tiny"],
+            lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+            optimizer=OptimizerConfig(warmup_steps=1),
+            parallel=ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4),
+            data=DataConfig(max_seq_len=16),
+            train=TrainConfig(num_epochs=1, micro_batch_size=4,
+                              grad_accum_steps=1, logging_steps=100,
+                              steps_per_sync=k,
+                              metrics_csv=str(tmp_path / f"ms{k}.csv")),
+            checkpoint=CheckpointConfig(save_strategy="no"),
+        )
+        batches = [
+            {"input_ids": np.asarray(jax.random.randint(
+                jax.random.fold_in(rng, 100 + i), (1, 4, 16), 0,
+                cfg.model.vocab_size)),
+             "loss_mask": np.ones((1, 4, 16), np.int32)}
+            for i in range(4)
+        ]
+        trainer = Trainer(cfg)
+        state, record = trainer.train(batches_per_epoch=batches,
+                                      state=trainer.init_state(
+                                          jax.random.fold_in(rng, 99)))
+        return state, record
+
+    s1, r1 = run(1)
+    s2, r2 = run(2)
+    assert int(jax.device_get(s1.step)) == int(jax.device_get(s2.step)) == 4
+    np.testing.assert_allclose(r1.final_loss, r2.final_loss, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_steps_per_sync_ragged_tail_batch(tmp_path, rng):
+    """A custom batches_per_epoch iterable whose final batch has a
+    different shape (drop_last=False pattern) must not crash the window
+    stack: the pending window drains per-step and the odd batch runs
+    alone — same outcome the per-step jit gives via recompile."""
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        model=MODEL_PRESETS["llama_tiny"],
+        lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=1),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16),
+        train=TrainConfig(num_epochs=1, micro_batch_size=2,
+                          grad_accum_steps=1, logging_steps=100,
+                          steps_per_sync=2,
+                          metrics_csv=str(tmp_path / "mr.csv")),
+        checkpoint=CheckpointConfig(save_strategy="no"),
+    )
+
+    def make(bs):
+        return {"input_ids": np.zeros((1, bs, 16), np.int32) + 3,
+                "loss_mask": np.ones((1, bs, 16), np.int32)}
+
+    batches = [make(2), make(2), make(2), make(1)]  # ragged tail
+    trainer = Trainer(cfg)
+    state, record = trainer.train(batches_per_epoch=batches)
+    assert int(state.step) == 4
