@@ -145,11 +145,13 @@ def main() -> None:
         # (results/mfu_investigation_r03.json): int8 frozen base frees
         # ~6.7 GB of base-weight HBM so remat can be disabled entirely
         # (the binding constraint at bf16 —
-        # results/mfu_investigation_r02.json), and steps_per_sync=10
-        # scans 10 optimizer steps per compiled call, amortizing the
-        # fixed dispatch/relay round-trip. Winner: 64.2% MFU / 4,677
-        # tok/s at int8 bs4 no-remat sync=10 (vs 40.8% bf16 in r02).
+        # results/mfu_investigation_r02.json), and steps_per_sync scans
+        # whole optimizer steps into one compiled call, amortizing the
+        # fixed dispatch/relay round-trip. Winner: 65.1% MFU / 4,746
+        # tok/s at int8 bs4 no-remat sync=20 (vs 40.8% bf16 in r02).
         candidates = [
+            dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none",
+                 sync=20),
             dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none",
                  sync=10),
             dict(model="llama2_7b", bs=4, quant="int8", remat_policy="none"),

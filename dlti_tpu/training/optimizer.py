@@ -13,9 +13,36 @@ into a handful of kernels.
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from dlti_tpu.config import OptimizerConfig
+
+
+def _fp32_state(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Keep optimizer state (Adam moments) in float32 for low-precision
+    params.
+
+    Gradients are always accumulated in fp32 (``training.step``), so
+    moments initialized in a param's bf16/fp16 dtype silently promote to
+    fp32 on the first update — a state-dtype morph that (a) poisons a
+    ``lax.scan`` carry (steps_per_sync windows require dtype-invariant
+    state) and (b) would lose second-moment precision if it ever stuck.
+    Upcasting at init is the standard mixed-precision recipe (fp32 master
+    optimizer state) and makes the state dtype stable from step 0.
+    Only LoRA-less full fine-tunes are affected: LoRA factors are already
+    fp32 master weights.
+    """
+
+    def init(params):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32)
+            if getattr(x, "dtype", None) in (jnp.bfloat16, jnp.float16)
+            else x,
+            inner.init(params))
+
+    return optax.GradientTransformation(init, inner.update)
 
 
 def build_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -42,7 +69,7 @@ def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     """Global-norm clip -> AdamW(schedule). Applied to the *trainable* subtree
     only (the step fn partitions LoRA vs frozen params before calling this),
     so optimizer state is allocated solely for trainable params."""
-    return optax.chain(
+    return _fp32_state(optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
         optax.adamw(
             learning_rate=build_schedule(cfg),
@@ -51,4 +78,4 @@ def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
             eps=cfg.eps,
             weight_decay=cfg.weight_decay,
         ),
-    )
+    ))

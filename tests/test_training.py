@@ -428,3 +428,96 @@ def test_steps_per_sync_ragged_tail_batch(tmp_path, rng):
     trainer = Trainer(cfg)
     state, record = trainer.train(batches_per_epoch=batches)
     assert int(state.step) == 4
+
+
+def test_steps_per_sync_preemption_drops_pending_window(tmp_path, rng):
+    """request_stop() while a window is filling: the queued (unrun)
+    batches are dropped, the checkpoint lands at the last executed step,
+    and resume replays the dropped batches (global_step never counted
+    them)."""
+    from dlti_tpu.checkpoint import latest_step
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        model=MODEL_PRESETS["llama_tiny"],
+        lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=1),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16),
+        train=TrainConfig(num_epochs=1, max_steps=40, micro_batch_size=2,
+                          grad_accum_steps=1, logging_steps=100,
+                          steps_per_sync=4,
+                          metrics_csv=str(tmp_path / "mp.csv")),
+        checkpoint=CheckpointConfig(output_dir=str(tmp_path / "ckpt"),
+                                    save_strategy="steps", save_steps=1000,
+                                    save_total_limit=2, async_save=False),
+    )
+    trainer = Trainer(cfg)
+    batch = {"input_ids": np.zeros((1, 2, 16), np.int32) + 7,
+             "loss_mask": np.ones((1, 2, 16), np.int32)}
+
+    def batches():
+        for i in range(40):
+            if i == 5:  # mid-window: one full window (4) has run, 1 queued
+                trainer.request_stop()
+            yield batch
+
+    state, record = trainer.train(batches_per_epoch=batches())
+    stopped_at = latest_step(cfg.checkpoint.output_dir)
+    # One full window executed (4 steps); the partially-filled second
+    # window was dropped, so the preemption checkpoint is at step 4.
+    assert stopped_at == 4
+    assert int(state.step) == 4
+
+
+def test_steps_per_sync_full_finetune(tmp_path, rng):
+    """Full fine-tune (bf16 params, no LoRA) under steps_per_sync: Adam
+    moments must be fp32 from init, or the first update's fp32 grads
+    morph the state dtype and the scan carry fails to typecheck
+    (regression: caught live by a 300M --lora-r 0 --steps-per-sync run).
+
+    The preset must actually carry bf16 params (llama_tiny is fp32, whose
+    moments are fp32 regardless) or this test guards nothing."""
+    import dataclasses
+
+    from dlti_tpu.config import (CheckpointConfig, Config, DataConfig,
+                                 LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from dlti_tpu.training.trainer import Trainer
+
+    bf16_tiny = dataclasses.replace(MODEL_PRESETS["llama_tiny"],
+                                    dtype="bfloat16", param_dtype="bfloat16")
+
+    def run(k):
+        cfg = Config(
+            model=bf16_tiny,
+            lora=LoRAConfig(enabled=False),
+            optimizer=OptimizerConfig(warmup_steps=1),
+            parallel=ParallelConfig(),
+            data=DataConfig(max_seq_len=16),
+            train=TrainConfig(num_epochs=1, micro_batch_size=2,
+                              grad_accum_steps=1, logging_steps=100,
+                              steps_per_sync=k,
+                              metrics_csv=str(tmp_path / f"mf{k}.csv")),
+            checkpoint=CheckpointConfig(save_strategy="no"),
+        )
+        batches = [
+            {"input_ids": np.asarray(jax.random.randint(
+                jax.random.fold_in(rng, 50 + i), (1, 2, 16), 0,
+                cfg.model.vocab_size)),
+             "loss_mask": np.ones((1, 2, 16), np.int32)}
+            for i in range(4)
+        ]
+        trainer = Trainer(cfg)
+        state, record = trainer.train(batches_per_epoch=batches,
+                                      state=trainer.init_state(
+                                          jax.random.fold_in(rng, 99)))
+        return state, record
+
+    s2, r2 = run(2)  # scans: would raise on a dtype-morphing carry
+    s1, r1 = run(1)
+    assert int(s1.step) == int(s2.step) == 4
+    np.testing.assert_allclose(r1.final_loss, r2.final_loss, rtol=1e-5)
