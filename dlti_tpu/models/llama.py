@@ -163,6 +163,8 @@ class LlamaAttention(nn.Module):
                 out = paged_decode_attention(
                     q, new_cache["k"], new_cache["v"],
                     cache["block_tables"], positions[:, 0] + 1,
+                    k_scale=new_cache.get("k_scale"),
+                    v_scale=new_cache.get("v_scale"),
                     window=cfg.sliding_window,
                     interpret=jax.default_backend() != "tpu",
                 ).astype(q.dtype)

@@ -30,6 +30,14 @@ from typing import List
 
 import jax.numpy as jnp
 
+# int8 KV: pools store symmetric per-token-per-kv-head int8 (absmax over
+# head_dim -> one fp32 scale per written row), halving KV HBM vs bf16 —
+# the pool is the serving engine's biggest allocation after the weights,
+# so the freed memory goes straight into more decode slots. Quantization
+# happens once at write (paged_update); consumers either dequantize after
+# gather (XLA fallback / prefill / TP path) or fold the scales into the
+# attention math in place (the Pallas decode kernel).
+
 
 def init_paged_cache(
     num_layers: int,
@@ -39,12 +47,35 @@ def init_paged_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
 ) -> List[dict]:
-    """Allocate the physical block pools, one ``{"k", "v"}`` dict per layer."""
+    """Allocate the physical block pools, one ``{"k", "v"}`` dict per layer.
+
+    ``dtype="int8"`` (the string, or ``jnp.int8``) selects the quantized
+    pool layout: int8 payloads plus ``{"k_scale", "v_scale"}`` fp32 arrays
+    of shape ``(num_blocks, block_size, kv_heads)``.
+    """
     shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    if dtype == "int8" or dtype == jnp.int8:
+        sshape = (num_blocks, block_size, num_kv_heads)
+        return [
+            {"k": jnp.zeros(shape, jnp.int8),
+             "v": jnp.zeros(shape, jnp.int8),
+             "k_scale": jnp.zeros(sshape, jnp.float32),
+             "v_scale": jnp.zeros(sshape, jnp.float32)}
+            for _ in range(num_layers)
+        ]
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(num_layers)
     ]
+
+
+def _quantize_rows(x: jnp.ndarray):
+    """Per-(token, kv_head) symmetric int8 over the trailing head_dim."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale[..., 0]
 
 
 def slot_mapping(block_tables: jnp.ndarray, positions: jnp.ndarray,
@@ -72,14 +103,26 @@ def paged_update(layer_cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray,
     k_pool, v_pool = layer_cache["k"], layer_cache["v"]
     nb, bs, kvh, hd = k_pool.shape
     flat = slots.reshape(-1)
+    out = dict(layer_cache)
+    if k_pool.dtype == jnp.int8:
+        kq, ks = _quantize_rows(k_new)
+        vq, vs = _quantize_rows(v_new)
+        out["k_scale"] = (layer_cache["k_scale"].reshape(nb * bs, kvh)
+                          .at[flat].set(ks.reshape(-1, kvh), mode="drop")
+                          .reshape(nb, bs, kvh))
+        out["v_scale"] = (layer_cache["v_scale"].reshape(nb * bs, kvh)
+                          .at[flat].set(vs.reshape(-1, kvh), mode="drop")
+                          .reshape(nb, bs, kvh))
+        k_new, v_new = kq, vq
     k_flat = k_pool.reshape(nb * bs, kvh, hd)
     v_flat = v_pool.reshape(nb * bs, kvh, hd)
-    k_flat = k_flat.at[flat].set(k_new.reshape(-1, kvh, hd).astype(k_pool.dtype),
-                                 mode="drop")
-    v_flat = v_flat.at[flat].set(v_new.reshape(-1, kvh, hd).astype(v_pool.dtype),
-                                 mode="drop")
-    return {**layer_cache, "k": k_flat.reshape(nb, bs, kvh, hd),
-            "v": v_flat.reshape(nb, bs, kvh, hd)}
+    out["k"] = k_flat.at[flat].set(
+        k_new.reshape(-1, kvh, hd).astype(k_pool.dtype),
+        mode="drop").reshape(nb, bs, kvh, hd)
+    out["v"] = v_flat.at[flat].set(
+        v_new.reshape(-1, kvh, hd).astype(v_pool.dtype),
+        mode="drop").reshape(nb, bs, kvh, hd)
+    return out
 
 
 def paged_gather(layer_cache: dict, block_tables: jnp.ndarray):
@@ -94,4 +137,11 @@ def paged_gather(layer_cache: dict, block_tables: jnp.ndarray):
     b, max_blk = block_tables.shape
     k = k_pool[block_tables].reshape(b, max_blk * bs, kvh, hd)
     v = v_pool[block_tables].reshape(b, max_blk * bs, kvh, hd)
+    if k_pool.dtype == jnp.int8:
+        # Dequantize the gathered window (gather moves 1/2 the bytes of a
+        # bf16 pool; the expansion happens on the small window).
+        ks = layer_cache["k_scale"][block_tables].reshape(b, max_blk * bs, kvh, 1)
+        vs = layer_cache["v_scale"][block_tables].reshape(b, max_blk * bs, kvh, 1)
+        k = (k.astype(jnp.float32) * ks).astype(jnp.bfloat16)
+        v = (v.astype(jnp.float32) * vs).astype(jnp.bfloat16)
     return k, v

@@ -41,9 +41,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scratch, l_scratch, acc_scratch,
-                   *, scale: float, block_size: int, window: int):
+def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, *rest,
+                   scale: float, block_size: int, window: int,
+                   quantized: bool):
+    if quantized:
+        # int8 pools travel with (1, block_size, kv_heads) fp32 scale
+        # tiles; the scales fold into the attention math per kv position
+        # (s *= k_scale, p *= v_scale) — no dequantized K/V tile is ever
+        # materialized. The scale tile's minor dim is kv_heads (< the
+        # 128-lane Mosaic tile): Mosaic pads it, costing a few KB of
+        # VMEM per block against the 64+ KB int8 payload — validated on
+        # hardware (results/int8_kv_7b.json).
+        ks_ref, vs_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        (o_ref, m_scratch, l_scratch, acc_scratch), ks_ref, vs_ref = rest, None, None
     b = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -69,6 +80,9 @@ def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                          # (kvh, hpg, bs)
+        if ks_ref is not None:
+            ks = jnp.swapaxes(ks_ref[0].astype(jnp.float32), 0, 1)
+            s = s * ks[:, None, :]                         # (kvh, 1, bs)
 
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 2)
@@ -83,8 +97,12 @@ def _decode_kernel(seq_lens_ref, block_tables_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
         alpha = jnp.exp(m_prev - m_new)
         l_scratch[:] = alpha * l_scratch[:] + jnp.sum(p, axis=2, keepdims=True)
+        pv = p
+        if vs_ref is not None:
+            vs = jnp.swapaxes(vs_ref[0].astype(jnp.float32), 0, 1)
+            pv = p * vs[:, None, :]                        # (kvh, 1, bs)
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))),
+            pv, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         m_scratch[:] = m_new
@@ -103,6 +121,8 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,
     seq_lens: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     window: int | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -116,6 +136,10 @@ def paged_decode_attention(
         masked, never read into the result).
       seq_lens: ``(batch,)`` int32 — tokens valid per sequence *including*
         the current one (i.e. query position + 1).
+      k_scale / v_scale: for int8 pools, the ``(num_blocks, block_size,
+        kv_heads)`` fp32 per-row scales (``ops.kv_cache`` int8 layout);
+        folded into the attention math in place — required iff the pools
+        are int8.
       window: Mistral-style sliding window — only the last ``window``
         positions stay visible; whole blocks outside the band are skipped.
 
@@ -137,24 +161,39 @@ def paged_decode_attention(
 
     grid = (batch, max_blocks)
 
+    quantized = k_pool.dtype == jnp.int8
+    if quantized and (k_scale is None or v_scale is None):
+        raise ValueError("int8 KV pools require k_scale/v_scale")
+
     def q_map(b, j, seq_lens_ref, bt_ref):
         return (b, 0, 0, 0)
 
     def kv_map(b, j, seq_lens_ref, bt_ref):
         return (bt_ref[b, j], 0, 0, 0)
 
+    def scale_map(b, j, seq_lens_ref, bt_ref):
+        return (bt_ref[b, j], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, kv_heads, hpg, head_dim), q_map),
+        pl.BlockSpec((1, block_size, kv_heads, head_dim), kv_map),
+        pl.BlockSpec((1, block_size, kv_heads, head_dim), kv_map),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, block_size, kv_heads), scale_map),
+                     pl.BlockSpec((1, block_size, kv_heads), scale_map)]
+        operands += [k_scale, v_scale]
+
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               block_size=block_size, window=window or 0)
+                               block_size=block_size, window=window or 0,
+                               quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, kv_heads, hpg, head_dim), q_map),
-                pl.BlockSpec((1, block_size, kv_heads, head_dim), kv_map),
-                pl.BlockSpec((1, block_size, kv_heads, head_dim), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, kv_heads, hpg, head_dim), q_map),
             scratch_shapes=[
                 pltpu.VMEM((kv_heads, hpg, 1), jnp.float32),
@@ -173,6 +212,6 @@ def paged_decode_attention(
                 * k_pool.dtype.itemsize + 2 * q.size * q.dtype.itemsize),
             transcendentals=batch * num_heads * max_blocks * block_size,
         ),
-    )(seq_lens, bt, qg, k_pool, v_pool)
+    )(seq_lens, bt, *operands)
 
     return out.reshape(batch, 1, num_heads, head_dim)

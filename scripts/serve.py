@@ -56,6 +56,11 @@ def parse_args():
     p.add_argument("--steps-per-sync", type=int, default=1,
                    help="decode iterations per compiled program (multi-step "
                         "scheduling; amortizes host round-trips)")
+    p.add_argument("--kv-cache-dtype", default="bfloat16",
+                   choices=["bfloat16", "float16", "float32", "int8"],
+                   help="KV pool dtype; int8 stores per-row-scaled "
+                        "payloads at half the bf16 HBM (roughly double "
+                        "the decode slots on a fixed chip)")
     p.add_argument("--quantization", default="none", choices=["none", "int8"],
                    help="weight-only quantization (int8 + per-channel scales; "
                         "~halves weight HBM)")
@@ -122,6 +127,7 @@ def main() -> None:
         eos_token_id=tok.eos_id,
         enable_prefix_caching=args.enable_prefix_caching,
         steps_per_sync=args.steps_per_sync,
+        cache_dtype=args.kv_cache_dtype,
         quantization=args.quantization,
         speculative=args.speculative,
         num_draft_tokens=args.num_draft_tokens,

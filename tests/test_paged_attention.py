@@ -116,3 +116,94 @@ def test_jit_and_grid_edge():
     want = _reference_decode(q, k_pool, v_pool, tables, jnp.asarray(seq_lens))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+# ----------------------------------------------------------------------
+# int8 KV pools
+# ----------------------------------------------------------------------
+
+def test_int8_pool_update_gather_roundtrip():
+    """paged_update quantizes per (token, kv_head); paged_gather returns
+    the dequantized window within the symmetric-int8 error bound."""
+    from dlti_tpu.ops.kv_cache import paged_update, slot_mapping
+
+    nb, bs, kvh, hd = 8, 4, 2, 16
+    cache = init_paged_cache(1, nb, bs, kvh, hd, "int8")[0]
+    assert cache["k"].dtype == jnp.int8
+    assert cache["k_scale"].shape == (nb, bs, kvh)
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((1, 6, kvh, hd)).astype(np.float32) * 3.0
+    bt = jnp.array([[2, 5]], jnp.int32)
+    pos = jnp.arange(6, dtype=jnp.int32)[None, :]
+    slots = slot_mapping(bt, pos, bs, nb)
+    cache = paged_update(cache, jnp.asarray(k), jnp.asarray(k), slots)
+    gk, gv = paged_gather(cache, bt)
+    got = np.asarray(gk[0, :6], np.float32)
+    bound = np.abs(k[0]).max(axis=-1, keepdims=True) / 127 + 1e-6
+    assert np.all(np.abs(got - k[0]) <= bound + np.abs(k[0]) * 0.01)
+    np.testing.assert_allclose(np.asarray(gv[0, :6], np.float32), got)
+
+
+def test_int8_pool_kernel_matches_dequant_reference():
+    """The Pallas kernel's in-place scale folding == gather+dequant+attend."""
+    batch, num_heads, kv_heads, head_dim = 3, 4, 2, 32
+    block_size, num_blocks, max_blocks = 8, 16, 4
+    seq_lens = np.array([5, 17, 32], np.int32)
+    kf, vf, tables = _random_paged_setup(
+        7, batch, num_heads, kv_heads, head_dim, block_size, num_blocks,
+        max_blocks, seq_lens)
+    # Quantize the pools the way paged_update stores them.
+    from dlti_tpu.ops.kv_cache import _quantize_rows
+
+    kq, ks = _quantize_rows(kf)
+    vq, vs = _quantize_rows(vf)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal(
+        (batch, 1, num_heads, head_dim)).astype(np.float32))
+
+    got = paged_decode_attention(
+        q, kq, vq, tables, jnp.asarray(seq_lens),
+        k_scale=ks, v_scale=vs, interpret=True)
+    # Reference: dequantized pools through the gather path.
+    kd = (kq.astype(jnp.float32) * ks[..., None])
+    vd = (vq.astype(jnp.float32) * vs[..., None])
+    want = _reference_decode(q, kd, vd, tables, jnp.asarray(seq_lens))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_int8_kv_engine_close_to_bf16(tmp_path):
+    """End-to-end: an int8-KV engine's greedy outputs track the bf16-KV
+    engine on a tiny model (same contract as the int8-weights test)."""
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+
+    cfg = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(cfg, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def mk(cache_dtype):
+        ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
+                          max_model_len=48, eos_token_id=-1,
+                          cache_dtype=cache_dtype)
+        return InferenceEngine(cfg, params, ec)
+
+    prompts = [[5, 9, 3, 7, 1], [11, 2, 6]]
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    want = mk("bfloat16").generate(prompts, sp)
+    got = mk("int8").generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert len(g.output_token_ids) == len(w.output_token_ids)
+        # A random tiny model's greedy argmax sits on near-ties, so
+        # trajectories may fork under quantization noise and never
+        # re-converge; the numerics contract lives in the kernel/roundtrip
+        # tests above. Here: the first (prefill-driven) token agrees, and
+        # logprobs stay close over the common prefix.
+        assert g.output_token_ids[0] == w.output_token_ids[0]
+        for a, b, la, lb in zip(g.output_token_ids, w.output_token_ids,
+                                g.output_logprobs, w.output_logprobs):
+            if a != b:
+                break
+            np.testing.assert_allclose(la, lb, atol=0.35)
