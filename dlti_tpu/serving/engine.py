@@ -346,6 +346,13 @@ class InferenceEngine:
         # Aggregate stats for the /stats endpoint and load reports.
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
+                      # active-slot x step units actually dispatched;
+                      # decode_slot_steps / (max_seqs * decode_steps) is
+                      # the mean slot occupancy — the first thing to look
+                      # at when throughput undershoots (synchronized
+                      # cohort retirement drains slots faster than
+                      # admission refills them; results/int8_kv_7b.json).
+                      "decode_slot_steps": 0,
                       "prefix_cached_tokens": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_paused_rounds": 0}
@@ -956,6 +963,7 @@ class InferenceEngine:
         tokens = np.asarray(jax.device_get(tokens))      # (S, k_steps)
         logprobs = np.asarray(jax.device_get(logprobs))
         self.stats["decode_steps"] += k_steps
+        self.stats["decode_slot_steps"] += len(active) * k_steps
 
         finished = []
         for s in active:
@@ -1037,6 +1045,7 @@ class InferenceEngine:
         prop = np.asarray(jax.device_get(prop))
         acc = np.asarray(jax.device_get(acc))
         self.stats["decode_steps"] += R
+        self.stats["decode_slot_steps"] += len(active) * R
 
         finished = []
         gate_rounds = 0

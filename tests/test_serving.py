@@ -724,3 +724,20 @@ def test_chunked_prefill_preemption_mid_prefill(tiny_model_and_params):
         toks.append(int(jnp.argmax(logits[0, -1])))
     assert b.output_token_ids == toks[24:]
     assert eng.block_manager.num_free == ec.num_blocks - 1
+
+
+def test_decode_slot_occupancy_stat(tiny_model_and_params):
+    """decode_slot_steps tracks active-slot x step units, bounding mean
+    occupancy: generated <= slot_steps <= max_seqs * decode_steps."""
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=64,
+                      max_model_len=48, cache_dtype="float32",
+                      eos_token_id=-1)
+    eng = InferenceEngine(CFG, params, ec)
+    eng.generate([[3, 1, 4], [1, 5, 9, 2], [6, 5]],
+                 SamplingParams(temperature=0.0, max_tokens=6))
+    st = eng.stats
+    assert st["decode_slot_steps"] > 0
+    assert st["decode_slot_steps"] <= ec.max_seqs * st["decode_steps"]
+    assert st["generated_tokens"] <= st["decode_slot_steps"] + len(
+        eng.finished)  # +1 prefill-sampled token per request
