@@ -1102,15 +1102,20 @@ class InferenceEngine:
             return True
         return False
 
-    def _release(self, slot: _Slot) -> None:
+    def _release(self, slot: _Slot, register: bool = True) -> None:
         if self.prefix_cache is not None and slot.request is not None:
             # Register the written full blocks for reuse (shared blocks get
             # their refcount dropped; the partial tail goes back to the
             # pool). A preempted mid-prefill slot has written only
             # next_pos tokens — caching past that would serve unwritten KV.
+            # ``register=False`` (abort after a faulted step): the slot's
+            # KV may never have been written at all, so drop shared refs
+            # and free owned blocks WITHOUT registering any content keys —
+            # an empty token chain does exactly that.
             req = slot.request
             n_written = slot.next_pos if slot.prefilling else slot.seq_len
-            written = (req.prompt_token_ids + req.output_token_ids)[:n_written]
+            written = ((req.prompt_token_ids + req.output_token_ids)[:n_written]
+                       if register else [])
             self.prefix_cache.release_sequence(written, slot.blocks)
         else:
             self.block_manager.free(slot.blocks)
@@ -1125,6 +1130,34 @@ class InferenceEngine:
         self._top_p[slot.slot_id] = 1.0
         self._slot_keys[slot.slot_id] = 0
         self._gen_counts[slot.slot_id] = 0
+
+    def abort_all(self, reason: str = "abort") -> List[Request]:
+        """Fail every in-flight and queued request and free their slots.
+
+        The server's step-failure recovery: after a faulted
+        ``engine.step()`` the queues' consumers are gone, so leaving the
+        requests in place would either hot-loop the same failing program
+        (persistent faults) or burn whole decode windows generating
+        tokens nobody reads (transient faults). Returns the aborted
+        requests (their ``finish_reason`` is set to ``reason``).
+        """
+        aborted: List[Request] = []
+        for slot in self.slots:
+            if slot.request is not None:
+                req = slot.request
+                req.finish_reason = reason
+                req.finish_time = time.monotonic()
+                aborted.append(req)
+                # register=False: the faulted step may never have written
+                # this slot's KV — registering it in the prefix cache
+                # would serve garbage to later cache hits.
+                self._release(slot, register=False)
+        while self.waiting:
+            req = self.waiting.popleft()
+            req.finish_reason = reason
+            req.finish_time = time.monotonic()
+            aborted.append(req)
+        return aborted
 
     def _preempt_youngest(self, exclude: _Slot) -> bool:
         """Evict the most-recently-arrived sequence back to the queue."""

@@ -80,6 +80,7 @@ class AsyncEngine:
         self._queues: Dict[str, queue.Queue] = {}
         self._seen: Dict[str, int] = {}
         self._stop = False
+        self._dead = False  # set when even fault recovery failed
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="dlti-engine-stepper")
         self._thread.start()
@@ -93,6 +94,9 @@ class AsyncEngine:
         """
         q: queue.Queue = queue.Queue()
         with self._work:
+            if self._dead:
+                raise RuntimeError(
+                    "engine is down (unrecoverable step fault)")
             req = self.engine.submit(prompt_ids, params, request_id)
             self._queues[req.request_id] = q
             self._seen[req.request_id] = 0
@@ -114,15 +118,47 @@ class AsyncEngine:
                     for q in self._queues.values():
                         q.put(("error", "server shutting down"))
                     return
-                try:
-                    self.engine.step()
-                except Exception as e:  # surface engine faults to all waiters
-                    self.logger.exception("engine step failed")
+            # Step OUTSIDE the lock: one step is a compiled-program call
+            # (>1 s at large steps_per_sync), and holding the lock across
+            # it serializes every HTTP submit against the device — the
+            # measured 54-66% slot occupancy under load vs 94% offline
+            # (results/int8_kv_7b.json). Concurrent engine.submit() only
+            # appends to the waiting deque (GIL-atomic) and touches its
+            # own stats key; admission consumes the deque at one point
+            # inside step(), so a racing submit lands this step or next.
+            try:
+                self.engine.step()
+            except Exception as e:  # surface engine faults to the waiters
+                self.logger.exception("engine step failed")
+                with self._work:
+                    # Fail fast: abort every request the engine holds
+                    # (slots + waiting; KV is NOT prefix-cache-registered
+                    # — it may never have been written) and error EVERY
+                    # registered consumer, including requests that
+                    # finished during the failing step and any submit()
+                    # that raced into the fault window (engine state is
+                    # suspect; one clean 500, client may retry). The
+                    # engine ends empty: no hot-loop on a persistent
+                    # fault, no decoding into deleted queues.
+                    try:
+                        self.engine.abort_all(reason="error")
+                    except Exception:
+                        # Even the abort failed — bookkeeping is beyond
+                        # recovery; park the stepper and fail all future
+                        # submits instead of serving from a corrupt
+                        # engine while /health looks ok.
+                        self.logger.exception(
+                            "engine abort failed; stepper parked")
+                        self._dead = True
+                        self._stop = True
                     for q in self._queues.values():
                         q.put(("error", f"{type(e).__name__}: {e}"))
                     self._queues.clear()
                     self._seen.clear()
-                    continue
+                    if self._stop:
+                        return
+                continue
+            with self._work:
                 self._drain_events()
 
     def _drain_events(self) -> None:
@@ -267,6 +303,8 @@ class _Handler(BaseHTTPRequestHandler):
             req, q = self.async_engine.submit(prompt_ids, params, rid)
         except ValueError as e:
             return self._error(400, str(e))
+        except RuntimeError as e:  # engine parked after unrecoverable fault
+            return self._error(503, str(e))
 
         if body.get("stream"):
             self._stream_response(req, q, chat, created)

@@ -210,3 +210,49 @@ def test_native_allocator_contract(tmp_path):
     assert bm.num_free == 4
     bm.free(a)
     assert bm.num_free == 7
+
+
+def test_stepper_fault_aborts_cleanly():
+    """A faulted engine.step() errors exactly the in-flight consumers and
+    leaves the engine EMPTY (slots + waiting freed): no hot-loop on a
+    persistent fault, no decoding into deleted queues after a transient
+    one."""
+    from dlti_tpu.serving.server import AsyncEngine
+
+    model = LlamaForCausalLM(CFG, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
+                      max_model_len=32, cache_dtype="float32",
+                      eos_token_id=-1)
+    eng = InferenceEngine(CFG, params, ec)
+    boom = {"n": 0}
+    real_step = eng.step
+
+    def flaky_step():
+        boom["n"] += 1
+        raise RuntimeError("injected device fault")
+
+    eng.step = flaky_step
+    aeng = AsyncEngine(eng)
+    try:
+        _, q = aeng.submit([3, 1, 4, 1, 5], SamplingParams(max_tokens=4))
+        kind, payload = q.get(timeout=30)[:2]
+        assert kind == "error" and "injected device fault" in payload
+        # Engine drained: nothing left to step, stepper idles (no
+        # unbounded retry of the failing program).
+        assert not eng.has_work
+        assert all(s.free for s in eng.slots) and not eng.waiting
+        n_after_error = boom["n"]
+        import time as _t
+        _t.sleep(0.5)
+        assert boom["n"] == n_after_error  # stepper is parked, not looping
+        # Recovery: the engine works again for new requests.
+        eng.step = real_step
+        _, q2 = aeng.submit([2, 7, 1], SamplingParams(temperature=0.0,
+                                                      max_tokens=3))
+        events = [q2.get(timeout=60) for _ in range(4)]
+        assert events[-1][0] == "done"
+        assert sum(1 for e in events if e[0] == "token") == 3
+    finally:
+        aeng.shutdown()
