@@ -130,7 +130,9 @@ def paged_gather(layer_cache: dict, block_tables: jnp.ndarray):
 
     Returns (k, v) of shape (batch, max_blocks*block_size, kv_heads, head_dim)
     in logical order; garbage beyond a sequence's written length is masked by
-    the caller's causal/position mask.
+    the caller's causal/position mask. int8 pools dequantize to the fp32
+    product (scales are fp32) — callers cast to their compute dtype, so
+    fp32 paths don't pay an extra bf16 rounding step on the way through.
     """
     k_pool, v_pool = layer_cache["k"], layer_cache["v"]
     nb, bs, kvh, hd = k_pool.shape
@@ -142,6 +144,6 @@ def paged_gather(layer_cache: dict, block_tables: jnp.ndarray):
         # bf16 pool; the expansion happens on the small window).
         ks = layer_cache["k_scale"][block_tables].reshape(b, max_blk * bs, kvh, 1)
         vs = layer_cache["v_scale"][block_tables].reshape(b, max_blk * bs, kvh, 1)
-        k = (k.astype(jnp.float32) * ks).astype(jnp.bfloat16)
-        v = (v.astype(jnp.float32) * vs).astype(jnp.bfloat16)
+        k = k.astype(jnp.float32) * ks
+        v = v.astype(jnp.float32) * vs
     return k, v

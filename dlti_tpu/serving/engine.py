@@ -346,7 +346,10 @@ class InferenceEngine:
         # Aggregate stats for the /stats endpoint and load reports.
         self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
                       "preemptions": 0, "decode_steps": 0,
-                      # active-slot x step units actually dispatched;
+                      # slot x step units CONSUMED (a slot that hits
+                      # EOS/limit mid-window stops counting, even though
+                      # the device still runs its dead steps — that waste
+                      # deliberately shows up as occupancy < 100%);
                       # decode_slot_steps / (max_seqs * decode_steps) is
                       # the mean slot occupancy — the first thing to look
                       # at when throughput undershoots (synchronized
@@ -582,6 +585,20 @@ class InferenceEngine:
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None) -> Request:
+        """Enqueue a request. Returns immediately; tokens arrive via step().
+
+        THREAD-SAFETY CONTRACT (load-bearing): AsyncEngine runs step() on
+        its stepper thread *without* holding a lock while HTTP handlers
+        call submit() concurrently. That is only sound because submit()
+        does nothing beyond (a) one GIL-atomic ``self.waiting.append`` and
+        (b) touching its own ``stats["requests"]`` key — no slot, cache,
+        block-allocator, or prefix-cache state. Admission consumes
+        ``waiting`` at a single point inside step(), so a racing submit
+        lands this step or the next. If you add ANY engine-state work here
+        (prefix-cache probing, block preallocation, ...), it must move
+        into step()-side admission or AsyncEngine must buffer submissions
+        on its own lock and hand them over from the stepper thread.
+        """
         if not prompt_token_ids:
             raise ValueError("prompt must contain at least one token")
         if len(prompt_token_ids) >= self.cfg.max_model_len:
@@ -963,11 +980,15 @@ class InferenceEngine:
         tokens = np.asarray(jax.device_get(tokens))      # (S, k_steps)
         logprobs = np.asarray(jax.device_get(logprobs))
         self.stats["decode_steps"] += k_steps
-        self.stats["decode_slot_steps"] += len(active) * k_steps
 
         finished = []
         for s in active:
             for k in range(k_steps):
+                # Per-step occupancy: a slot that hits EOS mid-window
+                # stops counting here, so occupancy stays honest at large
+                # steps_per_sync (the device still runs the dead steps —
+                # that waste shows up as occupancy < 100%, as it should).
+                self.stats["decode_slot_steps"] += 1
                 s.seq_len += 1  # the input token is now in the cache
                 done = self._append_token(s, int(tokens[s.slot_id, k]),
                                           float(logprobs[s.slot_id, k]))
@@ -1045,7 +1066,6 @@ class InferenceEngine:
         prop = np.asarray(jax.device_get(prop))
         acc = np.asarray(jax.device_get(acc))
         self.stats["decode_steps"] += R
-        self.stats["decode_slot_steps"] += len(active) * R
 
         finished = []
         gate_rounds = 0
@@ -1055,6 +1075,9 @@ class InferenceEngine:
             greedy = s.request.params.temperature == 0.0
             done = False
             for r in range(R):
+                # Per-round occupancy (see _decode_complete): rounds after
+                # a slot finishes mid-window don't count as occupied.
+                self.stats["decode_slot_steps"] += 1
                 if greedy:
                     gate_rounds += 1
                     gate_extra += int(emit[sid, r]) - 1

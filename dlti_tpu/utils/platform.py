@@ -30,25 +30,29 @@ def honor_platform_env() -> None:
         pass  # backend already up; the env var had its chance
 
 
-def enable_compilation_cache() -> None:
+def enable_compilation_cache(subdir: str = "xla",
+                             min_compile_secs: float = 5.0) -> None:
     """Point XLA's persistent compilation cache at a stable location.
 
     A 7B train-step compile costs minutes on the remote relay but replays
     from this cache in milliseconds across processes (measured), so every
     entry point enables it. Explicit ``JAX_COMPILATION_CACHE_DIR`` (or
-    ``DLTI_NO_COMPILE_CACHE=1``) wins.
+    ``DLTI_NO_COMPILE_CACHE=1``) wins. The test suite uses its own
+    ``subdir`` and a lower ``min_compile_secs`` (hundreds of sub-5s
+    compiles dominate there; see tests/conftest.py).
     """
     if os.environ.get("DLTI_NO_COMPILE_CACHE", "").lower() in (
             "1", "true", "yes"):
         return
     cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "dlti_tpu", "xla"))
+        os.path.join(os.path.expanduser("~"), ".cache", "dlti_tpu", subdir))
     import jax
 
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
     except Exception:
         pass  # older jax without these knobs
 

@@ -27,6 +27,14 @@ jax.config.update("jax_platforms", "cpu")
 # (≈bf16, ~7e-3 error); correctness tests need true fp32 matmuls.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# The suite is XLA-compile-bound on a 1-CPU runner; the persistent cache
+# replays every test's compiles after the first run. Threshold lowered
+# from the entry points' 5 s: test-sized programs compile in 0.5–5 s each
+# but there are hundreds of them.
+from dlti_tpu.utils.platform import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(subdir="xla-tests", min_compile_secs=0.5)
+
 
 @pytest.fixture(scope="session")
 def devices():

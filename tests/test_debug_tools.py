@@ -86,6 +86,7 @@ def test_step_recorder_roundtrip_and_rotation(tmp_path):
                                   jax.random.key_data(rng))
 
 
+@pytest.mark.slow
 def test_replay_reproduces_recorded_step(tmp_path, rng):
     """Record a live step, then re-execute it: bitwise-equal metrics."""
     cfg = MODEL_PRESETS["llama_tiny"]

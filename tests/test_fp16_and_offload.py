@@ -141,6 +141,7 @@ def test_param_offload_places_frozen_on_host(rng):
     assert lora_a.sharding.memory_kind in (None, "device")
 
 
+@pytest.mark.slow
 def test_param_offload_step_matches_unoffloaded(rng):
     """One ZeRO-3 step with host-offloaded base params == same step with
     everything in device memory."""
@@ -179,6 +180,7 @@ def test_param_offload_requires_lora():
         shard_train_state(state, cfg, mesh)
 
 
+@pytest.mark.slow
 def test_fp16_scaler_survives_checkpoint_resume(tmp_path, rng):
     """The dynamic scaler state checkpoints and restores with the rest of
     the train state."""

@@ -181,6 +181,7 @@ def test_num_params_analytic_matches_actual(rng):
     assert CFG.num_params() == actual
 
 
+@pytest.mark.slow
 def test_remat_stride_preserves_training_math(rng):
     """Selective remat (every k-th block keeps activations) is a pure
     memory/FLOPs tradeoff — two steps must produce identical losses for

@@ -172,6 +172,7 @@ def test_int8_pool_kernel_matches_dequant_reference():
                                atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_int8_kv_engine_close_to_bf16(tmp_path):
     """End-to-end: an int8-KV engine's greedy outputs track the bf16-KV
     engine on a tiny model (same contract as the int8-weights test)."""

@@ -132,6 +132,7 @@ def test_merged_export_is_dequantized_and_close(rng):
     np.testing.assert_allclose(a, b, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_sharded_int8_matches_single_device(rng):
     from dlti_tpu.parallel import build_mesh, make_sharded_train_step, shard_train_state
 

@@ -59,16 +59,29 @@ def _run_reference(rng, batch, steps=3):
     return state, metrics
 
 
+# Fast tier keeps one pure-ZeRO (zero3, the flagship FSDP path) and one
+# TP composition (dp_tp); the other variants run in the full suite
+# (`pytest tests/` without the default `-m "not slow"`).
 STRATEGIES = [
-    ("zero1_8dev", ParallelConfig(zero_stage=ZeROStage.ZERO1, data=8)),
-    ("zero2_8dev", ParallelConfig(zero_stage=ZeROStage.ZERO2, data=8)),
-    ("zero3_8dev", ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=8)),
-    ("zero3_tp", ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4, tensor=2)),
-    ("dp_tp", ParallelConfig(zero_stage=ZeROStage.NONE, data=4, tensor=2)),
+    pytest.param("zero1_8dev",
+                 ParallelConfig(zero_stage=ZeROStage.ZERO1, data=8),
+                 marks=pytest.mark.slow, id="zero1_8dev"),
+    pytest.param("zero2_8dev",
+                 ParallelConfig(zero_stage=ZeROStage.ZERO2, data=8),
+                 marks=pytest.mark.slow, id="zero2_8dev"),
+    pytest.param("zero3_8dev",
+                 ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=8),
+                 id="zero3_8dev"),
+    pytest.param("zero3_tp",
+                 ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4, tensor=2),
+                 marks=pytest.mark.slow, id="zero3_tp"),
+    pytest.param("dp_tp",
+                 ParallelConfig(zero_stage=ZeROStage.NONE, data=4, tensor=2),
+                 id="dp_tp"),
 ]
 
 
-@pytest.mark.parametrize("name,parallel", STRATEGIES, ids=[s[0] for s in STRATEGIES])
+@pytest.mark.parametrize("name,parallel", STRATEGIES)
 def test_sharded_step_matches_single_device(rng, name, parallel):
     batch = _batch(jax.random.PRNGKey(7))
     ref_state, ref_metrics = _run_reference(rng, batch)

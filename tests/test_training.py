@@ -250,6 +250,7 @@ def test_chunked_ce_matches_unchunked(rng):
                                    atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_unchunked_tied_int8(rng):
     """head_matrix must track __call__'s head exactly for the other two
     head variants: tied embeddings (fp32 projection) and an int8-quantized
@@ -322,6 +323,7 @@ def test_steps_per_sync_matches_per_step(tmp_path, rng):
                                    atol=1e-6, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_steps_per_sync_max_steps_cap(tmp_path, rng):
     """A window never overshoots max_steps: the last window shrinks to the
     remaining step budget (and runs per-step, shape-stable)."""
@@ -349,6 +351,7 @@ def test_steps_per_sync_max_steps_cap(tmp_path, rng):
     assert int(state.step) == 5
 
 
+@pytest.mark.slow
 def test_steps_per_sync_sharded_zero3(tmp_path, rng):
     """steps_per_sync composes with the sharded (ZeRO-3 FSDP) step: the
     scanned window traces the jitted sharded step inline, keeping its
@@ -397,6 +400,7 @@ def test_steps_per_sync_sharded_zero3(tmp_path, rng):
                                    atol=1e-6, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_steps_per_sync_ragged_tail_batch(tmp_path, rng):
     """A custom batches_per_epoch iterable whose final batch has a
     different shape (drop_last=False pattern) must not crash the window
@@ -473,6 +477,7 @@ def test_steps_per_sync_preemption_drops_pending_window(tmp_path, rng):
     assert int(state.step) == 4
 
 
+@pytest.mark.slow
 def test_steps_per_sync_full_finetune(tmp_path, rng):
     """Full fine-tune (bf16 params, no LoRA) under steps_per_sync: Adam
     moments must be fp32 from init, or the first update's fp32 grads
