@@ -76,15 +76,16 @@ def _error_json(msg: str):
             "unit": "tok/s/chip", "vs_baseline": 0.0, "error": msg}
 
 
-def _kill_stale_chip_holders(min_age_s: float = 600.0) -> list:
+def _kill_stale_chip_holders(min_age_s: float = 3600.0) -> list:
     """SIGKILL leftover python processes from a previous builder session
     (serving servers, benchmarks, trainers) that may still hold the TPU.
 
     Only targets processes whose cmdline references this repo's entry
-    points AND that are older than ``min_age_s`` — a stale holder is by
-    definition old, while a sibling the driver legitimately started
-    alongside this bench would be young. Never touches self, ancestors,
-    or non-python processes. Disable entirely with BENCH_NO_KILL=1.
+    points AND that are older than ``min_age_s`` (default 1 h — longer
+    than any healthy workload here, including 15-min serving benchmarks,
+    while a builder-session leftover is hours old by driver time). Never
+    touches self, ancestors, or non-python processes. Disable entirely
+    with BENCH_NO_KILL=1.
     """
     if os.environ.get("BENCH_NO_KILL") == "1":
         return []
@@ -171,25 +172,40 @@ def _probe_backend() -> None:
 
 
 def _watchdog() -> None:
-    """Hard deadline: whatever happens (hung compile, relay stall), print a
-    JSON line and exit before the driver's timeout turns it into rc=124."""
+    """Hard deadline: whatever happens (hung probe, hung compile, relay
+    stall), print a JSON line and exit before the driver's timeout turns it
+    into rc=124. Runs from BEFORE the backend probe so even a probe stuck
+    in an uninterruptible wait is covered."""
     remaining = DEADLINE_S - (time.monotonic() - _START)
     if remaining > 0:
         time.sleep(remaining)
     if _BEST.get("printed"):
         return  # main already emitted; let its own exit path finish
-    if _BEST.get("json"):
-        _emit(_BEST["json"])
-        os._exit(0)
-    _emit(_error_json(
-        f"deadline {DEADLINE_S}s hit with no completed candidate; "
-        f"last: {_BEST.get('last_candidate')}"))
-    os._exit(4)
+    # Bounded lock acquire: if main is itself wedged inside print() while
+    # holding the lock (blocked stdout), exit anyway — holding the process
+    # open can only end in the driver's rc=124.
+    got = _EMIT_LOCK.acquire(timeout=15)
+    code = 4
+    try:
+        if not _BEST.get("printed"):
+            obj = _BEST.get("json") or _error_json(
+                f"deadline {DEADLINE_S}s hit with no completed candidate; "
+                f"last: {_BEST.get('last_candidate')}")
+            _BEST["printed"] = True
+            print(json.dumps(obj), flush=True)
+            code = 0 if "error" not in obj else 4
+        else:
+            code = 0
+    finally:
+        if got:
+            _EMIT_LOCK.release()
+        os._exit(code)
 
 
+# Watchdog first (it must cover a hung probe), then the bounded probe.
+threading.Thread(target=_watchdog, daemon=True).start()
 if os.environ.get("BENCH_SKIP_PROBE") != "1":
     _probe_backend()
-threading.Thread(target=_watchdog, daemon=True).start()
 
 try:
     import jax  # noqa: E402  (post-probe: backend known reachable)
@@ -339,6 +355,7 @@ def main() -> None:
 
     result = None
     failures = []
+    out_of_time = False
     # Leave enough slack for one more candidate's compile+run before the
     # watchdog deadline; otherwise stop and report what we have.
     MIN_SLACK_S = int(os.environ.get("BENCH_MIN_SLACK_S", 300))
@@ -347,6 +364,7 @@ def main() -> None:
         if remaining < MIN_SLACK_S:
             print(f"# bench: {remaining:.0f}s left < {MIN_SLACK_S}s slack; "
                   f"stopping candidate loop", file=sys.stderr, flush=True)
+            out_of_time = True
             break
         _BEST["last_candidate"] = c
         try:
@@ -357,6 +375,15 @@ def main() -> None:
                 loss_chunk=c.get("loss_chunk", 0),
                 sync=c.get("sync", 1))
             result = (c, tok_s, dt, trainable, total, loss)
+            # Minimal best-so-far for the watchdog: if anything after the
+            # loop stalls (e.g. a device query in MFU derivation), the
+            # deadline still emits a real measurement, not an error.
+            _BEST["json"] = {
+                "metric": "lora_sft_tokens_per_sec_per_chip_llama2_7b_seq512",
+                "value": round(tok_s, 1), "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s / V100_BASELINE_TOK_S, 3),
+                "model": c["model"], "micro_batch_size": c["bs"],
+                "partial": "post-measurement finalization stalled"}
             break
         except Exception as e:  # OOM or compile failure: try the next config
             msg = f"{type(e).__name__}: {str(e)[:200]}"
@@ -364,8 +391,10 @@ def main() -> None:
             print(f"# bench: {c} failed: {msg}", file=sys.stderr, flush=True)
             continue
     if result is None:
-        _emit(_error_json(f"no config fit ({len(failures)} candidates "
-                          f"failed; first: {failures[0] if failures else None}"))
+        why = ("deadline slack exhausted before any candidate completed"
+               if out_of_time else "no config fit")
+        _emit(_error_json(f"{why} ({len(failures)} candidates failed; "
+                          f"first: {failures[0] if failures else None})"))
         sys.exit(5)
 
     c, tok_s, dt, trainable, total, loss = result
