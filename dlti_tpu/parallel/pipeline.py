@@ -76,14 +76,32 @@ def from_pipeline_params(pparams: dict, num_layers: int) -> dict:
 
 
 def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
-    """Stacked layers sharded over ``pipe`` on the layer dim; rest replicated."""
-    def leaf_layers(v):
+    """Stacked layers sharded over ``pipe`` on the layer dim; rest replicated.
+
+    When the mesh also has ``tensor`` > 1 (PP x TP), each stacked leaf
+    additionally shards over ``tensor`` on the same dim the training TP
+    rules use (shifted +1 for the leading layer dim): stage-internal
+    tensor parallelism. The ``tensor`` axis stays a GSPMD *auto* axis
+    inside the pipeline's shard_map (see :func:`pipeline_forward`), so XLA
+    partitions the block math and inserts the TP collectives.
+    """
+    tp = mesh.shape.get("tensor", 1)
+
+    def leaf_layers(path, v):
         spec = [None] * v.ndim
         spec[0] = "pipe"
+        if tp > 1:
+            from dlti_tpu.parallel.sharding import _path_str, _tp_dim
+
+            d = _tp_dim(_path_str(path))
+            # d is the TP dim in the unstacked layout; +1 for the layer dim.
+            if d is not None and v.shape[d + 1] % tp == 0:
+                spec[d + 1] = "tensor"
         return NamedSharding(mesh, P(*spec))
 
     return {
-        k: (jax.tree_util.tree_map(leaf_layers, v) if k == "layers"
+        k: (jax.tree_util.tree_map_with_path(leaf_layers, v)
+            if k == "layers"
             else jax.tree_util.tree_map(
                 lambda x: NamedSharding(mesh, P()), v))
         for k, v in pparams.items()
@@ -165,6 +183,12 @@ def pipeline_forward(
 
     @functools.partial(
         shard_map, mesh=mesh,
+        # Only 'pipe' is manual: every other mesh axis (notably 'tensor')
+        # stays a GSPMD auto axis, so stacked-layer leaves that carry a
+        # 'tensor' sharding (pipeline_param_shardings under PP x TP) keep
+        # it inside the body and XLA partitions the stage's block math +
+        # inserts the row/column-parallel collectives.
+        axis_names=frozenset({"pipe"}),
         in_specs=(jax.tree_util.tree_map(lambda _: P("pipe"), pparams["layers"]),
                   P(), P(), P()),
         out_specs=P(),

@@ -64,7 +64,10 @@ def _validate_pipeline_config(cfg: Config) -> None:
     if int(par.zero_stage) != 0:
         illegal.append(f"zero_stage={int(par.zero_stage)} (stages hold "
                        "their full layer shard; ZeRO axes do not compose)")
-    for axis in ("data", "fsdp", "tensor", "sequence", "expert"):
+    # 'tensor' composes: stage-internal TP over a ('pipe','tensor') mesh
+    # (pipeline_param_shardings shards each stacked leaf over both axes;
+    # 'tensor' rides GSPMD inside the pipeline's shard_map).
+    for axis in ("data", "fsdp", "sequence", "expert"):
         if getattr(par, axis) > 1:
             illegal.append(f"{axis}={getattr(par, axis)}")
     if par.offload_optimizer or par.offload_params:
@@ -95,8 +98,9 @@ def _validate_pipeline_config(cfg: Config) -> None:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: single-host pure pipe over the 'pipe' axis with bf16 "
-            "LoRA or full fine-tune, dense models, default remat")
+            "Legal: single-host pipe (optionally x tensor for stage-"
+            "internal TP) with bf16 LoRA or full fine-tune, dense models, "
+            "default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 
