@@ -1,0 +1,178 @@
+"""End-to-end from_pretrained demonstration on a REAL artifact (VERDICT r03 #3).
+
+Round-trips the trained 300M glaive export through the HF checkpoint
+layer, then fine-tunes from it, proving the
+``AutoModelForCausalLM.from_pretrained`` semantics of the reference
+(``training/train_baseline.py:122-126``) on a real checkpoint instead of
+synthetic tensors:
+
+  1. load the consolidated Orbax export (``exports/glaive_300m``)
+  2. ``save_hf_checkpoint`` with a small shard budget -> sharded
+     ``model-XXXXX-of-XXXXX.safetensors`` + index (the multi-file layout
+     real 7B checkpoints use)
+  3. ``load_hf_checkpoint`` back (exercises the index path) and verify
+     numerical identity
+  4. fine-tune from the loaded base on held-out glaive pairs through the
+     production ``Trainer(base_params=...)`` path -> loss starts at the
+     trained-corpus level (~0.2, vs ~11 from random init) and drops
+  5. a short random-init contrast run makes the gap explicit
+
+Writes ``results/hf_interop_pretrained_300m.json``.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+os.chdir(_repo)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def tree_close(a, b, atol=0.0):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(b)}
+    assert len(la) == len(lb), (len(la), len(lb))
+    worst = 0.0
+    for p, v in la:
+        w = lb[jax.tree_util.keystr(p)]
+        d = float(np.max(np.abs(np.asarray(v, np.float32)
+                                - np.asarray(w, np.float32))))
+        worst = max(worst, d)
+        assert d <= atol, (jax.tree_util.keystr(p), d)
+    return worst
+
+
+def main():
+    from dlti_tpu.checkpoint.export import load_exported_model
+    from dlti_tpu.models.hf_interop import (
+        load_hf_checkpoint, save_hf_checkpoint,
+    )
+
+    t0 = time.time()
+    params, full_cfg = load_exported_model("exports/glaive_300m")
+    mc = full_cfg.model
+    print(f"export loaded in {time.time()-t0:.0f}s", flush=True)
+
+    hf_dir = os.path.join(tempfile.mkdtemp(prefix="hf300m_"), "ckpt")
+    save_hf_checkpoint(hf_dir, params, mc, max_shard_bytes=120 * 1024**2)
+    files = sorted(os.listdir(hf_dir))
+    print("HF checkpoint files:", files, flush=True)
+    assert "model.safetensors.index.json" in files, "sharded path not taken"
+    n_shards = len([f for f in files if f.endswith(".safetensors")])
+
+    # fp32 load (CPU fine-tune; bf16 emulation is slow on CPU). bf16->fp32
+    # is exact, so identity still checks bitwise.
+    params2, mc2 = load_hf_checkpoint(hf_dir, dtype="float32",
+                                      param_dtype="float32")
+    worst = tree_close(params, params2, atol=0.0)
+    print(f"round-trip identity ok (max abs diff {worst})", flush=True)
+
+    # ------------------------------------------------------------------
+    # Fine-tune from the loaded base on held-out glaive pairs.
+    # ------------------------------------------------------------------
+    from dlti_tpu.config import (
+        CheckpointConfig, Config, DataConfig, LoRAConfig, OptimizerConfig,
+        ParallelConfig, TrainConfig,
+    )
+    from dlti_tpu.data import ByteTokenizer, make_batches
+    from dlti_tpu.training.trainer import Trainer
+    from datasets import load_from_disk
+
+    texts = list(load_from_disk("data/glaive_eval")["text"])
+    print(f"{len(texts)} held-out texts", flush=True)
+
+    mc_ft = dataclasses.replace(mc2, remat=False, max_seq_len=512)
+    tmp = tempfile.mkdtemp(prefix="hf300m_ft_")
+
+    import logging
+    import re
+
+    class _Capture(logging.Handler):
+        """Per-step losses only reach the logger ('step N | loss X | ...');
+        the metrics CSV is a per-run record."""
+
+        def __init__(self):
+            super().__init__()
+            self.losses = []
+
+        def emit(self, record):
+            m = re.match(r"step (\d+) \| loss ([0-9.]+)", record.getMessage())
+            if m:
+                self.losses.append(round(float(m.group(2)), 4))
+
+    def run(tag, base_params, max_steps):
+        cfg = Config(
+            model=mc_ft,
+            lora=LoRAConfig(enabled=True, r=8, alpha=16, dropout=0.0),
+            optimizer=OptimizerConfig(learning_rate=1e-4, warmup_steps=2),
+            parallel=ParallelConfig(),
+            data=DataConfig(max_seq_len=512, tokenizer="byte"),
+            checkpoint=CheckpointConfig(output_dir=os.path.join(tmp, tag),
+                                        save_strategy="no"),
+            train=TrainConfig(micro_batch_size=2, grad_accum_steps=1,
+                              max_steps=max_steps, logging_steps=1,
+                              num_epochs=1,
+                              metrics_csv=os.path.join(tmp, f"{tag}.csv")),
+            experiment_name=tag,
+        )
+        ds = make_batches(texts, ByteTokenizer(), seq_len=512,
+                          micro_batch_size=2, grad_accum_steps=1,
+                          shard_by_host=False)
+        tr = Trainer(cfg, base_params=base_params)
+        cap = _Capture()
+        tr.logger.addHandler(cap)
+        t = time.time()
+        try:
+            state, record = tr.train(dataset=ds)
+        finally:
+            tr.logger.removeHandler(cap)
+        dt = time.time() - t
+        losses = cap.losses
+        print(f"{tag}: {len(losses)} steps in {dt:.0f}s losses={losses} "
+              f"final={record.final_loss:.4f}", flush=True)
+        return losses, round(float(record.final_loss), 4)
+
+    ft_losses, ft_final = run("from_pretrained", params2, max_steps=14)
+    ri_losses, ri_final = run("random_init", None, max_steps=3)
+
+    art = {
+        "what": "from_pretrained semantics on a real artifact: trained 300M "
+                "glaive export -> save_hf_checkpoint (sharded safetensors + "
+                "index) -> load_hf_checkpoint -> LoRA fine-tune on 400 "
+                "held-out glaive pairs via Trainer(base_params=...); "
+                "random-init contrast shows the pretrained base starts at "
+                "corpus loss, not cold.",
+        "export": "exports/glaive_300m (bf16, 24L/1024h, byte tokenizer)",
+        "hf_checkpoint_shards": n_shards,
+        "roundtrip_max_abs_diff": worst,
+        "finetune_losses_from_pretrained": ft_losses,
+        "finetune_final_loss_from_pretrained": ft_final,
+        "finetune_losses_random_init_contrast": ri_losses,
+        "finetune_final_loss_random_init_contrast": ri_final,
+        "reference_parity": "train_baseline.py:122-126 "
+                            "(AutoModelForCausalLM.from_pretrained)",
+        "platform": "cpu (single process; chip was down this session)",
+        "date": "2026-08-01",
+    }
+    with open("results/hf_interop_pretrained_300m.json", "w") as f:
+        json.dump(art, f, indent=1)
+    print("ARTIFACT_WRITTEN", flush=True)
+    assert ft_losses[0] < 2.0, f"pretrained start too high: {ft_losses[0]}"
+    assert ri_losses[0] > 5.0, f"random-init start too low: {ri_losses[0]}"
+    assert ft_final < ft_losses[0], "no improvement while fine-tuning"
+    print("E2E_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
