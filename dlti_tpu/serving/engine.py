@@ -309,9 +309,10 @@ class InferenceEngine:
 
         self._prefill_fns: Dict[int, callable] = {}
         self._decode_fn = self._build_decode_fn()
-        self._multi_decode_fn = (
-            self._build_multi_decode_fn(ec.steps_per_sync)
-            if ec.steps_per_sync > 1 else None)
+        # Multi-step decode programs, one per window length on the halving
+        # ladder (K, K//2, ..., 1; see _window_steps) — compiled lazily on
+        # first use. Bounded at ~log2(K)+1 variants.
+        self._multi_decode_fns: Dict[int, callable] = {}
         # Speculative program: rounds = steps_per_sync (>=1), so spec and
         # multi-step are one composed program, not alternatives.
         self._spec_rounds = max(1, ec.steps_per_sync)
@@ -439,6 +440,71 @@ class InferenceEngine:
             return new_kv, tokens, logprobs
 
         return decode
+
+    def _window_steps(self, active: list) -> int:
+        """Budget-clamped multi-step window (the r03 occupancy lever).
+
+        A slot that exhausts its token budget at step j of a K-step window
+        idles for K-j device steps, and uniform workloads retire whole
+        cohorts inside one window — the measured 77.7% decode occupancy at
+        the r03 headline (results/serving_7b_report.json). So never run a
+        window longer than the smallest PREDICTABLE retirement among
+        active slots (max_tokens budget or model-length room; natural EOS
+        is unpredictable and still wastes its tail). Window lengths come
+        from the halving ladder K, K//2, ..., 1 so the compile surface
+        stays ~log2(K)+1 programs instead of one per distinct remainder.
+        Side effect: near max_model_len the old batch-wide fallback to
+        k=1 becomes a right-sized window instead.
+        """
+        ec = self.cfg
+        # Length retirement fires at prompt+output >= max_model_len
+        # (_append_token), which is one step EARLIER than KV room
+        # (output leads seq_len by one at dispatch): remaining decode
+        # steps until a length stop = max_model_len - (prompt + output).
+        min_rem = min(
+            min(s.request.params.max_tokens - len(s.request.output_token_ids),
+                ec.max_model_len - len(s.request.prompt_token_ids)
+                - len(s.request.output_token_ids))
+            for s in active)
+        k = ec.steps_per_sync
+        while k > 1 and k > min_rem:
+            k //= 2
+        return max(1, k)
+
+    def warmup_decode_ladder(self) -> None:
+        """Pre-compile the decode programs (single-step + every multi-step
+        halving-ladder length) BEFORE traffic: a window length's first use
+        otherwise stalls the live decode loop on an XLA compile at an
+        unpredictable moment. AOT-lowers on abstract shapes (donation only
+        consumes avals here — no scratch KV pool is materialized); with
+        the persistent compilation cache the built binaries replay for the
+        jit dispatch path even across processes."""
+        def avals(tree):
+            return jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), tree)
+
+        S = self.cfg.max_seqs
+        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+        args = (avals(self.params), avals(self.cache),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                jax.ShapeDtypeStruct(self._block_tables.shape, i32),
+                jax.ShapeDtypeStruct((S, 2), u32),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S,), f32),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S,), f32))
+        fns = [self._decode_fn]
+        k = self.cfg.steps_per_sync
+        while k > 1:
+            fn = self._multi_decode_fns.get(k)
+            if fn is None:
+                fn = self._multi_decode_fns[k] = \
+                    self._build_multi_decode_fn(k)
+            fns.append(fn)
+            k //= 2
+        for fn in fns:
+            fn.lower(*args).compile()
 
     def _build_multi_decode_fn(self, num_steps: int):
         """K decode iterations in one program: the sampled token feeds the
@@ -885,9 +951,9 @@ class InferenceEngine:
         All host mirrors are snapshotted here (jnp.asarray copies at call
         time), so admission may mutate them while the call is in flight."""
         ec = self.cfg
-        # Multi-step decode only when every active slot has room for the
-        # whole window (writing past max_model_len would clip block-table
-        # lookups back into a slot's own live blocks). Prefilling slots are
+        # Multi-step windows are budget-clamped per round (_window_steps):
+        # max_model_len safety lives in its min(...) term, so there is no
+        # batch-wide all-or-nothing room gate anymore. Prefilling slots are
         # admitted but not yet decodable: excluded everywhere below, with
         # their block-table rows masked to the trash block.
         k_steps = 1
@@ -910,10 +976,8 @@ class InferenceEngine:
             and self._spec_gate_open())
         if use_spec:
             k_steps = spec_window  # block-growth window
-        elif self._multi_decode_fn is not None and active0 and all(
-                s.seq_len + ec.steps_per_sync <= ec.max_model_len
-                for s in active0):
-            k_steps = ec.steps_per_sync
+        elif ec.steps_per_sync > 1 and active0:
+            k_steps = self._window_steps(active0)
 
         # Grow block tables to cover the decode window; preempt the
         # youngest if the pool is exhausted. (Prefilling slots already own
@@ -965,7 +1029,11 @@ class InferenceEngine:
             jnp.asarray(self._top_p),
         )
         if k_steps > 1:
-            self.cache, tokens, logprobs = self._multi_decode_fn(*args)
+            fn = self._multi_decode_fns.get(k_steps)
+            if fn is None:
+                fn = self._multi_decode_fns[k_steps] = \
+                    self._build_multi_decode_fn(k_steps)
+            self.cache, tokens, logprobs = fn(*args)
         else:
             self.cache, tokens, logprobs = self._decode_fn(*args)
             tokens = tokens[:, None]

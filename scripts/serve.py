@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 # Source checkout wins over any installed copy; an installed dlti-tpu
 # serves scripts run from outside a checkout.
@@ -151,6 +152,10 @@ def main() -> None:
     del params
     sc = ServerConfig(host=args.host, port=args.port,
                       default_params=SamplingParams(max_tokens=args.max_tokens_default))
+    print("pre-compiling decode programs (single-step + multi-step ladder)...")
+    t0 = time.time()
+    engine.warmup_decode_ladder()
+    print(f"decode programs ready in {time.time() - t0:.0f}s")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
     serve(engine, tok, sc)

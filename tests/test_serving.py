@@ -741,3 +741,30 @@ def test_decode_slot_occupancy_stat(tiny_model_and_params):
     assert st["decode_slot_steps"] <= ec.max_seqs * st["decode_steps"]
     assert st["generated_tokens"] <= st["decode_slot_steps"] + len(
         eng.finished)  # +1 prefill-sampled token per request
+
+
+def test_budget_clamped_window_full_occupancy(tiny_model_and_params):
+    """The r03 occupancy lever: with uniform max_tokens, multi-step windows
+    clamp to the smallest remaining budget (halving ladder), so no slot
+    ever idles inside a window — 100% decode-slot occupancy — and the
+    emitted tokens are identical to the unclamped/single-step stream."""
+    model, params = tiny_model_and_params
+    prompts = [[3, 1, 4], [1, 5, 9, 2], [6, 5], [8, 9, 7]]
+
+    def run(sync):
+        ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=64,
+                          max_model_len=48, cache_dtype="float32",
+                          eos_token_id=-1, steps_per_sync=sync)
+        eng = InferenceEngine(CFG, params, ec)
+        res = eng.generate(prompts,
+                           SamplingParams(temperature=0.0, max_tokens=10))
+        return eng, [r.output_token_ids for r in res]
+
+    eng, toks = run(sync=8)
+    ref_eng, ref_toks = run(sync=1)
+    assert toks == ref_toks, "clamped windows changed the token stream"
+
+    st = eng.stats
+    # All 4 slots admitted together with budget 9 after the prefill token:
+    # windows 8 then 1 (ladder), zero dead slot-steps -> 100% occupancy.
+    assert st["decode_slot_steps"] == 4 * st["decode_steps"], st
