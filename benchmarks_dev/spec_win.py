@@ -1,0 +1,149 @@
+"""Make speculation win (VERDICT r03 #6): measure the fused n-gram
+speculative path on its FAVORABLE workload — repetitive/code-like text,
+greedy, engine-direct, long outputs — vs plain multi-step decode at the
+same steps_per_sync, and report tokens/s over >= 3 runs each.
+
+Usage:
+  python benchmarks_dev/spec_win.py                 # real chip, 300M export
+  python benchmarks_dev/spec_win.py --cpu           # CPU, llama_tiny (mechanism check)
+  python benchmarks_dev/spec_win.py --export exports/glaive_300m
+
+The favorable construction: prompts containing repeated boilerplate
+blocks (the shape of real config/code templating), greedy sampling, long
+outputs. A trained model continues the repetition, so the on-device
+n-gram prompt-lookup proposer gets long accepted prefixes; the adaptive
+gate never engages. Writes results/speculative_win.json (or _cpu variant).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+os.chdir(_repo)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--export", default="exports/glaive_300m")
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--max-tokens", type=int, default=160)
+    ap.add_argument("--sync", type=int, default=8)
+    ap.add_argument("--draft", type=int, default=6)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import dataclasses
+
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving.engine import (
+        EngineConfig, InferenceEngine, SamplingParams,
+    )
+
+    if args.cpu:
+        cfg = dataclasses.replace(MODEL_PRESETS["llama_tiny"],
+                                  dtype="float32", param_dtype="float32")
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        tok = None
+    else:
+        from dlti_tpu.checkpoint.export import load_exported_model
+        from dlti_tpu.data import ByteTokenizer
+
+        params, full_cfg = load_exported_model(args.export)
+        cfg = full_cfg.model
+        tok = ByteTokenizer()
+
+    # Repetitive, code-shaped prompts: boilerplate blocks the greedy
+    # continuation keeps extending (prompt-lookup heaven).
+    if tok is None:
+        # token-id world for the tiny model: a strict 8-token cycle
+        base = [11, 12, 13, 14, 15, 16, 17, 18]
+        prompts = [(base * 6)[:48] for _ in range(4)]
+    else:
+        block = ("def check_{i}(value):\n"
+                 "    if value is None:\n"
+                 "        return default\n"
+                 "    return transform(value)\n\n")
+        texts = ["".join(block.replace("{i}", str(i)) for i in range(4))
+                 for _ in range(4)]
+        prompts = [tok.encode(t)[:512] for t in texts]
+
+    def build(spec: bool):
+        ec = EngineConfig(
+            max_seqs=4, block_size=16,
+            num_blocks=max(256, (args.max_tokens + 600) // 16 * 8),
+            max_model_len=1024, eos_token_id=-1,
+            cache_dtype="float32" if args.cpu else "bfloat16",
+            steps_per_sync=args.sync,
+            speculative="ngram" if spec else "none",
+            num_draft_tokens=args.draft,
+        )
+        return InferenceEngine(cfg, params, ec)
+
+    def measure(spec: bool):
+        eng = build(spec)
+        sp = SamplingParams(temperature=0.0, max_tokens=args.max_tokens)
+        rates, toks = [], None
+        # warmup (compile): decode ladder + spec program + prefill buckets
+        eng.warmup_decode_ladder()
+        eng.generate([p[:16] for p in prompts], SamplingParams(
+            temperature=0.0, max_tokens=args.sync * (args.draft + 1) + 2))
+        eng.generate(prompts, SamplingParams(
+            temperature=0.0, max_tokens=args.sync * (args.draft + 1) + 2))
+        for _ in range(args.runs):
+            t0 = time.perf_counter()
+            res = eng.generate(prompts, sp)
+            dt = time.perf_counter() - t0
+            n = sum(len(r.output_token_ids) for r in res)
+            rates.append(n / dt)
+            toks = [r.output_token_ids for r in res]
+        st = dict(eng.stats)
+        return rates, toks, st
+
+    plain_rates, plain_toks, plain_st = measure(False)
+    spec_rates, spec_toks, spec_st = measure(True)
+    assert spec_toks == plain_toks, "speculation changed greedy outputs"
+
+    med_p = statistics.median(plain_rates)
+    med_s = statistics.median(spec_rates)
+    acc = (spec_st["spec_accepted"] / spec_st["spec_proposed"]
+           if spec_st.get("spec_proposed") else 0.0)
+    out = {
+        "what": "speculation on its favorable workload (repetitive "
+                "code-shaped prompts, greedy, engine-direct, long outputs) "
+                "vs plain multi-step at the same steps_per_sync",
+        "platform": "cpu/llama_tiny" if args.cpu else f"tpu/{args.export}",
+        "steps_per_sync": args.sync, "num_draft_tokens": args.draft,
+        "max_tokens": args.max_tokens, "runs": args.runs,
+        "plain_tok_s_all": [round(r, 1) for r in plain_rates],
+        "spec_tok_s_all": [round(r, 1) for r in spec_rates],
+        "plain_tok_s_median": round(med_p, 1),
+        "spec_tok_s_median": round(med_s, 1),
+        "speedup": round(med_s / med_p, 3),
+        "outputs_identical": True,
+        "draft_acceptance": round(acc, 3),
+        "decode_rounds_plain": plain_st["decode_steps"],
+        "decode_rounds_spec": spec_st["decode_steps"],
+        "date": "2026-08-01",
+    }
+    name = ("results/speculative_win_cpu.json" if args.cpu
+            else "results/speculative_win.json")
+    with open(name, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

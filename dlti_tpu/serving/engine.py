@@ -466,10 +466,23 @@ class InferenceEngine:
                 ec.max_model_len - len(s.request.prompt_token_ids)
                 - len(s.request.output_token_ids))
             for s in active)
+        # Round UP to the ladder: the smallest ladder length >= min_rem.
+        # Rounding down would fragment a 63-step tail into 32+16+8+4+2+1 —
+        # five extra host syncs (~0.5 s each on a relay link) to save a
+        # handful of dead device steps (~11 ms each). Round-up keeps one
+        # window with < k/2 dead steps, and still lands exact fits
+        # (min_rem a ladder value) at 100% occupancy.
         k = ec.steps_per_sync
-        while k > 1 and k > min_rem:
+        while k > 1 and k // 2 >= min_rem:
             k //= 2
-        return max(1, k)
+        # ...but NEVER past hard KV room: dead steps past a budget stop are
+        # merely discarded samples, while steps past max_model_len would
+        # grow a slot's block table beyond max_blocks_per_seq (an
+        # out-of-bounds block-table write). Round DOWN under the room cap.
+        min_room = min(ec.max_model_len - s.seq_len for s in active)
+        while k > 1 and k > min_room:
+            k //= 2
+        return k
 
     def warmup_decode_ladder(self) -> None:
         """Pre-compile the decode programs (single-step + every multi-step

@@ -41,6 +41,7 @@ def _sharded_state(rng, zero=ZeROStage.ZERO3):
     return cfg, mesh, state
 
 
+@pytest.mark.slow
 def test_sharding_assertion_passes_on_intended_layout(rng):
     cfg, mesh, state = _sharded_state(rng)
     expected = state_shardings(state, cfg, mesh)
@@ -108,6 +109,7 @@ def test_replay_reproduces_recorded_step(tmp_path, rng):
     assert replayed["loss"] == metrics["loss"]
 
 
+@pytest.mark.slow
 def test_replay_detects_divergence(tmp_path, rng):
     """A replay against the wrong state must fail loudly."""
     cfg = MODEL_PRESETS["llama_tiny"]

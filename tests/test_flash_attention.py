@@ -82,6 +82,7 @@ def test_flash_segments_match_reference(rng, h, hkv):
                                atol=2e-5, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_flash_segments_grads_match_reference(rng):
     q, k, v = _qkv(rng, b=1, s=128, h=4, hkv=2, d=64)
     segs = make_packed_segments(1, 128, n_docs=2)
@@ -173,6 +174,7 @@ def test_flash_windowed_grid_grads_match_reference(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_windowed_grid_with_segments_and_gqa(rng):
     """window + packing + GQA on the restricted sweep."""
     q, k, v = _qkv(rng, b=2, s=256, h=8, hkv=2)

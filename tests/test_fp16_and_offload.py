@@ -91,6 +91,7 @@ def test_fp16_overflow_skips_update_and_shrinks_after_hysteresis(rng):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_fp16_matches_fp32_step_when_no_overflow(rng):
     """At moderate scale with fp32 params, the scaled step equals the
     unscaled one (scaling is numerically transparent)."""

@@ -213,6 +213,7 @@ def test_remat_stride_preserves_training_math(rng):
     assert losses[0] == pytest.approx(losses[2], rel=1e-6)
 
 
+@pytest.mark.slow
 def test_packed_attention_window_is_exact(rng):
     """packed_attention_window = max doc length must not change logits:
     intra-doc attention never spans further back than the doc itself, so

@@ -88,6 +88,7 @@ def test_quant_leaves_partition_as_frozen(rng):
         assert k[:-1] + ("scale",) in frozen
 
 
+@pytest.mark.slow
 def test_int8_frozen_loss_tracks_bf16(rng):
     """Quantization noise on the frozen base must be benign: the int8 run's
     loss trajectory stays within a small band of the bf16 run's."""

@@ -65,6 +65,7 @@ def test_ring_composes_with_tp(rng):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_with_batch_sharding(rng):
     """Batch over data, sequence over the ring — the training layout."""
     q, k, v = _qkv(rng, b=4, s=32)
@@ -98,6 +99,7 @@ def test_ring_gradients_match(rng):
         )
 
 
+@pytest.mark.slow
 def test_ring_custom_positions_match_reference(rng):
     """Explicit (shifted) positions: ring mask must follow the positions
     RoPE used, not reconstructed shard indices."""

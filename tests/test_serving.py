@@ -768,3 +768,20 @@ def test_budget_clamped_window_full_occupancy(tiny_model_and_params):
     # All 4 slots admitted together with budget 9 after the prefill token:
     # windows 8 then 1 (ladder), zero dead slot-steps -> 100% occupancy.
     assert st["decode_slot_steps"] == 4 * st["decode_steps"], st
+
+
+def test_window_never_exceeds_kv_room_near_model_len(tiny_model_and_params):
+    """Round-up windows must round back DOWN under hard KV room: a slot
+    near max_model_len with a large max_tokens budget must finish with a
+    length stop, not overflow its block table (regression: round-up clamp
+    picked k past max_blocks_per_seq)."""
+    model, params = tiny_model_and_params
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=32,
+                      max_model_len=32, cache_dtype="float32",
+                      eos_token_id=-1, steps_per_sync=8)
+    eng = InferenceEngine(CFG, params, ec)
+    prompt = list(range(1, 27))  # 26 tokens, 6 from the model-length stop
+    [res] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                  max_tokens=100))
+    assert res.finish_reason == "length"
+    assert len(prompt) + len(res.output_token_ids) <= ec.max_model_len

@@ -77,6 +77,7 @@ def test_frozen_params_unchanged(rng):
     assert moved, "no trainable params moved"
 
 
+@pytest.mark.slow
 def test_grad_accum_equals_big_batch(rng):
     """accum=4 x micro=1 must equal accum=1 x micro=4 (same tokens)."""
     model, state = make_state(rng)
@@ -174,6 +175,7 @@ def test_golden_loss_regression(rng):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_preemption_checkpoint_and_resume(tmp_path, rng):
     """request_stop() (the SIGTERM handler's action) checkpoints at the
     next step boundary; a fresh Trainer resumes from that step."""
@@ -225,6 +227,7 @@ def test_preemption_checkpoint_and_resume(tmp_path, rng):
     assert int(s2.step) == stopped_at
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_unchunked(rng):
     """loss_chunk computes the identical loss and produces the identical
     training trajectory as the full-logits path (up to summation order),
@@ -276,6 +279,7 @@ def test_chunked_ce_matches_unchunked_tied_int8(rng):
     np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_steps_per_sync_matches_per_step(tmp_path, rng):
     """TrainConfig.steps_per_sync: a scanned K-step window must produce the
     SAME trajectory as K separate calls (same data + per-step rng split),
