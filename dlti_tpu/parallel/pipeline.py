@@ -121,6 +121,7 @@ def pipeline_forward(
     lora: Optional[LoRAConfig] = None,
     num_microbatches: int = 4,
     positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
@@ -155,12 +156,18 @@ def pipeline_forward(
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     x_mb = x.reshape(num_microbatches, mb, s, -1)
     pos_mb = positions.reshape(num_microbatches, mb, s)
+    # Packed batches: segment ids travel with their microbatch so each
+    # stage applies the same intra-doc attention mask the unpipelined
+    # model would. A zero array means "one segment" (mask is a no-op) and
+    # keeps the scanned stage body shape-stable either way.
+    seg_mb = (segment_ids.reshape(num_microbatches, mb, s)
+              if segment_ids is not None else None)
 
     block = LlamaBlock(cfg, lora)
 
     layers_per_stage = cfg.num_layers // num_stages
 
-    def apply_stage(layer_params, x, pos, rng):
+    def apply_stage(layer_params, x, pos, seg, rng):
         """Apply this stage's local layers (leading dim = layers/stage)."""
         def body(carry, layer_with_idx):
             h = carry
@@ -170,7 +177,7 @@ def pipeline_forward(
             rngs = ({"dropout": jax.random.fold_in(rng, layer_idx)}
                     if not deterministic else None)
             out, _ = block.apply({"params": one_layer}, h, cos, sin, pos,
-                                 None, None, deterministic, rngs=rngs)
+                                 seg, None, deterministic, rngs=rngs)
             return out, None
 
         fn = jax.checkpoint(body) if cfg.remat else body
@@ -190,10 +197,10 @@ def pipeline_forward(
         # inserts the row/column-parallel collectives.
         axis_names=frozenset({"pipe"}),
         in_specs=(jax.tree_util.tree_map(lambda _: P("pipe"), pparams["layers"]),
-                  P(), P(), P()),
+                  P(), P(), P(), P()),
         out_specs=P(),
     )
-    def run_pipeline(local_layers, x_mb, pos_mb, rng):
+    def run_pipeline(local_layers, x_mb, pos_mb, seg_mb, rng):
         # Inside: one pipeline stage per device along 'pipe'.
         stage = jax.lax.axis_index("pipe")
         # Initial carries must be device-varying for the scan's carry type
@@ -209,9 +216,10 @@ def pipeline_forward(
             # t: stage k works on microbatch t - k.
             m_here = jnp.clip(t - stage, 0, num_microbatches - 1)
             pos = pos_mb[m_here]
+            seg = seg_mb[m_here] if segment_ids is not None else None
             # Fold the stage in as well: stage k's layers are globally
             # layers k*K..(k+1)*K-1, so masks differ across stages too.
-            out = apply_stage(local_layers, inp, pos,
+            out = apply_stage(local_layers, inp, pos, seg,
                               jax.random.fold_in(
                                   jax.random.fold_in(rng, t), stage))
             # Last stage finished microbatch t - (P-1) at this tick.
@@ -232,7 +240,9 @@ def pipeline_forward(
 
     rng_arg = (dropout_rng if dropout_rng is not None
                else jax.random.PRNGKey(0))  # unused when deterministic
-    y = run_pipeline(pparams["layers"], x_mb, pos_mb, rng_arg)
+    seg_arg = (seg_mb if seg_mb is not None
+               else jnp.zeros((num_microbatches, mb, s), jnp.int32))
+    y = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg, rng_arg)
     y = y.reshape(b, s, -1)
 
     # Final norm + head outside the pipeline (replicated).
@@ -301,6 +311,8 @@ def make_pipeline_train_step(
         logits = pipeline_forward(
             pparams, batch["input_ids"], cfg.model, mesh, lora=lora,
             num_microbatches=num_microbatches,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
             deterministic=False, dropout_rng=rng,
         )
         loss_sum, n_tok = causal_lm_loss(
@@ -340,6 +352,8 @@ def make_pipeline_eval_step(cfg: Config, mesh: Mesh) -> Callable:
         logits = pipeline_forward(
             state.params, batch["input_ids"], cfg.model, mesh, lora=lora,
             num_microbatches=1, deterministic=True,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
         )
         loss_sum, n_tok = causal_lm_loss(
             logits, batch["input_ids"], batch.get("loss_mask"))

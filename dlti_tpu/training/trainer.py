@@ -82,9 +82,8 @@ def _validate_pipeline_config(cfg: Config) -> None:
                        "own full-logits loss)")
     if cfg.model.num_experts > 0:
         illegal.append("MoE experts")
-    if cfg.data.pack_sequences:
-        illegal.append("packed sequences (the stage body takes no segment "
-                       "mask)")
+    # Packed sequences compose: segment ids ride each microbatch through
+    # the stages (pipeline_forward segment_ids), per-doc positions included.
     if cfg.model.remat and cfg.model.remat_policy != "nothing_saveable":
         illegal.append(f"remat_policy={cfg.model.remat_policy} (the scanned "
                        "stage body supports plain jax.checkpoint only)")
@@ -206,13 +205,10 @@ class Trainer:
                 self.cfg, self.tx, self.mesh, num_microbatches=accum)
 
             def step_fn(state, batch, rng):
-                if "segment_ids" in batch:
-                    raise ValueError(
-                        "packed batches are not supported under pipeline "
-                        "parallelism (the pipelined stage body takes no "
-                        "segment mask); disable packing")
                 # (accum, micro_bs, seq) -> (accum*micro_bs, seq): grad
                 # accumulation happens through the microbatch schedule.
+                # Packed batches ride along: segment_ids/positions flatten
+                # the same way and pipeline_forward masks per microbatch.
                 flat = {k: v.reshape((-1,) + v.shape[2:])
                         for k, v in batch.items()}
                 return pipe_step(state, flat, rng)
@@ -340,16 +336,9 @@ class Trainer:
             if cfg.parallel.pipe > 1:
                 from dlti_tpu.parallel.pipeline import make_pipeline_eval_step
 
-                pipe_eval = make_pipeline_eval_step(cfg, self.mesh)
-
-                def eval_fn(state, batch):
-                    if "segment_ids" in batch:
-                        raise ValueError(
-                            "packed eval batches are not supported under "
-                            "pipeline parallelism (the pipelined stage body "
-                            "takes no segment mask) — eval loss would be "
-                            "silently wrong; use an unpacked eval dataset")
-                    return pipe_eval(state, batch)
+                # Packed eval batches are fine: make_pipeline_eval_step
+                # passes segment_ids/positions through pipeline_forward.
+                eval_fn = make_pipeline_eval_step(cfg, self.mesh)
             else:
                 from dlti_tpu.training.step import make_eval_step
 

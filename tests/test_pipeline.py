@@ -286,3 +286,50 @@ def test_pipe_x_tensor_matches_single_device():
         want = np.asarray(
             ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_packed_matches_unpipelined(pipe_mesh):
+    """Packed batches under PP: segment ids and per-doc positions ride
+    each microbatch through the stages, so the pipelined step reproduces
+    the unpipelined packed step exactly."""
+    from conftest import make_packed_segments
+    from dlti_tpu.data.pipeline import packed_loss_mask, packed_positions
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+    from dlti_tpu.training.step import make_train_step
+
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    model = LlamaForCausalLM(CFG, lora)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
+    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                               lora_enabled=True)
+    segs = make_packed_segments(8, 16)
+    batch_flat = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                        CFG.vocab_size),
+        "segment_ids": segs,
+        "positions": packed_positions(segs),
+        "loss_mask": packed_loss_mask(segs),
+    }
+    ref_step = jax.jit(make_train_step(model, accum_steps=1))
+    ref_batch = {k: v[None] for k, v in batch_flat.items()}
+    rng = jax.random.PRNGKey(4)
+    ref_state, ref_m = ref_step(state, ref_batch, rng)
+
+    cfg = Config(model=CFG, lora=lora,
+                 optimizer=OptimizerConfig(warmup_steps=0),
+                 parallel=ParallelConfig(pipe=4),
+                 data=DataConfig(max_seq_len=16),
+                 train=TrainConfig(micro_batch_size=8, grad_accum_steps=1))
+    pstate = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                lora_enabled=True)
+    pstate = to_pipeline_state(pstate, CFG.num_layers)
+    pstep = make_pipeline_train_step(cfg, tx, pipe_mesh, num_microbatches=4)
+    pstate, pm = pstep(pstate, batch_flat, rng)
+
+    np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    back = from_pipeline_params(pstate.params, CFG.num_layers)
+    got = np.asarray(back["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+    want = np.asarray(
+        ref_state.params["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
