@@ -91,11 +91,17 @@ def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
         spec = [None] * v.ndim
         spec[0] = "pipe"
         if tp > 1:
-            from dlti_tpu.parallel.sharding import _path_str, _tp_dim
+            from dlti_tpu.parallel.sharding import (
+                _path_str, _quant_normalized_path, _tp_dim,
+            )
 
-            d = _tp_dim(_path_str(path))
+            # int8 trees: alias {kernel}/q and {kernel}/scale to the
+            # kernel's path so quantized stacked weights TP-shard too
+            # (scale's size-1 contraction dim auto-replicates via the
+            # divisibility check below).
+            d = _tp_dim(_quant_normalized_path(_path_str(path), v))
             # d is the TP dim in the unstacked layout; +1 for the layer dim.
-            if d is not None and v.shape[d + 1] % tp == 0:
+            if d is not None and d + 1 < v.ndim and v.shape[d + 1] % tp == 0:
                 spec[d + 1] = "tensor"
         return NamedSharding(mesh, P(*spec))
 
@@ -148,10 +154,23 @@ def pipeline_forward(
 
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
-    cos, sin = rope_frequencies(cfg.resolved_head_dim, cfg.max_seq_len, cfg.rope_theta)
+    # Cover the actual sequence even past the preset's design length
+    # (same fix as models/llama.py: positions >= table length hit
+    # jnp.take's NaN fill and training silently NaNs).
+    cos, sin = rope_frequencies(cfg.resolved_head_dim,
+                                max(cfg.max_seq_len, s), cfg.rope_theta)
 
-    # Embed outside the pipelined region (replicated).
-    x = jnp.take(pparams["embed_tokens"], input_ids, axis=0).astype(dtype)
+    # Embed outside the pipelined region (replicated). int8 frozen-base
+    # trees quantize the embedding too — gather int8 ROWS then scale
+    # (models/llama.py's lookup path): only (b*s, hidden) expands, never
+    # the whole (vocab, hidden) matrix in fp.
+    from dlti_tpu.models.quantization import is_quant_node, maybe_dequantize
+
+    emb = pparams["embed_tokens"]
+    if is_quant_node(emb):
+        x = emb["q"][input_ids].astype(dtype) * emb["scale"].astype(dtype)
+    else:
+        x = jnp.take(emb, input_ids, axis=0).astype(dtype)
     if cfg.embedding_scale:  # Gemma: embeddings scaled by sqrt(hidden)
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     x_mb = x.reshape(num_microbatches, mb, s, -1)
@@ -249,10 +268,15 @@ def pipeline_forward(
     norm = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset)
     y = norm.apply({"params": pparams["final_norm"]}, y)
     if cfg.tie_embeddings or "lm_head" not in pparams:
+        # fp32 dequant for the tied head (llama.py head_matrix parity:
+        # int8 -> fp32 directly, not via the lookup dtype).
+        tied = maybe_dequantize(pparams["embed_tokens"], jnp.float32,
+                                anchor=y)
         logits = jnp.einsum("bsh,vh->bsv", y.astype(jnp.float32),
-                            pparams["embed_tokens"].astype(jnp.float32))
+                            jnp.asarray(tied, jnp.float32))
     else:
-        logits = jnp.dot(y, pparams["lm_head"].astype(y.dtype),
+        lm_head = maybe_dequantize(pparams["lm_head"], y.dtype, anchor=y)
+        logits = jnp.dot(y, lm_head.astype(y.dtype),
                          preferred_element_type=jnp.float32)
     return logits.astype(jnp.float32)
 

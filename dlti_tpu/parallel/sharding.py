@@ -90,25 +90,33 @@ def _largest_divisible_dim(shape: tuple, size: int, taken=()) -> Optional[int]:
     return best
 
 
+def _quant_normalized_path(path_s: str, value: Any) -> str:
+    """Alias a quant-node leaf ("{kernel}/q" or "{kernel}/scale") to its
+    kernel's own path so the TP rules match quantized trees.
+
+    "q" keeps the kernel's rank and sharding; "scale" has size 1 on the
+    contraction dim, so divisibility checks at the call sites
+    automatically replicate it for row-parallel kernels and shard it with
+    the output channels for column-parallel ones. Gated on the quant-node
+    layout so ordinary leaves that happen to be *named* scale (RMSNorm's
+    param) are never aliased to their parent path.
+    """
+    if path_s.endswith("/q") and value.dtype == jnp.int8:
+        return path_s[:-2]
+    if path_s.endswith("/scale") and path_s.rsplit("/", 2)[-2] in (
+            "kernel", "embed_tokens", "lm_head", "w1", "w2", "w3"):
+        return path_s.rsplit("/", 1)[0]
+    return path_s
+
+
 def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     """PartitionSpec for one param leaf under the configured strategy."""
     shape = value.shape
     if len(shape) == 0:
         return P()
-    path_s = _path_str(path)
     # Weight-only int8 trees (serving) wrap each quantized kernel as
     # {"q": int8, "scale": fp32} — rules match on the kernel's own path.
-    # "q" keeps the kernel's rank and sharding; "scale" has size 1 on the
-    # contraction dim, so the divisibility checks below automatically
-    # replicate it for row-parallel kernels and shard it with the output
-    # channels for column-parallel ones. Gated on the quant-node layout so
-    # ordinary leaves that happen to be *named* scale (RMSNorm's param) are
-    # never aliased to their parent path.
-    if path_s.endswith("/q") and value.dtype == jnp.int8:
-        path_s = path_s[:-2]
-    elif path_s.endswith("/scale") and path_s.rsplit("/", 2)[-2] in (
-            "kernel", "embed_tokens", "lm_head", "w1", "w2", "w3"):
-        path_s = path_s.rsplit("/", 1)[0]
+    path_s = _quant_normalized_path(_path_str(path), value)
     spec: list = [None] * len(shape)
 
     ep_d = None

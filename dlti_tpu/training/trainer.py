@@ -74,9 +74,10 @@ def _validate_pipeline_config(cfg: Config) -> None:
         illegal.append("host offload")
     if cfg.train.fp16:
         illegal.append("fp16 loss scaling")
-    if cfg.train.quantize_frozen_base:
-        illegal.append("quantize_frozen_base (the pipelined embed/head "
-                       "consume raw arrays)")
+    # quantize_frozen_base composes: the stage body dequantizes int8
+    # leaves like the unpipelined block, and pipeline_forward dequantizes
+    # embed/head on the fly. (Under PP x TP, quantized kernels stay
+    # pipe-sharded only — the TP rules match raw kernel leaves.)
     if cfg.train.loss_chunk:
         illegal.append("loss_chunk (the pipelined last stage computes its "
                        "own full-logits loss)")
