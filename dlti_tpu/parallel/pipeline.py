@@ -175,12 +175,27 @@ def pipeline_forward(
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     x_mb = x.reshape(num_microbatches, mb, s, -1)
     pos_mb = positions.reshape(num_microbatches, mb, s)
+    if mesh.shape.get("data", 1) > 1:
+        # PP x DP: keep each microbatch row-sharded over 'data' (an auto
+        # axis inside the shard_map). Without the constraint the (b, s) ->
+        # (M, mb, s) reshape migrates the batch sharding onto the
+        # microbatch index M, and the tick loop's x_mb[m] gathers.
+        x_mb = jax.lax.with_sharding_constraint(
+            x_mb, NamedSharding(mesh, P(None, "data", None, None)))
+        pos_mb = jax.lax.with_sharding_constraint(
+            pos_mb, NamedSharding(mesh, P(None, "data", None)))
     # Packed batches: segment ids travel with their microbatch so each
     # stage applies the same intra-doc attention mask the unpipelined
     # model would. A zero array means "one segment" (mask is a no-op) and
     # keeps the scanned stage body shape-stable either way.
     seg_mb = (segment_ids.reshape(num_microbatches, mb, s)
               if segment_ids is not None else None)
+    if seg_mb is not None and mesh.shape.get("data", 1) > 1:
+        # Same row-sharding pin as x_mb/pos_mb above: without it the
+        # reshape migrates 'data' onto the microbatch index and every
+        # tick's seg_mb[m] gathers across the data axis.
+        seg_mb = jax.lax.with_sharding_constraint(
+            seg_mb, NamedSharding(mesh, P(None, "data", None)))
 
     block = LlamaBlock(cfg, lora)
 

@@ -64,10 +64,11 @@ def _validate_pipeline_config(cfg: Config) -> None:
     if int(par.zero_stage) != 0:
         illegal.append(f"zero_stage={int(par.zero_stage)} (stages hold "
                        "their full layer shard; ZeRO axes do not compose)")
-    # 'tensor' composes: stage-internal TP over a ('pipe','tensor') mesh
-    # (pipeline_param_shardings shards each stacked leaf over both axes;
-    # 'tensor' rides GSPMD inside the pipeline's shard_map).
-    for axis in ("data", "fsdp", "sequence", "expert"):
+    # 'tensor' and 'data' compose: stage-internal TP and batch-row DP ride
+    # GSPMD as auto axes inside the pipeline's shard_map (grads psum over
+    # 'data' automatically; microbatches stay row-sharded via an explicit
+    # constraint in pipeline_forward) — pipe x tensor x data is full 3D.
+    for axis in ("fsdp", "sequence", "expert"):
         if getattr(par, axis) > 1:
             illegal.append(f"{axis}={getattr(par, axis)}")
     if par.offload_optimizer or par.offload_params:
@@ -98,8 +99,9 @@ def _validate_pipeline_config(cfg: Config) -> None:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: single-host pipe (optionally x tensor for stage-"
-            "internal TP) with bf16 LoRA or full fine-tune, dense models, "
+            "Legal: single-host pipe x tensor x data (3D: GPipe stages, "
+            "stage-internal TP, batch-row DP) with bf16-or-int8-base LoRA "
+            "or full fine-tune, dense models, packed or padded batches, "
             "default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
