@@ -360,16 +360,36 @@ def make_pipeline_train_step(
 
     def step(state, batch, rng):
         trainable, frozen = state.trainable_and_frozen()
-        (loss, n_tok), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            trainable, frozen, batch, rng)
+        loss_scale = (state.scaler["scale"] if state.scaler is not None
+                      else jnp.float32(1.0))
+
+        def scaled_loss(trainable, frozen, batch, rng):
+            loss, n_tok = loss_fn(trainable, frozen, batch, rng)
+            return loss * loss_scale, n_tok
+
+        (loss, n_tok), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(trainable, frozen, batch, rng)
+        loss = loss / loss_scale
+        grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         updates, new_opt = state.tx.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+        grad_norm = optax.global_norm(grads)
+        metrics = {"loss": loss, "grad_norm": grad_norm,
                    "num_tokens": n_tok}
+        new_scaler = state.scaler
+        if state.scaler is not None:
+            from dlti_tpu.training.step import apply_loss_scaler
+
+            new_trainable, new_opt, new_scaler, extra = apply_loss_scaler(
+                state.scaler, grad_norm, new_trainable, trainable,
+                new_opt, state.opt_state, cfg.train.fp16_scale_window,
+                cfg.train.fp16_min_scale, cfg.train.fp16_hysteresis)
+            metrics.update(extra)
         return state.replace(
             step=state.step + 1,
             params=combine_params(new_trainable, frozen),
             opt_state=new_opt,
+            scaler=new_scaler,
         ), metrics
 
     return jax.jit(step, donate_argnums=(0,))

@@ -104,6 +104,50 @@ def chunked_causal_lm_loss(
     return loss_sum, n_tok
 
 
+def apply_loss_scaler(scaler: dict, grad_norm, new_trainable, old_trainable,
+                      new_opt_state, old_opt_state,
+                      scale_window: int, min_scale: float, hysteresis: int):
+    """Dynamic fp16 loss-scaler update (exact ds_config semantics:
+    ``configs/ds_config_zero1.json:25-32``) — shared by the flat and
+    pipelined train steps.
+
+    On overflow (non-finite grad norm) the optimizer update is skipped
+    (params/opt state keep old values) and the scale halves once the
+    hysteresis budget is spent; after ``scale_window`` consecutive good
+    steps the scale doubles. Returns
+    ``(trainable, opt_state, new_scaler, metrics_extra)``.
+    """
+    finite = jnp.isfinite(grad_norm)
+    new_trainable = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old),
+        new_trainable, old_trainable)
+    new_opt_state = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old)
+        if hasattr(new, "shape") else new,
+        new_opt_state, old_opt_state)
+
+    # Overflow: absorb into hysteresis first, then halve the scale.
+    hyst_after = jnp.where(finite, scaler["hysteresis_left"],
+                           jnp.maximum(scaler["hysteresis_left"] - 1, 0))
+    shrink = (~finite) & (scaler["hysteresis_left"] <= 1)
+    scale_after = jnp.where(
+        shrink, jnp.maximum(scaler["scale"] * 0.5, min_scale),
+        scaler["scale"])
+    good_after = jnp.where(finite, scaler["good_steps"] + 1, 0)
+    # Growth: double after scale_window consecutive good steps.
+    grow = good_after >= scale_window
+    new_scaler = {
+        "scale": jnp.where(grow, scale_after * 2.0, scale_after),
+        "good_steps": jnp.where(grow, 0, good_after),
+        # Any scale change re-arms the hysteresis budget.
+        "hysteresis_left": jnp.where(
+            shrink | grow, jnp.int32(hysteresis), hyst_after),
+    }
+    metrics_extra = {"loss_scale": new_scaler["scale"],
+                     "overflow": (~finite).astype(jnp.float32)}
+    return new_trainable, new_opt_state, new_scaler, metrics_extra
+
+
 def make_train_step(
     model,
     *,
@@ -259,35 +303,12 @@ def make_train_step(
 
         new_scaler = state.scaler
         if state.scaler is not None:
-            finite = jnp.isfinite(grad_norm)
-            # Skip the update on overflow (params/opt state keep old values).
-            new_trainable = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(finite, new, old),
-                new_trainable, trainable)
-            new_opt_state = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(finite, new, old)
-                if hasattr(new, "shape") else new,
-                new_opt_state, opt_state)
-
-            s = state.scaler
-            # Overflow: absorb into hysteresis first, then halve the scale.
-            hyst_after = jnp.where(finite, s["hysteresis_left"],
-                                   jnp.maximum(s["hysteresis_left"] - 1, 0))
-            shrink = (~finite) & (s["hysteresis_left"] <= 1)
-            scale_after = jnp.where(
-                shrink, jnp.maximum(s["scale"] * 0.5, fp16_min_scale), s["scale"])
-            good_after = jnp.where(finite, s["good_steps"] + 1, 0)
-            # Growth: double after fp16_scale_window consecutive good steps.
-            grow = good_after >= fp16_scale_window
-            new_scaler = {
-                "scale": jnp.where(grow, scale_after * 2.0, scale_after),
-                "good_steps": jnp.where(grow, 0, good_after),
-                # Any scale change re-arms the hysteresis budget.
-                "hysteresis_left": jnp.where(
-                    shrink | grow, jnp.int32(fp16_hysteresis), hyst_after),
-            }
-            metrics["loss_scale"] = new_scaler["scale"]
-            metrics["overflow"] = (~finite).astype(jnp.float32)
+            new_trainable, new_opt_state, new_scaler, extra = \
+                apply_loss_scaler(
+                    state.scaler, grad_norm, new_trainable, trainable,
+                    new_opt_state, opt_state, fp16_scale_window,
+                    fp16_min_scale, fp16_hysteresis)
+            metrics.update(extra)
 
         new_params = combine_params(new_trainable, frozen)
         new_state = state.replace(

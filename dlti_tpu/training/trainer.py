@@ -73,8 +73,9 @@ def _validate_pipeline_config(cfg: Config) -> None:
             illegal.append(f"{axis}={getattr(par, axis)}")
     if par.offload_optimizer or par.offload_params:
         illegal.append("host offload")
-    if cfg.train.fp16:
-        illegal.append("fp16 loss scaling")
+    # fp16 dynamic loss scaling composes: the pipelined step scales the
+    # loss, unscales grads, and evolves TrainState.scaler via the same
+    # apply_loss_scaler helper the flat step uses.
     # quantize_frozen_base composes: the stage body dequantizes int8
     # leaves like the unpipelined block, and pipeline_forward dequantizes
     # embed/head on the fly. (Under PP x TP, quantized kernels stay
