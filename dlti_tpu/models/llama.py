@@ -394,6 +394,26 @@ class LlamaModel(nn.Module):
         return x, new_caches
 
 
+def head_matrix_from_leaves(embed_leaf, head_leaf, tie_embeddings: bool,
+                            anchor) -> jnp.ndarray:
+    """The (hidden, vocab) head as an explicit matrix from raw param
+    leaves — ONE implementation of the chunked-loss head contract, shared
+    by the flat (``LlamaForCausalLM.head_matrix``) and pipeline-layout
+    (``parallel.pipeline.pipeline_head_matrix``) callers so a head change
+    cannot desynchronize the two chunked paths. Dtypes match __call__
+    exactly: tied embeddings project in float32, untied heads in the
+    activation dtype with fp32 accumulation."""
+    from dlti_tpu.models.quantization import maybe_dequantize
+
+    if tie_embeddings or head_leaf is None:
+        embed = maybe_dequantize(embed_leaf, jnp.float32, anchor=anchor)
+        return embed.astype(jnp.float32).T
+    head = head_leaf
+    if isinstance(head, dict):
+        head = maybe_dequantize(head, anchor.dtype, anchor=anchor)
+    return head.astype(anchor.dtype)
+
+
 class LlamaForCausalLM(nn.Module):
     """Body + LM head. Returns float32 logits (stable softmax/loss)."""
 
@@ -448,16 +468,9 @@ class LlamaForCausalLM(nn.Module):
         __call__ exactly: tied embeddings project in float32
         (the einsum above), untied heads in the activation dtype with
         fp32 accumulation."""
-        from dlti_tpu.models.quantization import maybe_dequantize
-
-        if self.cfg.tie_embeddings:
-            embed = maybe_dequantize(
-                params["model"]["embed_tokens"], jnp.float32, anchor=anchor)
-            return embed.astype(jnp.float32).T
-        head = params["lm_head"]
-        if isinstance(head, dict):
-            head = maybe_dequantize(head, anchor.dtype, anchor=anchor)
-        return head.astype(anchor.dtype)
+        return head_matrix_from_leaves(
+            params["model"]["embed_tokens"], params.get("lm_head"),
+            self.cfg.tie_embeddings, anchor)
 
     def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16) -> list:
         """Allocate a fixed-capacity KV cache for decode."""

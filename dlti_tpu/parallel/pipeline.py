@@ -130,6 +130,7 @@ def pipeline_forward(
     segment_ids: Optional[jnp.ndarray] = None,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
     """Run the full model with the block stack pipelined over ``pipe``.
 
@@ -282,6 +283,11 @@ def pipeline_forward(
     # Final norm + head outside the pipeline (replicated).
     norm = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset)
     y = norm.apply({"params": pparams["final_norm"]}, y)
+    if return_hidden:
+        # Sequence-chunked loss path: the caller applies the head per
+        # chunk (pipeline_head_matrix) so full fp32 logits never sit in
+        # HBM — the loss_chunk contract of training.step.
+        return y
     if cfg.tie_embeddings or "lm_head" not in pparams:
         # fp32 dequant for the tied head (llama.py head_matrix parity:
         # int8 -> fp32 directly, not via the lookup dtype).
@@ -294,6 +300,18 @@ def pipeline_forward(
         logits = jnp.dot(y, lm_head.astype(y.dtype),
                          preferred_element_type=jnp.float32)
     return logits.astype(jnp.float32)
+
+
+def pipeline_head_matrix(pparams: dict, cfg: ModelConfig, anchor) -> jnp.ndarray:
+    """The (hidden, vocab) head as an explicit matrix on pipeline-layout
+    params — the input to ``chunked_causal_lm_loss``. Delegates to the
+    ONE shared head contract (``models.llama.head_matrix_from_leaves``)
+    so the flat and pipelined chunked paths cannot desynchronize."""
+    from dlti_tpu.models.llama import head_matrix_from_leaves
+
+    return head_matrix_from_leaves(
+        pparams["embed_tokens"], pparams.get("lm_head"),
+        cfg.tie_embeddings, anchor)
 
 
 def to_pipeline_state(state, num_layers: int):
@@ -345,17 +363,27 @@ def make_pipeline_train_step(
 
     lora = cfg.lora if cfg.lora.enabled else None
 
+    loss_chunk = int(cfg.train.loss_chunk or 0)
+
     def loss_fn(trainable, frozen, batch, rng):
         pparams = combine_params(trainable, frozen)
-        logits = pipeline_forward(
+        out = pipeline_forward(
             pparams, batch["input_ids"], cfg.model, mesh, lora=lora,
             num_microbatches=num_microbatches,
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"),
             deterministic=False, dropout_rng=rng,
+            return_hidden=bool(loss_chunk),
         )
-        loss_sum, n_tok = causal_lm_loss(
-            logits, batch["input_ids"], batch.get("loss_mask"))
+        if loss_chunk:
+            from dlti_tpu.training.step import chunked_causal_lm_loss
+
+            loss_sum, n_tok = chunked_causal_lm_loss(
+                out, pipeline_head_matrix(pparams, cfg.model, out),
+                batch["input_ids"], batch.get("loss_mask"), loss_chunk)
+        else:
+            loss_sum, n_tok = causal_lm_loss(
+                out, batch["input_ids"], batch.get("loss_mask"))
         return loss_sum / jnp.maximum(n_tok, 1.0), n_tok
 
     def step(state, batch, rng):
@@ -407,15 +435,27 @@ def make_pipeline_eval_step(cfg: Config, mesh: Mesh) -> Callable:
 
     lora = cfg.lora if cfg.lora.enabled else None
 
+    loss_chunk = int(cfg.train.loss_chunk or 0)
+
     def eval_step(state, batch):
-        logits = pipeline_forward(
+        out = pipeline_forward(
             state.params, batch["input_ids"], cfg.model, mesh, lora=lora,
             num_microbatches=1, deterministic=True,
             positions=batch.get("positions"),
             segment_ids=batch.get("segment_ids"),
+            return_hidden=bool(loss_chunk),
         )
-        loss_sum, n_tok = causal_lm_loss(
-            logits, batch["input_ids"], batch.get("loss_mask"))
+        if loss_chunk:
+            # Mirror the train step: a run whose HBM budget depends on
+            # loss_chunk must not OOM at its first periodic eval.
+            from dlti_tpu.training.step import chunked_causal_lm_loss
+
+            loss_sum, n_tok = chunked_causal_lm_loss(
+                out, pipeline_head_matrix(state.params, cfg.model, out),
+                batch["input_ids"], batch.get("loss_mask"), loss_chunk)
+        else:
+            loss_sum, n_tok = causal_lm_loss(
+                out, batch["input_ids"], batch.get("loss_mask"))
         return {"loss": loss_sum / jnp.maximum(n_tok, 1.0),
                 "num_tokens": n_tok}
 

@@ -61,9 +61,14 @@ def _validate_pipeline_config(cfg: Config) -> None:
     weak #2: PP must be reachable from the production Trainer)."""
     par = cfg.parallel
     illegal = []
-    if int(par.zero_stage) != 0:
+    # ZeRO-1 composes (optimizer state shards over 'data'; the update runs
+    # under GSPMD outside the pipeline's shard_map); ZeRO-2/3 do not —
+    # stages hold their full layer shard, and grad reduce-scatter / param
+    # gathering would fight the stacked-layer pipe sharding.
+    if int(par.zero_stage) >= 2:
         illegal.append(f"zero_stage={int(par.zero_stage)} (stages hold "
-                       "their full layer shard; ZeRO axes do not compose)")
+                       "their full layer shard; ZeRO-2/3 axes do not "
+                       "compose; zero_stage=1 does)")
     # 'tensor' and 'data' compose: stage-internal TP and batch-row DP ride
     # GSPMD as auto axes inside the pipeline's shard_map (grads psum over
     # 'data' automatically; microbatches stay row-sharded via an explicit
@@ -78,11 +83,11 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # apply_loss_scaler helper the flat step uses.
     # quantize_frozen_base composes: the stage body dequantizes int8
     # leaves like the unpipelined block, and pipeline_forward dequantizes
-    # embed/head on the fly. (Under PP x TP, quantized kernels stay
-    # pipe-sharded only — the TP rules match raw kernel leaves.)
-    if cfg.train.loss_chunk:
-        illegal.append("loss_chunk (the pipelined last stage computes its "
-                       "own full-logits loss)")
+    # embed/head on the fly (quantized kernels TP-shard too via the shared
+    # quant-path normalization in parallel.sharding).
+    # loss_chunk composes: pipeline_forward returns hidden states and the
+    # pipelined loss applies the head per sequence chunk
+    # (pipeline_head_matrix + chunked_causal_lm_loss).
     if cfg.model.num_experts > 0:
         illegal.append("MoE experts")
     # Packed sequences compose: segment ids ride each microbatch through
@@ -181,11 +186,21 @@ class Trainer:
 
             state = to_pipeline_state(state, self.cfg.model.num_layers)
             repl = NamedSharding(self.mesh, P())
+            # opt_state_shardings is shape-based, so it applies to the
+            # stacked trainable tree unchanged: ZeRO-1 x PP shards Adam
+            # moments over 'data' (the update runs under GSPMD outside
+            # the pipeline's shard_map); every other legal pipe config
+            # (stage NONE, or data==1) falls out replicated.
+            from dlti_tpu.parallel.sharding import opt_state_shardings
+
             state = state.replace(
                 params=jax.device_put(
                     state.params,
                     pipeline_param_shardings(state.params, self.mesh)),
-                opt_state=jax.device_put(state.opt_state, repl),
+                opt_state=jax.device_put(
+                    state.opt_state,
+                    opt_state_shardings(state.opt_state, self.cfg,
+                                        self.mesh)),
                 step=jax.device_put(state.step, repl),
             )
         elif self.mesh is not None:
