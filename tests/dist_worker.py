@@ -17,7 +17,10 @@ scalability, so this worker bypasses the dataset and slices the global
 batch directly — the point here is numerical equivalence of the
 distributed step, not the data schedule.
 
-Usage: ``python tests/dist_worker.py OUT_JSON [n_steps]``
+Usage: ``python tests/dist_worker.py OUT_JSON [n_steps] [strategy]``
+(strategy: ``zero3`` (default) or ``tp`` — ZeRO-3 fsdp=8, or fsdp=4 x
+tensor=2 with the tensor axis spanning both processes, so TP's
+row/column-parallel collectives really cross a process boundary.)
 """
 
 import json
@@ -34,6 +37,7 @@ N_LOCAL_DEVICES = 4  # per process; 2 processes -> 8-device global mesh
 def main() -> None:
     out_path = sys.argv[1]
     n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    strategy = sys.argv[3] if len(sys.argv) > 3 else "zero3"
 
     flags = os.environ.get("XLA_FLAGS", "")
     os.environ["XLA_FLAGS"] = (
@@ -62,11 +66,21 @@ def main() -> None:
     from dlti_tpu.parallel.sharding import make_global_batch
     from dlti_tpu.training import build_optimizer, create_train_state
 
+    parallel = {
+        "zero3": ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=8),
+        # fsdp=4 x tensor=2: with (fsdp, tensor)-major device order the
+        # tensor pairs are process-local while the fsdp axis spans both
+        # processes — a mixed TP x FSDP mesh whose cross-process
+        # collectives (param all-gathers / grad reduce-scatters) compose
+        # with TP-sharded kernels. The pure-fsdp mode already proves
+        # cross-process collectives; this mode proves the composition.
+        "tp": ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4, tensor=2),
+    }[strategy]
     cfg = Config(
         model=MODEL_PRESETS["llama_tiny"],
         lora=LoRAConfig(r=4, alpha=8, dropout=0.0),
         optimizer=OptimizerConfig(warmup_steps=2),
-        parallel=ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=8),
+        parallel=parallel,
         train=TrainConfig(micro_batch_size=8, grad_accum_steps=2),
     )
     rng = jax.random.PRNGKey(0)

@@ -63,7 +63,8 @@ def _reference_losses(n_steps: int):
     return losses
 
 
-def test_two_process_zero3_matches_single_device(tmp_path):
+@pytest.mark.parametrize("strategy", ["zero3", "tp"])
+def test_two_process_mesh_matches_single_device(tmp_path, strategy):
     n_steps = 4
     out = tmp_path / "rank0.json"
     env = dict(os.environ)
@@ -74,7 +75,7 @@ def test_two_process_zero3_matches_single_device(tmp_path):
         [sys.executable, os.path.join(REPO, "scripts", "launch.py"),
          "--num-processes", "2", "--log-dir", str(tmp_path / "logs"), "--",
          sys.executable, os.path.join(REPO, "tests", "dist_worker.py"),
-         str(out), str(n_steps)],
+         str(out), str(n_steps), strategy],
         env=env, capture_output=True, text=True, timeout=900, cwd=REPO)
     logs = ""
     for rank in (0, 1):
