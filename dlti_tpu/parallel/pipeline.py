@@ -131,8 +131,15 @@ def pipeline_forward(
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
     return_hidden: bool = False,
+    token_mask: Optional[jnp.ndarray] = None,
+    return_aux: bool = False,
 ) -> jnp.ndarray:
     """Run the full model with the block stack pipelined over ``pipe``.
+
+    ``return_aux``: additionally return the per-microbatch router
+    aux-loss sums, shape (num_microbatches,) — MoE models only.
+    ``token_mask`` (b, s): keeps padding tokens out of expert capacity
+    (packed batches derive it from ``segment_ids`` instead).
 
     ``input_ids``: (batch, seq); batch must divide by ``num_microbatches``.
     Returns float32 logits (batch, seq, vocab) — the same function as
@@ -142,11 +149,7 @@ def pipeline_forward(
     if cfg.num_layers % num_stages != 0:
         raise ValueError(f"num_layers={cfg.num_layers} must divide into "
                          f"pipe={num_stages} stages")
-    if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "MoE models are not supported under pipeline parallelism yet "
-            "(the router load-balance aux loss sown inside the pipelined "
-            "region is not collected); use data/fsdp/tensor/expert axes")
+    moe = cfg.num_experts > 0
     b, s = input_ids.shape
     if b % num_microbatches != 0:
         raise ValueError(f"batch={b} must divide by microbatches={num_microbatches}")
@@ -202,8 +205,11 @@ def pipeline_forward(
 
     layers_per_stage = cfg.num_layers // num_stages
 
-    def apply_stage(layer_params, x, pos, seg, rng):
-        """Apply this stage's local layers (leading dim = layers/stage)."""
+    def apply_stage(layer_params, x, pos, seg, tm, rng):
+        """Apply this stage's local layers (leading dim = layers/stage).
+
+        Returns (x, aux_sum) — aux_sum is the stage's summed router
+        aux losses (0 for dense models)."""
         def body(carry, layer_with_idx):
             h = carry
             one_layer, layer_idx = layer_with_idx
@@ -211,14 +217,25 @@ def pipeline_forward(
             # layers_{i} module paths fold distinct keys).
             rngs = ({"dropout": jax.random.fold_in(rng, layer_idx)}
                     if not deterministic else None)
-            out, _ = block.apply({"params": one_layer}, h, cos, sin, pos,
-                                 seg, None, deterministic, rngs=rngs)
-            return out, None
+            if moe:
+                # Collect each MoE layer's sown load-balance loss.
+                (out, _), variables = block.apply(
+                    {"params": one_layer}, h, cos, sin, pos,
+                    seg, None, deterministic, token_mask=tm, rngs=rngs,
+                    mutable=["intermediates"])
+                from dlti_tpu.models.moe import collect_aux_loss
+
+                aux = collect_aux_loss(variables.get("intermediates", {}))
+            else:
+                out, _ = block.apply({"params": one_layer}, h, cos, sin, pos,
+                                     seg, None, deterministic, rngs=rngs)
+                aux = jnp.float32(0.0)
+            return out, aux
 
         fn = jax.checkpoint(body) if cfg.remat else body
-        x, _ = jax.lax.scan(
+        x, aux_layers = jax.lax.scan(
             fn, x, (layer_params, jnp.arange(layers_per_stage)))
-        return x
+        return x, jnp.sum(aux_layers)
 
     num_ticks = num_microbatches + num_stages - 1
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -232,19 +249,21 @@ def pipeline_forward(
         # inserts the row/column-parallel collectives.
         axis_names=frozenset({"pipe"}),
         in_specs=(jax.tree_util.tree_map(lambda _: P("pipe"), pparams["layers"]),
-                  P(), P(), P(), P()),
-        out_specs=P(),
+                  P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
     )
-    def run_pipeline(local_layers, x_mb, pos_mb, seg_mb, rng):
+    def run_pipeline(local_layers, x_mb, pos_mb, seg_mb, tm_mb, rng):
         # Inside: one pipeline stage per device along 'pipe'.
         stage = jax.lax.axis_index("pipe")
         # Initial carries must be device-varying for the scan's carry type
         # to be stable (they become varying after the first ppermute).
         buf = jax.lax.pvary(jnp.zeros_like(x_mb[0]), "pipe")
         outputs = jax.lax.pvary(jnp.zeros_like(x_mb), "pipe")
+        aux_vec = jax.lax.pvary(
+            jnp.zeros((num_microbatches,), jnp.float32), "pipe")
 
         def tick(carry, t):
-            buf, outputs = carry
+            buf, outputs, aux_vec = carry
             m_in = jnp.clip(t, 0, num_microbatches - 1)
             inp = jnp.where(stage == 0, x_mb[m_in], buf)
             # Positions for the microbatch this stage is processing at tick
@@ -252,11 +271,18 @@ def pipeline_forward(
             m_here = jnp.clip(t - stage, 0, num_microbatches - 1)
             pos = pos_mb[m_here]
             seg = seg_mb[m_here] if segment_ids is not None else None
+            tm = tm_mb[m_here] if moe else None
             # Fold the stage in as well: stage k's layers are globally
             # layers k*K..(k+1)*K-1, so masks differ across stages too.
-            out = apply_stage(local_layers, inp, pos, seg,
-                              jax.random.fold_in(
-                                  jax.random.fold_in(rng, t), stage))
+            out, aux = apply_stage(local_layers, inp, pos, seg, tm,
+                                   jax.random.fold_in(
+                                       jax.random.fold_in(rng, t), stage))
+            # Edge ticks (pipeline fill/drain) recompute a clipped
+            # microbatch; their aux must not double-count.
+            valid = ((t - stage >= 0)
+                     & (t - stage < num_microbatches)).astype(jnp.float32)
+            aux_vec = aux_vec + jax.nn.one_hot(
+                m_here, num_microbatches, dtype=jnp.float32) * aux * valid
             # Last stage finished microbatch t - (P-1) at this tick.
             m_out = t - (num_stages - 1)
             write = (stage == num_stages - 1) & (m_out >= 0)
@@ -264,20 +290,29 @@ def pipeline_forward(
                 outputs, out, jnp.maximum(m_out, 0), 0)
             outputs = jnp.where(write, updated, outputs)
             buf = jax.lax.ppermute(out, "pipe", perm)
-            return (buf, outputs), None
+            return (buf, outputs, aux_vec), None
 
-        (buf, outputs), _ = jax.lax.scan(
-            tick, (buf, outputs), jnp.arange(num_ticks))
+        (buf, outputs, aux_vec), _ = jax.lax.scan(
+            tick, (buf, outputs, aux_vec), jnp.arange(num_ticks))
         # Only the last stage holds real outputs; broadcast to every stage
         # (psum over the one-hot mask — a pipe-axis all-reduce on ICI).
+        # aux: every stage holds ITS layers' contribution — psum is the
+        # sum over the whole layer stack.
         mask = (stage == num_stages - 1).astype(outputs.dtype)
-        return jax.lax.psum(outputs * mask, "pipe")
+        return (jax.lax.psum(outputs * mask, "pipe"),
+                jax.lax.psum(aux_vec, "pipe"))
 
     rng_arg = (dropout_rng if dropout_rng is not None
                else jax.random.PRNGKey(0))  # unused when deterministic
     seg_arg = (seg_mb if seg_mb is not None
                else jnp.zeros((num_microbatches, mb, s), jnp.int32))
-    y = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg, rng_arg)
+    if moe and token_mask is None and segment_ids is not None:
+        token_mask = (segment_ids != 0).astype(jnp.int32)  # packed: 0 = pad
+    tm_arg = (token_mask.reshape(num_microbatches, mb, s)
+              if (moe and token_mask is not None)
+              else jnp.ones((num_microbatches, mb, s), jnp.int32))
+    y, aux_vec = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg,
+                              tm_arg, rng_arg)
     y = y.reshape(b, s, -1)
 
     # Final norm + head outside the pipeline (replicated).
@@ -287,7 +322,7 @@ def pipeline_forward(
         # Sequence-chunked loss path: the caller applies the head per
         # chunk (pipeline_head_matrix) so full fp32 logits never sit in
         # HBM — the loss_chunk contract of training.step.
-        return y
+        return (y, aux_vec) if return_aux else y
     if cfg.tie_embeddings or "lm_head" not in pparams:
         # fp32 dequant for the tied head (llama.py head_matrix parity:
         # int8 -> fp32 directly, not via the lookup dtype).
@@ -299,7 +334,8 @@ def pipeline_forward(
         lm_head = maybe_dequantize(pparams["lm_head"], y.dtype, anchor=y)
         logits = jnp.dot(y, lm_head.astype(y.dtype),
                          preferred_element_type=jnp.float32)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    return (logits, aux_vec) if return_aux else logits
 
 
 def pipeline_head_matrix(pparams: dict, cfg: ModelConfig, anchor) -> jnp.ndarray:
@@ -364,9 +400,21 @@ def make_pipeline_train_step(
     lora = cfg.lora if cfg.lora.enabled else None
 
     loss_chunk = int(cfg.train.loss_chunk or 0)
+    moe_coef = (cfg.model.router_aux_loss_coef
+                if cfg.model.num_experts > 0 else 0.0)
+    if loss_chunk and moe_coef:
+        raise ValueError(
+            "loss_chunk does not compose with MoE aux-loss collection; "
+            "set train.loss_chunk=0 for MoE models")
 
     def loss_fn(trainable, frozen, batch, rng):
         pparams = combine_params(trainable, frozen)
+        loss_mask = batch.get("loss_mask")
+        # Unpacked MoE: loss_mask IS the padding mask — keep padding out
+        # of expert capacity/aux stats (flat-step parity). Packed batches
+        # derive the mask from segment_ids inside pipeline_forward.
+        tm = (loss_mask if (moe_coef and loss_mask is not None
+                            and batch.get("segment_ids") is None) else None)
         out = pipeline_forward(
             pparams, batch["input_ids"], cfg.model, mesh, lora=lora,
             num_microbatches=num_microbatches,
@@ -374,17 +422,38 @@ def make_pipeline_train_step(
             segment_ids=batch.get("segment_ids"),
             deterministic=False, dropout_rng=rng,
             return_hidden=bool(loss_chunk),
+            token_mask=tm, return_aux=bool(moe_coef),
         )
+        aux_vec = None
+        if moe_coef:
+            out, aux_vec = out
         if loss_chunk:
             from dlti_tpu.training.step import chunked_causal_lm_loss
 
             loss_sum, n_tok = chunked_causal_lm_loss(
                 out, pipeline_head_matrix(pparams, cfg.model, out),
-                batch["input_ids"], batch.get("loss_mask"), loss_chunk)
+                batch["input_ids"], loss_mask, loss_chunk)
         else:
             loss_sum, n_tok = causal_lm_loss(
-                out, batch["input_ids"], batch.get("loss_mask"))
-        return loss_sum / jnp.maximum(n_tok, 1.0), n_tok
+                out, batch["input_ids"], loss_mask)
+        n_tok = jnp.maximum(n_tok, 1.0)
+        aux_weighted = jnp.float32(0.0)
+        if moe_coef:
+            # Flat-step parity: each microbatch's aux weighted by its own
+            # token count, so the objective equals the grad-accum loop's
+            # sum of (loss_sum_m + coef * aux_m * n_tok_m), all / n_tok.
+            b, s = batch["input_ids"].shape
+            mask = (loss_mask if loss_mask is not None
+                    else jnp.ones((b, s), jnp.int32))
+            # The flat step weights aux_m by the microbatch's CE token
+            # count — the SHIFTED mask (targets are input_ids[:, 1:]).
+            n_tok_m = jnp.sum(
+                mask.reshape(num_microbatches, -1, s)[:, :, 1:]
+                .astype(jnp.float32), axis=(1, 2))
+            aux_weighted = jnp.sum(aux_vec * n_tok_m)
+        objective = (loss_sum + moe_coef * aux_weighted) / n_tok
+        ce_mean = loss_sum / n_tok
+        return objective, (ce_mean, aux_weighted / n_tok, n_tok)
 
     def step(state, batch, rng):
         trainable, frozen = state.trainable_and_frozen()
@@ -392,18 +461,20 @@ def make_pipeline_train_step(
                       else jnp.float32(1.0))
 
         def scaled_loss(trainable, frozen, batch, rng):
-            loss, n_tok = loss_fn(trainable, frozen, batch, rng)
-            return loss * loss_scale, n_tok
+            objective, parts = loss_fn(trainable, frozen, batch, rng)
+            return objective * loss_scale, parts
 
-        (loss, n_tok), grads = jax.value_and_grad(
+        (_, (ce_mean, aux_mean, n_tok)), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(trainable, frozen, batch, rng)
-        loss = loss / loss_scale
         grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         updates, new_opt = state.tx.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)
         grad_norm = optax.global_norm(grads)
-        metrics = {"loss": loss, "grad_norm": grad_norm,
+        # Reported loss stays pure CE (aux separate), like the flat step.
+        metrics = {"loss": ce_mean, "grad_norm": grad_norm,
                    "num_tokens": n_tok}
+        if moe_coef:
+            metrics["aux_loss"] = aux_mean
         new_scaler = state.scaler
         if state.scaler is not None:
             from dlti_tpu.training.step import apply_loss_scaler

@@ -88,8 +88,9 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # loss_chunk composes: pipeline_forward returns hidden states and the
     # pipelined loss applies the head per sequence chunk
     # (pipeline_head_matrix + chunked_causal_lm_loss).
-    if cfg.model.num_experts > 0:
-        illegal.append("MoE experts")
+    # MoE composes: the stage scan collects each layer's sown router
+    # aux loss (edge ticks masked so fill/drain recomputes don't
+    # double-count), psum'd over 'pipe'; EP (expert axis) still doesn't.
     # Packed sequences compose: segment ids ride each microbatch through
     # the stages (pipeline_forward segment_ids), per-doc positions included.
     if cfg.model.remat and cfg.model.remat_policy != "nothing_saveable":
@@ -107,8 +108,8 @@ def _validate_pipeline_config(cfg: Config) -> None:
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
             "Legal: single-host pipe x tensor x data (3D: GPipe stages, "
             "stage-internal TP, batch-row DP) with bf16-or-int8-base LoRA "
-            "or full fine-tune, dense models, packed or padded batches, "
-            "default remat")
+            "or full fine-tune, dense or MoE models, packed or padded "
+            "batches, fp16 scaler, loss_chunk, ZeRO-1, default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 

@@ -613,3 +613,60 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
     assert sharded0 == 0, "baseline pipe run must replicate opt state"
     assert sharded1 > 0, "ZeRO-1 x PP must shard optimizer moments"
     np.testing.assert_allclose(loss1, loss0, rtol=1e-6)
+
+
+def test_pipeline_moe_matches_flat_grad_accum():
+    """MoE under PP: the pipelined step's aux-loss collection (per-layer
+    sown losses, edge-tick masked, psum over pipe) reproduces the flat
+    grad-accumulation step with identical microbatching — same CE, same
+    aux, same updated params."""
+    import dataclasses
+
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+    from dlti_tpu.training.step import make_train_step
+
+    moe_cfg = dataclasses.replace(
+        MODEL_PRESETS["mixtral_tiny"], num_layers=4, remat=False,
+        dtype="float32", param_dtype="float32",
+        attention_impl="reference", max_seq_len=32)
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    model = LlamaForCausalLM(moe_cfg, lora)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
+
+    def fresh():
+        return create_train_state(jax.random.PRNGKey(0), model, tx, (2, 16),
+                                  lora_enabled=True)
+
+    # (accum=4, mb=2, seq=16): the flat step's microbatches == the
+    # pipeline's microbatches, so even capacity DROPS match exactly.
+    batch = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (4, 2, 16),
+                                        0, moe_cfg.vocab_size),
+        "loss_mask": jnp.ones((4, 2, 16), jnp.int32),
+    }
+    rng = jax.random.PRNGKey(4)
+    ref_step = jax.jit(make_train_step(model, accum_steps=4))
+    ref_state, ref_m = ref_step(fresh(), batch, rng)
+    assert "aux_loss" in ref_m
+
+    cfg = Config(model=moe_cfg, lora=lora,
+                 optimizer=OptimizerConfig(warmup_steps=0),
+                 parallel=ParallelConfig(pipe=4),
+                 data=DataConfig(max_seq_len=16),
+                 train=TrainConfig(micro_batch_size=2, grad_accum_steps=4))
+    mesh = build_mesh(ParallelConfig(pipe=4))
+    pstate = to_pipeline_state(fresh(), moe_cfg.num_layers)
+    pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
+    batch_flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()}
+    pstate, pm = pstep(pstate, batch_flat, rng)
+
+    np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(pm["aux_loss"]), float(ref_m["aux_loss"]),
+                               rtol=1e-5)
+    back = from_pipeline_params(pstate.params, moe_cfg.num_layers)
+    got = np.asarray(back["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+    want = np.asarray(
+        ref_state.params["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
