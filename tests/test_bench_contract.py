@@ -1,0 +1,77 @@
+"""bench.py driver contract (the r03 postmortem, pinned).
+
+The driver parses bench.py's LAST stdout line as JSON and records the
+exit code. Whatever happens — unreachable backend, bad env config, a
+wedged relay — there must be exactly ONE JSON line and a meaningful rc,
+within a bounded time. r03 lost its round's perf verification to a
+silent rc=124; these tests keep that failure mode dead.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, timeout=120):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra)
+    proc = subprocess.run([sys.executable, BENCH], env=env,
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO)
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    return proc, lines
+
+
+def test_unreachable_backend_fails_fast_with_json():
+    """Backend init failure -> error JSON + nonzero exit in seconds, not
+    the r03 silent 50-minute burn."""
+    proc, lines = _run({"JAX_PLATFORMS": "bogus",
+                        "BENCH_PROBE_TIMEOUT": "30"})
+    assert proc.returncode == 3, proc.stderr[-500:]
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    assert out["value"] == 0.0
+    assert "probe failed" in out["error"]
+
+
+def test_bad_env_config_emits_json():
+    """A config typo must not burn candidates or exit silently."""
+    proc, lines = _run({"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "llama_tiny",
+                        "BENCH_QUANT": "int4"})
+    assert proc.returncode == 2, proc.stderr[-500:]
+    out = json.loads(lines[-1])
+    assert "BENCH_QUANT" in out["error"]
+
+
+@pytest.mark.slow
+def test_happy_path_single_json_line():
+    """CPU run on the tiny preset: rc=0 and exactly one parseable JSON
+    line with the driver-contract keys."""
+    proc, lines = _run({"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "llama_tiny",
+                        "BENCH_BS": "2", "BENCH_SEQ": "64",
+                        "BENCH_STEPS": "2"}, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert len(lines) == 1, lines
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out
+    assert out["value"] > 0
+
+
+@pytest.mark.slow
+def test_watchdog_deadline_emits_json():
+    """A deadline hit mid-run still produces one JSON line and a
+    diagnosable error instead of rc=124."""
+    proc, lines = _run({"JAX_PLATFORMS": "cpu", "BENCH_SKIP_PROBE": "1",
+                        "BENCH_DEADLINE_S": "5", "BENCH_MODEL": "llama_tiny",
+                        "BENCH_BS": "2", "BENCH_SEQ": "64"}, timeout=180)
+    assert proc.returncode in (4, 5), (proc.returncode, proc.stderr[-500:])
+    out = json.loads(lines[-1])
+    assert "error" in out
