@@ -34,6 +34,8 @@ def main():
     ap.add_argument("--requests", type=int, default=112)
     ap.add_argument("--max-tokens", type=int, default=256)
     ap.add_argument("--sync", type=int, default=64)
+    ap.add_argument("--json-out", default="",
+                    help="output path (default: results/int8_kv_ab_{cpu,r05}.json)")
     ap.add_argument("--blocks", type=int, default=455,
                     help="bf16-arm block count (int8 arm gets 2x)")
     args = ap.parse_args()
@@ -122,8 +124,8 @@ def main():
         "steps_per_sync": args.sync, "max_tokens": args.max_tokens,
         "requests": args.requests, "date": "2026-08-01",
     }
-    name = ("results/int8_kv_ab_cpu.json" if args.cpu
-            else "results/int8_kv_ab_r04.json")
+    name = args.json_out or ("results/int8_kv_ab_cpu.json" if args.cpu
+                             else "results/int8_kv_ab_r05.json")
     with open(name, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out, indent=1))

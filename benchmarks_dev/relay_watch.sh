@@ -1,0 +1,38 @@
+#!/bin/bash
+# Relay ambush (r05): probe the axon relay every ~10 min; the moment it
+# answers, fire chip_day.sh. Exits after chip_day completes (or
+# immediately if another instance is already watching), so a supervising
+# session gets notified exactly once per recovery.
+#
+#   bash benchmarks_dev/relay_watch.sh [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+MAX_HOURS=${1:-11}
+LOCK=/tmp/relay_watch.lock
+LOG=/tmp/relay_watch.log
+
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "relay_watch: another instance holds $LOCK; exiting" | tee -a "$LOG"
+  exit 2
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+log() { echo "[relay_watch $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+ATTEMPT=0
+log "watching (max ${MAX_HOURS}h, probe every ~10 min)"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  T0=$(date +%s)
+  if timeout 240 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
+    log "probe $ATTEMPT: RELAY UP after $(( $(date +%s) - T0 ))s - firing chip_day"
+    bash benchmarks_dev/chip_day.sh >> "$LOG" 2>&1
+    log "chip_day finished (rc=$?)"
+    exit 0
+  fi
+  log "probe $ATTEMPT: down ($(( $(date +%s) - T0 ))s)"
+  sleep 600
+done
+log "gave up after ${MAX_HOURS}h without a relay window"
+exit 1

@@ -84,32 +84,45 @@ def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
     tensor parallelism. The ``tensor`` axis stays a GSPMD *auto* axis
     inside the pipeline's shard_map (see :func:`pipeline_forward`), so XLA
     partitions the block math and inserts the TP collectives.
+
+    ``embed_tokens`` / ``lm_head`` follow the flat-TP vocab rules too
+    (rows / cols over ``tensor``): the embed lookup and the (b, s, vocab)
+    fp32 head einsum sit *outside* the pipe shard_map as ordinary GSPMD
+    ops, so sharding the leaves is all it takes for XLA to partition the
+    largest single matmul instead of replicating it per device (r04
+    advisor finding).
     """
     tp = mesh.shape.get("tensor", 1)
 
-    def leaf_layers(path, v):
-        spec = [None] * v.ndim
-        spec[0] = "pipe"
-        if tp > 1:
-            from dlti_tpu.parallel.sharding import (
-                _path_str, _quant_normalized_path, _tp_dim,
-            )
+    def leaf(prefix, dim_shift, lead_axis):
+        """One TP-rule lookup for both layouts: stacked layers (dim_shift=1
+        for the leading 'pipe'-sharded layer dim) and top-level leaves
+        (dim_shift=0, path prefixed with the tree key so the flat rules
+        match)."""
+        def f(path, v):
+            spec = [None] * v.ndim
+            if lead_axis:
+                spec[0] = lead_axis
+            if tp > 1:
+                from dlti_tpu.parallel.sharding import (
+                    _path_str, _quant_normalized_path, _tp_dim,
+                )
 
-            # int8 trees: alias {kernel}/q and {kernel}/scale to the
-            # kernel's path so quantized stacked weights TP-shard too
-            # (scale's size-1 contraction dim auto-replicates via the
-            # divisibility check below).
-            d = _tp_dim(_quant_normalized_path(_path_str(path), v))
-            # d is the TP dim in the unstacked layout; +1 for the layer dim.
-            if d is not None and d + 1 < v.ndim and v.shape[d + 1] % tp == 0:
-                spec[d + 1] = "tensor"
-        return NamedSharding(mesh, P(*spec))
+                # int8 trees: alias {kernel}/q and {kernel}/scale to the
+                # kernel's path so quantized weights TP-shard too
+                # (scale's size-1 contraction dim auto-replicates via the
+                # divisibility check below).
+                p = "/".join(x for x in (prefix, _path_str(path)) if x)
+                d = _tp_dim(_quant_normalized_path(p, v))
+                if (d is not None and d + dim_shift < v.ndim
+                        and v.shape[d + dim_shift] % tp == 0):
+                    spec[d + dim_shift] = "tensor"
+            return NamedSharding(mesh, P(*spec))
+        return f
 
     return {
-        k: (jax.tree_util.tree_map_with_path(leaf_layers, v)
-            if k == "layers"
-            else jax.tree_util.tree_map(
-                lambda x: NamedSharding(mesh, P()), v))
+        k: jax.tree_util.tree_map_with_path(
+            leaf("", 1, "pipe") if k == "layers" else leaf(k, 0, None), v)
         for k, v in pparams.items()
     }
 
@@ -257,10 +270,10 @@ def pipeline_forward(
         stage = jax.lax.axis_index("pipe")
         # Initial carries must be device-varying for the scan's carry type
         # to be stable (they become varying after the first ppermute).
-        buf = jax.lax.pvary(jnp.zeros_like(x_mb[0]), "pipe")
-        outputs = jax.lax.pvary(jnp.zeros_like(x_mb), "pipe")
-        aux_vec = jax.lax.pvary(
-            jnp.zeros((num_microbatches,), jnp.float32), "pipe")
+        buf = jax.lax.pcast(jnp.zeros_like(x_mb[0]), "pipe", to="varying")
+        outputs = jax.lax.pcast(jnp.zeros_like(x_mb), "pipe", to="varying")
+        aux_vec = jax.lax.pcast(
+            jnp.zeros((num_microbatches,), jnp.float32), "pipe", to="varying")
 
         def tick(carry, t):
             buf, outputs, aux_vec = carry
@@ -311,6 +324,12 @@ def pipeline_forward(
     tm_arg = (token_mask.reshape(num_microbatches, mb, s)
               if (moe and token_mask is not None)
               else jnp.ones((num_microbatches, mb, s), jnp.int32))
+    if moe and token_mask is not None and mesh.shape.get("data", 1) > 1:
+        # Same row-sharding pin as x_mb/pos_mb/seg_mb above: without it
+        # the (b, s) -> (M, mb, s) reshape migrates 'data' onto the
+        # microbatch index and every tick's tm_mb[m] gathers.
+        tm_arg = jax.lax.with_sharding_constraint(
+            tm_arg, NamedSharding(mesh, P(None, "data", None)))
     y, aux_vec = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg,
                               tm_arg, rng_arg)
     y = y.reshape(b, s, -1)

@@ -484,14 +484,46 @@ class InferenceEngine:
             k //= 2
         return k
 
+    @staticmethod
+    def _aot_or_jit(compiled, jit_fn):
+        """Dispatch through an AOT executable, permanently falling back to
+        the jit path the first time the executable REJECTS the inputs
+        (aval/sharding drift — should not happen with the engine's static
+        decode shapes, but a warmup must never be able to break serving).
+        Only TypeError (the input-validation error, raised before
+        execution, so no donated buffer is consumed) triggers the
+        fallback; a runtime failure mid-execution may already have
+        consumed the donated KV cache, so retrying via jit would only
+        mask the real error with 'Array has been deleted' — let it
+        propagate."""
+        state = {"aot": True}
+
+        def call(*a):
+            if state["aot"]:
+                try:
+                    return compiled(*a)
+                except TypeError as e:
+                    state["aot"] = False
+                    get_logger().warning(
+                        "AOT decode executable rejected inputs (%s); "
+                        "falling back to jit dispatch permanently", e)
+            return jit_fn(*a)
+
+        call._aot_state = state  # test hook: did dispatch stay on the AOT path?
+        call._jit_fn = jit_fn    # warmup idempotency: the lowerable fn
+        return call
+
     def warmup_decode_ladder(self) -> None:
         """Pre-compile the decode programs (single-step + every multi-step
         halving-ladder length) BEFORE traffic: a window length's first use
         otherwise stalls the live decode loop on an XLA compile at an
         unpredictable moment. AOT-lowers on abstract shapes (donation only
-        consumes avals here — no scratch KV pool is materialized); with
-        the persistent compilation cache the built binaries replay for the
-        jit dispatch path even across processes."""
+        consumes avals here — no scratch KV pool is materialized), then
+        KEEPS the compiled executables and swaps them into the dispatch
+        path: relying on the persistent compilation cache alone silently
+        does nothing when the cache is disabled (DLTI_NO_COMPILE_CACHE=1)
+        or the compile finishes under its min-compile-time floor (r04
+        advisor finding)."""
         def avals(tree):
             return jax.tree_util.tree_map(
                 lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), tree)
@@ -507,17 +539,19 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((S,), f32),
                 jax.ShapeDtypeStruct((S,), i32),
                 jax.ShapeDtypeStruct((S,), f32))
-        fns = [self._decode_fn]
+        # Idempotent: a re-warm unwraps back to the raw jit fn (the
+        # _aot_or_jit wrapper has no .lower) and rebuilds the executable.
+        raw = getattr(self._decode_fn, "_jit_fn", self._decode_fn)
+        self._decode_fn = self._aot_or_jit(raw.lower(*args).compile(), raw)
         k = self.cfg.steps_per_sync
         while k > 1:
             fn = self._multi_decode_fns.get(k)
             if fn is None:
-                fn = self._multi_decode_fns[k] = \
-                    self._build_multi_decode_fn(k)
-            fns.append(fn)
+                fn = self._build_multi_decode_fn(k)
+            raw = getattr(fn, "_jit_fn", fn)
+            self._multi_decode_fns[k] = self._aot_or_jit(
+                raw.lower(*args).compile(), raw)
             k //= 2
-        for fn in fns:
-            fn.lower(*args).compile()
 
     def _build_multi_decode_fn(self, num_steps: int):
         """K decode iterations in one program: the sampled token feeds the

@@ -29,11 +29,15 @@ def _run(env_extra, timeout=120):
     return proc, lines
 
 
-def test_unreachable_backend_fails_fast_with_json():
-    """Backend init failure -> error JSON + nonzero exit in seconds, not
-    the r03 silent 50-minute burn."""
+def test_unreachable_backend_fails_with_json_by_deadline():
+    """Backend init failure -> error JSON + nonzero exit by the deadline
+    (r05: the probe retries until DEADLINE_S - MIN_SLACK_S so a mid-window
+    relay recovery is caught; a dead backend still ends in rc=3 + JSON,
+    never the r03 silent 50-minute burn)."""
     proc, lines = _run({"JAX_PLATFORMS": "bogus",
-                        "BENCH_PROBE_TIMEOUT": "30"})
+                        "BENCH_PROBE_TIMEOUT": "30",
+                        "BENCH_DEADLINE_S": "60",
+                        "BENCH_MIN_SLACK_S": "10"})
     assert proc.returncode == 3, proc.stderr[-500:]
     assert len(lines) == 1, lines
     out = json.loads(lines[0])

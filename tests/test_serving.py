@@ -373,6 +373,37 @@ def test_multi_step_decode_matches_single_step(tiny_model_and_params):
             assert g.finish_reason == w.finish_reason
 
 
+def test_warmup_ladder_aot_dispatch_matches_cold(tiny_model_and_params):
+    """warmup_decode_ladder pre-compiles the decode ladder AND keeps the
+    AOT executables on the dispatch path (r04 advisor: lower().compile()
+    results were discarded, so with the persistent cache disabled the
+    warmup silently did nothing). Tokens must match a cold engine, and
+    the AOT path must still be live afterwards (no silent fallback)."""
+    model, params = tiny_model_and_params
+
+    def mk(steps):
+        ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=64,
+                          max_model_len=64, cache_dtype="float32",
+                          eos_token_id=-1, steps_per_sync=steps)
+        return InferenceEngine(CFG, params, ec)
+
+    prompts = [[3, 1, 4, 1, 5, 9], [2, 7, 1, 8]]
+    sp = SamplingParams(temperature=0.0, max_tokens=9)
+    want = mk(4).generate(prompts, sp)
+
+    warm = mk(4)
+    warm.warmup_decode_ladder()
+    warm.warmup_decode_ladder()  # idempotent: re-warm must not crash
+    assert hasattr(warm._decode_fn, "_aot_state")
+    got = warm.generate(prompts, sp)
+    for g, w in zip(got, want):
+        assert g.output_token_ids == w.output_token_ids
+    # Every ladder program dispatched through its compiled executable.
+    assert warm._decode_fn._aot_state["aot"]
+    for k, fn in warm._multi_decode_fns.items():
+        assert getattr(fn, "_aot_state", {"aot": True})["aot"], k
+
+
 def test_multi_step_decode_respects_stop_tokens(tiny_model_and_params):
     """A stop token hit mid-window finishes the request there; later
     window tokens are discarded."""
