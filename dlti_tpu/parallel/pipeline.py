@@ -91,23 +91,34 @@ def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
     ops, so sharding the leaves is all it takes for XLA to partition the
     largest single matmul instead of replicating it per device (r04
     advisor finding).
+
+    With ``fsdp`` > 1 (PP x ZeRO-3), each leaf additionally shards over
+    ``fsdp`` on its largest remaining divisible dim (same rule + size
+    floor as the flat ZeRO-3 path, ``sharding.param_pspec``). ``fsdp``
+    rides as a GSPMD auto axis exactly like ``tensor``: XLA all-gathers a
+    stage's layer shard at its use point inside the tick and
+    reduce-scatters grads — per-stage FSDP, so a stage holds
+    layers_per_stage/fsdp params at rest instead of a full layer shard.
     """
     tp = mesh.shape.get("tensor", 1)
+    fsdp = mesh.shape.get("fsdp", 1)
 
     def leaf(prefix, dim_shift, lead_axis):
-        """One TP-rule lookup for both layouts: stacked layers (dim_shift=1
-        for the leading 'pipe'-sharded layer dim) and top-level leaves
-        (dim_shift=0, path prefixed with the tree key so the flat rules
-        match)."""
+        """One TP/FSDP-rule lookup for both layouts: stacked layers
+        (dim_shift=1 for the leading 'pipe'-sharded layer dim) and
+        top-level leaves (dim_shift=0, path prefixed with the tree key so
+        the flat rules match)."""
         def f(path, v):
+            from dlti_tpu.parallel.sharding import (
+                _MIN_FSDP_DIM, _largest_divisible_dim, _path_str,
+                _quant_normalized_path, _tp_dim,
+            )
+
             spec = [None] * v.ndim
             if lead_axis:
                 spec[0] = lead_axis
+            tp_d = None
             if tp > 1:
-                from dlti_tpu.parallel.sharding import (
-                    _path_str, _quant_normalized_path, _tp_dim,
-                )
-
                 # int8 trees: alias {kernel}/q and {kernel}/scale to the
                 # kernel's path so quantized weights TP-shard too
                 # (scale's size-1 contraction dim auto-replicates via the
@@ -116,7 +127,13 @@ def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
                 d = _tp_dim(_quant_normalized_path(p, v))
                 if (d is not None and d + dim_shift < v.ndim
                         and v.shape[d + dim_shift] % tp == 0):
-                    spec[d + dim_shift] = "tensor"
+                    tp_d = d + dim_shift
+                    spec[tp_d] = "tensor"
+            if fsdp > 1:
+                taken = (0, tp_d) if lead_axis else (tp_d,)
+                d = _largest_divisible_dim(v.shape, fsdp, taken=taken)
+                if d is not None and v.shape[d] >= _MIN_FSDP_DIM:
+                    spec[d] = "fsdp"
             return NamedSharding(mesh, P(*spec))
         return f
 
@@ -192,27 +209,31 @@ def pipeline_forward(
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     x_mb = x.reshape(num_microbatches, mb, s, -1)
     pos_mb = positions.reshape(num_microbatches, mb, s)
-    if mesh.shape.get("data", 1) > 1:
-        # PP x DP: keep each microbatch row-sharded over 'data' (an auto
-        # axis inside the shard_map). Without the constraint the (b, s) ->
-        # (M, mb, s) reshape migrates the batch sharding onto the
-        # microbatch index M, and the tick loop's x_mb[m] gathers.
+    # PP x DP / PP x ZeRO-3: batch rows shard over 'data' and 'fsdp'
+    # (both carry batch, as in the flat batch_pspec) as auto axes inside
+    # the shard_map.
+    row_axes = tuple(a for a in ("data", "fsdp")
+                     if mesh.shape.get(a, 1) > 1) or None
+    if row_axes:
+        # Keep each microbatch row-sharded. Without the constraint the
+        # (b, s) -> (M, mb, s) reshape migrates the batch sharding onto
+        # the microbatch index M, and the tick loop's x_mb[m] gathers.
         x_mb = jax.lax.with_sharding_constraint(
-            x_mb, NamedSharding(mesh, P(None, "data", None, None)))
+            x_mb, NamedSharding(mesh, P(None, row_axes, None, None)))
         pos_mb = jax.lax.with_sharding_constraint(
-            pos_mb, NamedSharding(mesh, P(None, "data", None)))
+            pos_mb, NamedSharding(mesh, P(None, row_axes, None)))
     # Packed batches: segment ids travel with their microbatch so each
     # stage applies the same intra-doc attention mask the unpipelined
     # model would. A zero array means "one segment" (mask is a no-op) and
     # keeps the scanned stage body shape-stable either way.
     seg_mb = (segment_ids.reshape(num_microbatches, mb, s)
               if segment_ids is not None else None)
-    if seg_mb is not None and mesh.shape.get("data", 1) > 1:
+    if seg_mb is not None and row_axes:
         # Same row-sharding pin as x_mb/pos_mb above: without it the
-        # reshape migrates 'data' onto the microbatch index and every
-        # tick's seg_mb[m] gathers across the data axis.
+        # reshape migrates the batch sharding onto the microbatch index
+        # and every tick's seg_mb[m] gathers across the batch axes.
         seg_mb = jax.lax.with_sharding_constraint(
-            seg_mb, NamedSharding(mesh, P(None, "data", None)))
+            seg_mb, NamedSharding(mesh, P(None, row_axes, None)))
 
     block = LlamaBlock(cfg, lora)
 
@@ -324,12 +345,12 @@ def pipeline_forward(
     tm_arg = (token_mask.reshape(num_microbatches, mb, s)
               if (moe and token_mask is not None)
               else jnp.ones((num_microbatches, mb, s), jnp.int32))
-    if moe and token_mask is not None and mesh.shape.get("data", 1) > 1:
+    if moe and token_mask is not None and row_axes:
         # Same row-sharding pin as x_mb/pos_mb/seg_mb above: without it
-        # the (b, s) -> (M, mb, s) reshape migrates 'data' onto the
-        # microbatch index and every tick's tm_mb[m] gathers.
+        # the (b, s) -> (M, mb, s) reshape migrates the batch sharding
+        # onto the microbatch index and every tick's tm_mb[m] gathers.
         tm_arg = jax.lax.with_sharding_constraint(
-            tm_arg, NamedSharding(mesh, P(None, "data", None)))
+            tm_arg, NamedSharding(mesh, P(None, row_axes, None)))
     y, aux_vec = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg,
                               tm_arg, rng_arg)
     y = y.reshape(b, s, -1)
@@ -474,6 +495,14 @@ def make_pipeline_train_step(
         ce_mean = loss_sum / n_tok
         return objective, (ce_mean, aux_weighted / n_tok, n_tok)
 
+    # PP x ZeRO-3: pin trainable grads to the optimizer-state layout
+    # (sharded over 'fsdp') so XLA reduce-scatters instead of
+    # all-reducing — the same constraint the flat path applies in
+    # make_sharded_train_step.
+    fsdp_size = mesh.shape.get("fsdp", 1)
+    use_grad_pin = (fsdp_size > 1
+                    and int(cfg.parallel.zero_stage) >= 3)
+
     def step(state, batch, rng):
         trainable, frozen = state.trainable_and_frozen()
         loss_scale = (state.scaler["scale"] if state.scaler is not None
@@ -485,6 +514,15 @@ def make_pipeline_train_step(
 
         (_, (ce_mean, aux_mean, n_tok)), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(trainable, frozen, batch, rng)
+        if use_grad_pin:
+            from jax.sharding import NamedSharding
+
+            from dlti_tpu.parallel.sharding import _zero_opt_leaf_pspec
+
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, _zero_opt_leaf_pspec(
+                        g.shape, "fsdp", fsdp_size))), grads)
         grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         updates, new_opt = state.tx.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)

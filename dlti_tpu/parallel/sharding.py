@@ -57,6 +57,12 @@ _TP_RULES = [
 # Expert-parallel rule: stacked expert weights shard dim 0 over 'expert'.
 _EP_PATTERN = re.compile(r".*mlp/(w1|w2|w3)$")
 
+# Don't FSDP-shard tiny params (norm scales, LoRA factors with dim < 1024):
+# the all-gather latency outweighs memory savings. Shared by the flat and
+# pipeline param-sharding rules; tests monkeypatch it to exercise FSDP
+# placement on tiny models.
+_MIN_FSDP_DIM = 1024
+
 
 def _path_str(path: tuple) -> str:
     parts = []
@@ -136,9 +142,7 @@ def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
         fsdp_size = mesh.shape["fsdp"]
         if fsdp_size > 1:
             d = _largest_divisible_dim(shape, fsdp_size, taken=(tp_d, ep_d))
-            # Don't FSDP-shard tiny params (norm scales, LoRA factors with
-            # dim < 1024): the all-gather latency outweighs memory savings.
-            if d is not None and shape[d] >= 1024:
+            if d is not None and shape[d] >= _MIN_FSDP_DIM:
                 spec[d] = "fsdp"
     return P(*spec)
 

@@ -62,20 +62,30 @@ def _validate_pipeline_config(cfg: Config) -> None:
     par = cfg.parallel
     illegal = []
     # ZeRO-1 composes (optimizer state shards over 'data'; the update runs
-    # under GSPMD outside the pipeline's shard_map); ZeRO-2/3 do not —
-    # stages hold their full layer shard, and grad reduce-scatter / param
-    # gathering would fight the stacked-layer pipe sharding.
-    if int(par.zero_stage) >= 2:
-        illegal.append(f"zero_stage={int(par.zero_stage)} (stages hold "
-                       "their full layer shard; ZeRO-2/3 axes do not "
-                       "compose; zero_stage=1 does)")
+    # under GSPMD outside the pipeline's shard_map). ZeRO-3 composes as of
+    # r05: stacked leaves shard over 'fsdp' on a non-layer dim
+    # (pipeline_param_shardings), 'fsdp' rides GSPMD as an auto axis
+    # inside the pipe shard_map (per-tick all-gather at use, grads pinned
+    # to the reduce-scatter layout in make_pipeline_train_step) — the
+    # same mechanism that carried PP x TP. ZeRO-2 still does not: its
+    # grad reduce-scatter over 'data' presumes 'data'-replicated params,
+    # while the pipe layout replicates grads over 'data' only AFTER the
+    # per-tick psum; use zero_stage=1 (opt sharding) or 3 (fsdp) instead.
+    if int(par.zero_stage) == 2:
+        illegal.append("zero_stage=2 (grad reduce-scatter over 'data' "
+                       "does not compose with the pipe schedule; "
+                       "zero_stage=1 and zero_stage=3 both do)")
     # 'tensor' and 'data' compose: stage-internal TP and batch-row DP ride
     # GSPMD as auto axes inside the pipeline's shard_map (grads psum over
     # 'data' automatically; microbatches stay row-sharded via an explicit
-    # constraint in pipeline_forward) — pipe x tensor x data is full 3D.
-    for axis in ("fsdp", "sequence", "expert"):
+    # constraint in pipeline_forward) — pipe x tensor x data is full 3D,
+    # and pipe x fsdp (ZeRO-3) extends it to 4.
+    for axis in ("sequence", "expert"):
         if getattr(par, axis) > 1:
             illegal.append(f"{axis}={getattr(par, axis)}")
+    if par.fsdp > 1 and int(par.zero_stage) != 3:
+        illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
+                       "axis only carries ZeRO-3 param sharding)")
     if par.offload_optimizer or par.offload_params:
         illegal.append("host offload")
     # fp16 dynamic loss scaling composes: the pipelined step scales the
@@ -106,10 +116,11 @@ def _validate_pipeline_config(cfg: Config) -> None:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: single-host pipe x tensor x data (3D: GPipe stages, "
-            "stage-internal TP, batch-row DP) with bf16-or-int8-base LoRA "
-            "or full fine-tune, dense or MoE models, packed or padded "
-            "batches, fp16 scaler, loss_chunk, ZeRO-1, default remat")
+            "Legal: single-host pipe x tensor x data x fsdp (GPipe "
+            "stages, stage-internal TP, batch-row DP, ZeRO-3 param "
+            "sharding) with bf16-or-int8-base LoRA or full fine-tune, "
+            "dense or MoE models, packed or padded batches, fp16 scaler, "
+            "loss_chunk, ZeRO-1, default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 

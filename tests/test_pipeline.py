@@ -288,6 +288,83 @@ def test_pipe_x_tensor_matches_single_device():
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 
+def test_pipe_x_zero3_matches_single_device(monkeypatch):
+    """PP x ZeRO-3 (VERDICT r04 #4): pipe=2 x fsdp=2 — stacked leaves
+    shard over 'fsdp' on a non-layer dim, 'fsdp' riding GSPMD as an auto
+    axis inside the pipe shard_map (per-tick all-gather at use,
+    reduce-scatter grads) — reproduces the single-device step: same
+    loss, same updated LoRA params. The fsdp placement is asserted real
+    (addressable shards smaller than the leaf)."""
+    import dlti_tpu.parallel.sharding as sh_mod
+    from dlti_tpu.config import ZeROStage
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+    from dlti_tpu.parallel.sharding import opt_state_shardings
+    from dlti_tpu.training.step import make_train_step
+
+    # llama_tiny-scale dims sit under the production FSDP size floor;
+    # lower it so placement actually happens in this test.
+    monkeypatch.setattr(sh_mod, "_MIN_FSDP_DIM", 8)
+
+    par = ParallelConfig(pipe=2, fsdp=2, zero_stage=ZeROStage.ZERO3)
+    mesh = build_mesh(par)
+    assert mesh.shape["pipe"] == 2 and mesh.shape["fsdp"] == 2
+
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    model = LlamaForCausalLM(CFG, lora)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
+    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                               lora_enabled=True)
+    batch_flat = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                        CFG.vocab_size),
+        "loss_mask": jnp.ones((8, 16), jnp.int32),
+    }
+    ref_step = jax.jit(make_train_step(model, accum_steps=1))
+    ref_batch = {k: v[None] for k, v in batch_flat.items()}
+    rng = jax.random.PRNGKey(4)
+    ref_state, ref_m = ref_step(state, ref_batch, rng)
+
+    cfg = Config(model=CFG, lora=lora,
+                 optimizer=OptimizerConfig(warmup_steps=0),
+                 parallel=par,
+                 data=DataConfig(max_seq_len=16),
+                 train=TrainConfig(micro_batch_size=8, grad_accum_steps=1))
+    pstate = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                lora_enabled=True)
+    pstate = to_pipeline_state(pstate, CFG.num_layers)
+    sh = pipeline_param_shardings(pstate.params, mesh)
+    # FSDP placement really happened on a stacked frozen kernel: dim 0 is
+    # 'pipe', a later dim 'fsdp'.
+    q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
+    assert q_spec[0] == "pipe" and "fsdp" in q_spec, q_spec
+    pstate = pstate.replace(
+        params=jax.tree_util.tree_map(jax.device_put, pstate.params, sh),
+        opt_state=jax.device_put(
+            pstate.opt_state, opt_state_shardings(pstate.opt_state, cfg,
+                                                  mesh)))
+    leaf = pstate.params["layers"]["attn"]["q_proj"]["kernel"]
+    # Physical fsdp placement on its own dim (the pipe split on dim 0
+    # already makes shard != global, so check the fsdp-sharded dim
+    # specifically): with fsdp=2 the non-layer sharded dim is halved.
+    fsdp_d = q_spec.index("fsdp")
+    assert all(s.data.shape[fsdp_d] == leaf.shape[fsdp_d] // 2
+               for s in leaf.addressable_shards), (
+        f"fsdp sharding was not physically placed: "
+        f"{[s.data.shape for s in leaf.addressable_shards]}")
+    pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
+    pstate, pm = pstep(pstate, batch_flat, rng)
+
+    np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    back = from_pipeline_params(pstate.params, CFG.num_layers)
+    for layer in (0, CFG.num_layers - 1):
+        got = np.asarray(
+            back["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
+        want = np.asarray(
+            ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
 def test_pipeline_packed_matches_unpipelined(pipe_mesh):
     """Packed batches under PP: segment ids and per-doc positions ride
     each microbatch through the stages, so the pipelined step reproduces
