@@ -131,16 +131,20 @@ def main():
     ft_losses, ft_final, ft_s = run("from_pretrained", params, args.steps)
     ri_losses, ri_final, ri_s = run("random_init", None, args.contrast_steps)
 
+    scale = ("CPU SMOKE of the runner on the 300M export (NOT 7B-scale "
+             "evidence — proves the script end-to-end)" if args.cpu
+             else "consolidated trained 7B glaive export")
     art = {
-        "what": "pretrained-7B convergence semantics: consolidated trained "
-                "7B glaive export -> Trainer(base_params=...) LoRA r=16 "
-                "int8-base fine-tune on 400 HELD-OUT glaive pairs; "
-                "random-init contrast shows the pretrained base starts at "
-                "corpus loss, not cold. Reference trajectory: pretrained "
-                "Llama-2-7B 0.94 -> ~0.60-0.78 (train.ipynb:334 ff.). "
-                "Literal Llama-2 weights are unreachable offline (zero "
-                "egress), so the repo's own trained 7B stands in as the "
-                "pretrained base — same mechanism, same scale.",
+        "what": f"pretrained convergence semantics: {scale} -> "
+                "Trainer(base_params=...) LoRA r=16 "
+                f"{'' if args.cpu else 'int8-base '}fine-tune on 400 "
+                "HELD-OUT glaive pairs; random-init contrast shows the "
+                "pretrained base starts at corpus loss, not cold. "
+                "Reference trajectory: pretrained Llama-2-7B 0.94 -> "
+                "~0.60-0.78 (train.ipynb:334 ff.). Literal Llama-2 "
+                "weights are unreachable offline (zero egress), so the "
+                "repo's own trained export stands in as the pretrained "
+                "base — same mechanism.",
         "export": args.export,
         "steps": len(ft_losses),
         "micro_batch_size": bs,

@@ -495,13 +495,18 @@ def make_pipeline_train_step(
         ce_mean = loss_sum / n_tok
         return objective, (ce_mean, aux_weighted / n_tok, n_tok)
 
-    # PP x ZeRO-3: pin trainable grads to the optimizer-state layout
-    # (sharded over 'fsdp') so XLA reduce-scatters instead of
-    # all-reducing — the same constraint the flat path applies in
-    # make_sharded_train_step.
-    fsdp_size = mesh.shape.get("fsdp", 1)
-    use_grad_pin = (fsdp_size > 1
-                    and int(cfg.parallel.zero_stage) >= 3)
+    # PP x ZeRO-2/3: pin trainable grads to the optimizer-state layout
+    # (sharded over 'data' for ZeRO-2, 'fsdp' for ZeRO-3) so XLA
+    # reduce-scatters instead of all-reducing — the same constraint the
+    # flat path applies in make_sharded_train_step.
+    zstage = int(cfg.parallel.zero_stage)
+    if zstage >= 3 and mesh.shape.get("fsdp", 1) > 1:
+        pin_axis, pin_size = "fsdp", mesh.shape["fsdp"]
+    elif zstage == 2 and mesh.shape.get("data", 1) > 1:
+        pin_axis, pin_size = "data", mesh.shape["data"]
+    else:
+        pin_axis, pin_size = None, 1
+    use_grad_pin = pin_axis is not None
 
     def step(state, batch, rng):
         trainable, frozen = state.trainable_and_frozen()
@@ -522,7 +527,7 @@ def make_pipeline_train_step(
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.with_sharding_constraint(
                     g, NamedSharding(mesh, _zero_opt_leaf_pspec(
-                        g.shape, "fsdp", fsdp_size))), grads)
+                        g.shape, pin_axis, pin_size))), grads)
         grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         updates, new_opt = state.tx.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)

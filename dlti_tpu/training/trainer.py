@@ -61,20 +61,16 @@ def _validate_pipeline_config(cfg: Config) -> None:
     weak #2: PP must be reachable from the production Trainer)."""
     par = cfg.parallel
     illegal = []
-    # ZeRO-1 composes (optimizer state shards over 'data'; the update runs
-    # under GSPMD outside the pipeline's shard_map). ZeRO-3 composes as of
-    # r05: stacked leaves shard over 'fsdp' on a non-layer dim
+    # The whole ZeRO family composes as of r05. ZeRO-1: optimizer state
+    # shards over 'data'; the update runs under GSPMD outside the
+    # pipeline's shard_map. ZeRO-2: grads additionally pinned to the
+    # optimizer-state layout after the pipe step's value_and_grad
+    # (reduce-scatter over 'data' instead of all-reduce). ZeRO-3:
+    # stacked leaves shard over 'fsdp' on a non-layer dim
     # (pipeline_param_shardings), 'fsdp' rides GSPMD as an auto axis
-    # inside the pipe shard_map (per-tick all-gather at use, grads pinned
-    # to the reduce-scatter layout in make_pipeline_train_step) — the
-    # same mechanism that carried PP x TP. ZeRO-2 still does not: its
-    # grad reduce-scatter over 'data' presumes 'data'-replicated params,
-    # while the pipe layout replicates grads over 'data' only AFTER the
-    # per-tick psum; use zero_stage=1 (opt sharding) or 3 (fsdp) instead.
-    if int(par.zero_stage) == 2:
-        illegal.append("zero_stage=2 (grad reduce-scatter over 'data' "
-                       "does not compose with the pipe schedule; "
-                       "zero_stage=1 and zero_stage=3 both do)")
+    # inside the pipe shard_map (per-tick all-gather at use, grads
+    # pinned to the reduce-scatter layout) — the same mechanism that
+    # carried PP x TP.
     # 'tensor' and 'data' compose: stage-internal TP and batch-row DP ride
     # GSPMD as auto axes inside the pipeline's shard_map (grads psum over
     # 'data' automatically; microbatches stay row-sharded via an explicit
@@ -117,10 +113,10 @@ def _validate_pipeline_config(cfg: Config) -> None:
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
             "Legal: single-host pipe x tensor x data x fsdp (GPipe "
-            "stages, stage-internal TP, batch-row DP, ZeRO-3 param "
-            "sharding) with bf16-or-int8-base LoRA or full fine-tune, "
-            "dense or MoE models, packed or padded batches, fp16 scaler, "
-            "loss_chunk, ZeRO-1, default remat")
+            "stages, stage-internal TP, batch-row DP, ZeRO-1/2/3) with "
+            "bf16-or-int8-base LoRA or full fine-tune, dense or MoE "
+            "models, packed or padded batches, fp16 scaler, loss_chunk, "
+            "default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 
@@ -199,10 +195,10 @@ class Trainer:
             state = to_pipeline_state(state, self.cfg.model.num_layers)
             repl = NamedSharding(self.mesh, P())
             # opt_state_shardings is shape-based, so it applies to the
-            # stacked trainable tree unchanged: ZeRO-1 x PP shards Adam
-            # moments over 'data' (the update runs under GSPMD outside
-            # the pipeline's shard_map); every other legal pipe config
-            # (stage NONE, or data==1) falls out replicated.
+            # stacked trainable tree unchanged: ZeRO-1/2 x PP shard Adam
+            # moments over 'data', ZeRO-3 x PP over 'fsdp' (the update
+            # runs under GSPMD outside the pipeline's shard_map); stage
+            # NONE (or a size-1 axis) falls out replicated.
             from dlti_tpu.parallel.sharding import opt_state_shardings
 
             state = state.replace(

@@ -141,10 +141,17 @@ def test_trainer_rejects_illegal_pipe_compositions():
 
     bad = Config(
         model=CFG, lora=LoRAConfig(r=2, alpha=4),
-        parallel=ParallelConfig(pipe=2, zero_stage=ZeROStage.ZERO2, data=2),
+        parallel=ParallelConfig(pipe=2, sequence=2),
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad)
+    # fsdp axis without ZeRO-3 carries nothing — rejected loudly.
+    bad2 = Config(
+        model=CFG, lora=LoRAConfig(r=2, alpha=4),
+        parallel=ParallelConfig(pipe=2, fsdp=2),
+    )
+    with pytest.raises(ValueError, match="does not compose"):
+        Trainer(bad2)
 
 
 def test_pipeline_train_step_matches_single_device(pipe_mesh):
@@ -650,8 +657,9 @@ def test_pipeline_loss_chunk_matches_unchunked(pipe_mesh):
 
 
 def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
-    """ZeRO-1 x PP x DP: Adam moments shard over 'data' while the
-    trajectory matches the replicated-optimizer pipe run exactly."""
+    """ZeRO-1/2 x PP x DP: Adam moments shard over 'data' (ZeRO-2 adds
+    the grad reduce-scatter pin) while the trajectory matches the
+    replicated-optimizer pipe run exactly."""
     from dlti_tpu.config import CheckpointConfig, ZeROStage
     from dlti_tpu.data import ByteTokenizer, make_batches
     from dlti_tpu.training.trainer import Trainer
@@ -687,9 +695,12 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
 
     sharded0, loss0 = run(ZeROStage.NONE, "base")
     sharded1, loss1 = run(ZeROStage.ZERO1, "zero1")
+    sharded2, loss2 = run(ZeROStage.ZERO2, "zero2")
     assert sharded0 == 0, "baseline pipe run must replicate opt state"
     assert sharded1 > 0, "ZeRO-1 x PP must shard optimizer moments"
+    assert sharded2 > 0, "ZeRO-2 x PP must shard optimizer moments"
     np.testing.assert_allclose(loss1, loss0, rtol=1e-6)
+    np.testing.assert_allclose(loss2, loss0, rtol=1e-6)
 
 
 def test_pipeline_moe_matches_flat_grad_accum():
