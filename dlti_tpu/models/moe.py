@@ -126,13 +126,26 @@ class MoEMLP(nn.Module):
 
     def _expert_constraint(self, v: jnp.ndarray) -> jnp.ndarray:
         """Pin the expert dim to the 'expert' mesh axis (GSPMD then places
-        the all-to-all between token- and expert-sharded layouts)."""
+        the all-to-all between token- and expert-sharded layouts).
+
+        Inside a shard_map with manual axes (the PP x EP case: 'pipe' is
+        manual, 'expert' auto), a constraint built on the CONCRETE mesh
+        is rejected ("axes in vma should be Manual") — the current
+        *abstract* mesh carries the right Manual/Auto axis types, so use
+        it whenever it is active."""
         if (self.mesh is not None and "expert" in self.mesh.shape
                 and self.mesh.shape["expert"] > 1):
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            mesh = self.mesh
+            try:
+                am = jax.sharding.get_abstract_mesh()
+                if am is not None and not am.empty and "expert" in am.shape:
+                    mesh = am
+            except Exception:
+                pass
             return jax.lax.with_sharding_constraint(
-                v, NamedSharding(self.mesh, P("expert", None, None)))
+                v, NamedSharding(mesh, P("expert", None, None)))
         return v
 
 

@@ -99,41 +99,42 @@ def pipeline_param_shardings(pparams: dict, mesh: Mesh) -> dict:
     stage's layer shard at its use point inside the tick and
     reduce-scatters grads — per-stage FSDP, so a stage holds
     layers_per_stage/fsdp params at rest instead of a full layer shard.
+
+    With ``expert`` > 1 (PP x EP), stacked MoE expert weights shard over
+    ``expert`` on the expert dim (flat dim 0 -> stacked dim 1, the flat
+    ``_EP_PATTERN`` rule shifted) — expert parallelism inside each
+    pipeline stage, dispatch all-to-all inserted by GSPMD.
     """
     tp = mesh.shape.get("tensor", 1)
     fsdp = mesh.shape.get("fsdp", 1)
+    ep = mesh.shape.get("expert", 1)
 
     def leaf(prefix, dim_shift, lead_axis):
-        """One TP/FSDP-rule lookup for both layouts: stacked layers
+        """One EP/TP/FSDP-rule lookup for both layouts: stacked layers
         (dim_shift=1 for the leading 'pipe'-sharded layer dim) and
         top-level leaves (dim_shift=0, path prefixed with the tree key so
-        the flat rules match)."""
+        the flat rules match). Delegates to the ONE shared placement rule
+        (``sharding.strategy_axes``) so the flat and pipelined layouts of
+        a strategy cannot drift apart."""
         def f(path, v):
             from dlti_tpu.parallel.sharding import (
-                _MIN_FSDP_DIM, _largest_divisible_dim, _path_str,
-                _quant_normalized_path, _tp_dim,
+                _path_str, _quant_normalized_path, strategy_axes,
             )
 
             spec = [None] * v.ndim
             if lead_axis:
                 spec[0] = lead_axis
-            tp_d = None
-            if tp > 1:
-                # int8 trees: alias {kernel}/q and {kernel}/scale to the
-                # kernel's path so quantized weights TP-shard too
-                # (scale's size-1 contraction dim auto-replicates via the
-                # divisibility check below).
-                p = "/".join(x for x in (prefix, _path_str(path)) if x)
-                d = _tp_dim(_quant_normalized_path(p, v))
-                if (d is not None and d + dim_shift < v.ndim
-                        and v.shape[d + dim_shift] % tp == 0):
-                    tp_d = d + dim_shift
-                    spec[tp_d] = "tensor"
-            if fsdp > 1:
-                taken = (0, tp_d) if lead_axis else (tp_d,)
-                d = _largest_divisible_dim(v.shape, fsdp, taken=taken)
-                if d is not None and v.shape[d] >= _MIN_FSDP_DIM:
-                    spec[d] = "fsdp"
+            # int8 trees: alias {kernel}/q and {kernel}/scale to the
+            # kernel's path so quantized weights shard too (scale's
+            # size-1 contraction dim auto-replicates via the divisibility
+            # checks inside strategy_axes).
+            p = _quant_normalized_path(
+                "/".join(x for x in (prefix, _path_str(path)) if x), v)
+            for d, axis in strategy_axes(
+                    p, v.shape, ep=ep, tp=tp, fsdp=fsdp,
+                    dim_shift=dim_shift,
+                    taken=(0,) if lead_axis else ()).items():
+                spec[d] = axis
             return NamedSharding(mesh, P(*spec))
         return f
 
@@ -235,7 +236,13 @@ def pipeline_forward(
         seg_mb = jax.lax.with_sharding_constraint(
             seg_mb, NamedSharding(mesh, P(None, row_axes, None)))
 
-    block = LlamaBlock(cfg, lora)
+    # Pass the mesh: MoE's expert-dispatch constraint (moe.py
+    # _expert_constraint) pins the (E, C, h) dispatched activations to
+    # the 'expert' axis — legal inside the pipe shard_map because
+    # 'expert' stays a GSPMD auto axis there, and a no-op on dense
+    # models / expert==1 meshes. Without it, PP x EP would leave the
+    # token->expert all-to-all placement to unpinned propagation.
+    block = LlamaBlock(cfg, lora, mesh)
 
     layers_per_stage = cfg.num_layers // num_stages
 

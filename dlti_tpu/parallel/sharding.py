@@ -115,6 +115,40 @@ def _quant_normalized_path(path_s: str, value: Any) -> str:
     return path_s
 
 
+def strategy_axes(path_s: str, shape: tuple, *, ep: int = 1, tp: int = 1,
+                  fsdp: int = 1, dim_shift: int = 0,
+                  taken: tuple = ()) -> dict:
+    """THE shared EP/TP/FSDP placement rule for one (quant-normalized)
+    param leaf: returns ``{dim: axis_name}``.
+
+    ``dim_shift`` relocates the flat rules for stacked pipeline layouts
+    (a leading layer dim shifts every flat dim by +1); ``taken`` marks
+    dims already claimed (e.g. the stacked layout's 'pipe' dim 0) that
+    FSDP must not grab. Both the flat ``param_pspec`` and the pipeline's
+    ``pipeline_param_shardings`` call this one function, so the flat and
+    pipelined layouts of a given strategy cannot drift apart.
+    """
+    out: dict = {}
+    ep_d = None
+    if (ep > 1 and _EP_PATTERN.match(path_s) and dim_shift < len(shape)
+            and shape[dim_shift] % ep == 0):
+        ep_d = dim_shift  # flat expert dim 0, shifted for stacked layouts
+        out[ep_d] = "expert"
+    tp_d = None
+    if tp > 1:
+        d = _tp_dim(path_s)
+        if (d is not None and d + dim_shift < len(shape)
+                and d + dim_shift != ep_d
+                and shape[d + dim_shift] % tp == 0):
+            tp_d = d + dim_shift
+            out[tp_d] = "tensor"
+    if fsdp > 1:
+        d = _largest_divisible_dim(shape, fsdp, taken=taken + (tp_d, ep_d))
+        if d is not None and shape[d] >= _MIN_FSDP_DIM:
+            out[d] = "fsdp"
+    return out
+
+
 def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     """PartitionSpec for one param leaf under the configured strategy."""
     shape = value.shape
@@ -124,26 +158,13 @@ def param_pspec(path: tuple, value: Any, cfg: Config, mesh: Mesh) -> P:
     # {"q": int8, "scale": fp32} — rules match on the kernel's own path.
     path_s = _quant_normalized_path(_path_str(path), value)
     spec: list = [None] * len(shape)
-
-    ep_d = None
-    ep_size = mesh.shape.get("expert", 1)
-    if ep_size > 1 and _EP_PATTERN.match(path_s) and shape[0] % ep_size == 0:
-        spec[0] = "expert"
-        ep_d = 0
-
-    tp_size = mesh.shape["tensor"]
-    tp_d = _tp_dim(path_s) if tp_size > 1 else None
-    if tp_d is not None and tp_d != ep_d and shape[tp_d] % tp_size == 0:
-        spec[tp_d] = "tensor"
-    else:
-        tp_d = None
-
-    if cfg.parallel.zero_stage == ZeROStage.ZERO3:
-        fsdp_size = mesh.shape["fsdp"]
-        if fsdp_size > 1:
-            d = _largest_divisible_dim(shape, fsdp_size, taken=(tp_d, ep_d))
-            if d is not None and shape[d] >= _MIN_FSDP_DIM:
-                spec[d] = "fsdp"
+    fsdp_size = (mesh.shape["fsdp"]
+                 if cfg.parallel.zero_stage == ZeROStage.ZERO3 else 1)
+    for d, axis in strategy_axes(path_s, shape,
+                                 ep=mesh.shape.get("expert", 1),
+                                 tp=mesh.shape["tensor"],
+                                 fsdp=fsdp_size).items():
+        spec[d] = axis
     return P(*spec)
 
 

@@ -71,14 +71,17 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # inside the pipe shard_map (per-tick all-gather at use, grads
     # pinned to the reduce-scatter layout) — the same mechanism that
     # carried PP x TP.
-    # 'tensor' and 'data' compose: stage-internal TP and batch-row DP ride
-    # GSPMD as auto axes inside the pipeline's shard_map (grads psum over
-    # 'data' automatically; microbatches stay row-sharded via an explicit
-    # constraint in pipeline_forward) — pipe x tensor x data is full 3D,
-    # and pipe x fsdp (ZeRO-3) extends it to 4.
-    for axis in ("sequence", "expert"):
-        if getattr(par, axis) > 1:
-            illegal.append(f"{axis}={getattr(par, axis)}")
+    # 'tensor', 'data', 'expert' compose: stage-internal TP, batch-row
+    # DP, and expert parallelism (stacked MoE weights shard the expert
+    # dim; dispatch all-to-all via GSPMD) all ride as auto axes inside
+    # the pipeline's shard_map — pipe x tensor x data is full 3D, and
+    # pipe x fsdp (ZeRO-3) / pipe x expert extend it. Only the
+    # 'sequence' axis remains out: ring attention is its own manual
+    # shard_map over 'sequence' and cannot nest inside the pipe one.
+    if par.sequence > 1:
+        illegal.append(f"sequence={par.sequence} (ring attention is a "
+                       "manual shard_map over 'sequence'; nesting it "
+                       "inside the pipe shard_map is unsupported)")
     if par.fsdp > 1 and int(par.zero_stage) != 3:
         illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
                        "axis only carries ZeRO-3 param sharding)")
@@ -96,7 +99,7 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # (pipeline_head_matrix + chunked_causal_lm_loss).
     # MoE composes: the stage scan collects each layer's sown router
     # aux loss (edge ticks masked so fill/drain recomputes don't
-    # double-count), psum'd over 'pipe'; EP (expert axis) still doesn't.
+    # double-count), psum'd over 'pipe'; EP composes too (see above).
     # Packed sequences compose: segment ids ride each microbatch through
     # the stages (pipeline_forward segment_ids), per-doc positions included.
     if cfg.model.remat and cfg.model.remat_policy != "nothing_saveable":
@@ -112,11 +115,11 @@ def _validate_pipeline_config(cfg: Config) -> None:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: single-host pipe x tensor x data x fsdp (GPipe "
-            "stages, stage-internal TP, batch-row DP, ZeRO-1/2/3) with "
-            "bf16-or-int8-base LoRA or full fine-tune, dense or MoE "
-            "models, packed or padded batches, fp16 scaler, loss_chunk, "
-            "default remat")
+            "Legal: single-host pipe x tensor x data x fsdp x expert "
+            "(GPipe stages, stage-internal TP, batch-row DP, ZeRO-1/2/3, "
+            "expert parallelism) with bf16-or-int8-base LoRA or full "
+            "fine-tune, dense or MoE models, packed or padded batches, "
+            "fp16 scaler, loss_chunk, default remat")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 
