@@ -33,7 +33,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlti_tpu.config import Config, LoRAConfig, ModelConfig
-from dlti_tpu.models.llama import LlamaBlock, RMSNorm, _dtype
+from dlti_tpu.models.llama import LlamaBlock, RMSNorm, _dtype, _remat_policy
 from dlti_tpu.ops.rope import rope_frequencies
 
 
@@ -273,7 +273,13 @@ def pipeline_forward(
                 aux = jnp.float32(0.0)
             return out, aux
 
-        fn = jax.checkpoint(body) if cfg.remat else body
+        if cfg.remat:
+            # Same policy table as the flat path (llama._remat_policy):
+            # the int8/no-remat bench winner aside, 7B-class PP runs need
+            # dots_saveable/save_attn_out to fit activations per stage.
+            fn = jax.checkpoint(body, policy=_remat_policy(cfg.remat_policy))
+        else:
+            fn = body
         x, aux_layers = jax.lax.scan(
             fn, x, (layer_params, jnp.arange(layers_per_stage)))
         return x, jnp.sum(aux_layers)

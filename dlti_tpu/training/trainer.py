@@ -86,7 +86,10 @@ def _validate_pipeline_config(cfg: Config) -> None:
         illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
                        "axis only carries ZeRO-3 param sharding)")
     if par.offload_optimizer or par.offload_params:
-        illegal.append("host offload")
+        illegal.append("host offload (the streaming/boundary-transfer "
+                       "machinery lives in make_sharded_train_step; "
+                       "pinned_host leaves cannot enter the pipe "
+                       "shard_map as stage-sharded operands)")
     # fp16 dynamic loss scaling composes: the pipelined step scales the
     # loss, unscales grads, and evolves TrainState.scaler via the same
     # apply_loss_scaler helper the flat step uses.
@@ -102,9 +105,11 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # double-count), psum'd over 'pipe'; EP composes too (see above).
     # Packed sequences compose: segment ids ride each microbatch through
     # the stages (pipeline_forward segment_ids), per-doc positions included.
-    if cfg.model.remat and cfg.model.remat_policy != "nothing_saveable":
-        illegal.append(f"remat_policy={cfg.model.remat_policy} (the scanned "
-                       "stage body supports plain jax.checkpoint only)")
+    # Every named remat policy composes as of r05: the scanned stage body
+    # passes cfg.remat_policy through the same policy table the flat path
+    # uses (llama._remat_policy). remat_stride alone stays a warning in
+    # make_pipeline_train_step (a per-layer stride predicate is not
+    # expressible in a scan over uniform layers).
     import jax as _jax
 
     if _jax.process_count() > 1:
@@ -119,7 +124,7 @@ def _validate_pipeline_config(cfg: Config) -> None:
             "(GPipe stages, stage-internal TP, batch-row DP, ZeRO-1/2/3, "
             "expert parallelism) with bf16-or-int8-base LoRA or full "
             "fine-tune, dense or MoE models, packed or padded batches, "
-            "fp16 scaler, loss_chunk, default remat")
+            "fp16 scaler, loss_chunk, any named remat policy")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 

@@ -703,6 +703,52 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
     np.testing.assert_allclose(loss2, loss0, rtol=1e-6)
 
 
+@pytest.mark.parametrize("policy", ["nothing_saveable", "dots_saveable",
+                                    "dots_with_no_batch_dims_saveable",
+                                    "save_attn_out"])
+def test_pipeline_remat_policy_matches_no_remat(pipe_mesh, policy):
+    """Named remat policies under PP (r05): the scanned stage body
+    passes cfg.remat_policy through the flat path's policy table —
+    numerics identical to the no-remat pipelined step (remat never
+    changes values, only what the backward recomputes)."""
+    import dataclasses
+
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
+    batch_flat = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                        CFG.vocab_size),
+        "loss_mask": jnp.ones((8, 16), jnp.int32),
+    }
+    rng = jax.random.PRNGKey(4)
+
+    def run(mc):
+        model = LlamaForCausalLM(mc, lora)
+        state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                   lora_enabled=True)
+        cfg = Config(model=mc, lora=lora,
+                     optimizer=OptimizerConfig(warmup_steps=0),
+                     parallel=ParallelConfig(pipe=4),
+                     data=DataConfig(max_seq_len=16),
+                     train=TrainConfig(micro_batch_size=8,
+                                       grad_accum_steps=1))
+        pstate = to_pipeline_state(state, mc.num_layers)
+        pstep = make_pipeline_train_step(cfg, tx, pipe_mesh,
+                                         num_microbatches=4)
+        pstate, pm = pstep(pstate, batch_flat, rng)
+        back = from_pipeline_params(pstate.params, mc.num_layers)
+        return float(pm["loss"]), np.asarray(
+            back["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+
+    base_loss, base_w = run(CFG)
+    remat_loss, remat_w = run(
+        dataclasses.replace(CFG, remat=True, remat_policy=policy))
+    np.testing.assert_allclose(remat_loss, base_loss, rtol=1e-6)
+    np.testing.assert_allclose(remat_w, base_w, rtol=1e-6, atol=1e-7)
+
+
 def test_pipe_x_expert_matches_flat():
     """PP x EP: stacked MoE expert weights shard over 'expert' on the
     expert dim inside the pipe shard_map (dispatch all-to-all via GSPMD
