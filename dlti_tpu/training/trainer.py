@@ -113,18 +113,32 @@ def _validate_pipeline_config(cfg: Config) -> None:
     import jax as _jax
 
     if _jax.process_count() > 1:
-        illegal.append("multi-host meshes (per-host batch shards would be "
-                       "assembled into a 'replicated' array that differs "
-                       "across hosts)")
+        # Multi-host PP composes when the batch-row axes (data x fsdp)
+        # span the processes: rows then shard across hosts and
+        # make_global_batch assembles a consistent global array, with
+        # the pipe/tensor/expert axes process-local (mesh order is
+        # data-major). Without that, batch rows would be REPLICATED
+        # across hosts while each host feeds its own different shard —
+        # silent divergence. Proven by the 2-process 'pipe' leg in
+        # tests/test_distributed.py (data=4 x pipe=2 over 2 processes).
+        rows = par.data * par.fsdp
+        if rows % _jax.process_count() != 0:
+            illegal.append(
+                f"multi-host meshes with batch-row extent data*fsdp={rows} "
+                f"not divisible by process_count={_jax.process_count()} "
+                "(batch rows must shard across hosts; a host-replicated "
+                "batch would silently differ per host)")
     if illegal:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: single-host pipe x tensor x data x fsdp x expert "
-            "(GPipe stages, stage-internal TP, batch-row DP, ZeRO-1/2/3, "
-            "expert parallelism) with bf16-or-int8-base LoRA or full "
-            "fine-tune, dense or MoE models, packed or padded batches, "
-            "fp16 scaler, loss_chunk, any named remat policy")
+            "Legal: pipe x tensor x data x fsdp x expert (GPipe stages, "
+            "stage-internal TP, batch-row DP, ZeRO-1/2/3, expert "
+            "parallelism) with bf16-or-int8-base LoRA or full fine-tune, "
+            "dense or MoE models, packed or padded batches, fp16 scaler, "
+            "loss_chunk, any named remat policy — single-host, or "
+            "multi-host when data*fsdp divides by process_count (batch "
+            "rows shard across hosts, pipe stages process-local)")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 

@@ -18,9 +18,12 @@ batch directly — the point here is numerical equivalence of the
 distributed step, not the data schedule.
 
 Usage: ``python tests/dist_worker.py OUT_JSON [n_steps] [strategy]``
-(strategy: ``zero3`` (default) or ``tp`` — ZeRO-3 fsdp=8, or fsdp=4 x
+(strategy: ``zero3`` (default), ``tp`` — ZeRO-3 fsdp=8, or fsdp=4 x
 tensor=2 with the tensor axis spanning both processes, so TP's
-row/column-parallel collectives really cross a process boundary.)
+row/column-parallel collectives really cross a process boundary — or
+``pipe`` — data=4 x pipe=2 through the production Trainer: pipe stages
+process-local (the ICI-like placement), batch rows sharded across the
+hosts, the multi-host GPipe configuration r05 legalized.)
 """
 
 import json
@@ -56,8 +59,8 @@ def main() -> None:
     import numpy as np
 
     from dlti_tpu.config import (
-        Config, LoRAConfig, MODEL_PRESETS, OptimizerConfig, ParallelConfig,
-        TrainConfig, ZeROStage,
+        Config, DataConfig, LoRAConfig, MODEL_PRESETS, OptimizerConfig,
+        ParallelConfig, TrainConfig, ZeROStage,
     )
     from dlti_tpu.models import LlamaForCausalLM
     from dlti_tpu.parallel import (
@@ -75,22 +78,38 @@ def main() -> None:
         # with TP-sharded kernels. The pure-fsdp mode already proves
         # cross-process collectives; this mode proves the composition.
         "tp": ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4, tensor=2),
+        # data=4 x pipe=2: data-major order keeps each pipe pair
+        # process-local (the natural deployment: GPipe over ICI within a
+        # host, DP across hosts) while batch rows shard across the two
+        # processes — the multi-host pipeline configuration.
+        "pipe": ParallelConfig(data=4, pipe=2),
     }[strategy]
     cfg = Config(
         model=MODEL_PRESETS["llama_tiny"],
         lora=LoRAConfig(r=4, alpha=8, dropout=0.0),
         optimizer=OptimizerConfig(warmup_steps=2),
         parallel=parallel,
+        data=DataConfig(max_seq_len=32),
         train=TrainConfig(micro_batch_size=8, grad_accum_steps=2),
     )
     rng = jax.random.PRNGKey(0)
-    model = LlamaForCausalLM(cfg.model, cfg.lora)
-    tx = build_optimizer(cfg.optimizer)
-    state = create_train_state(rng, model, tx, (2, 32), lora_enabled=True)
-    mesh = build_mesh(cfg.parallel)
-    state = shard_train_state(state, cfg, mesh)
-    step = make_sharded_train_step(model, state, cfg, mesh, accum_steps=2,
-                                   donate=False)
+    if strategy == "pipe":
+        # The production Trainer path: init_state converts to the stacked
+        # pipe layout + shards it; _build_step routes to the GPipe step.
+        from dlti_tpu.training.trainer import Trainer
+
+        trainer = Trainer(cfg)
+        mesh = trainer.mesh
+        state = trainer.init_state(rng)
+        step = trainer._build_step(state)
+    else:
+        model = LlamaForCausalLM(cfg.model, cfg.lora)
+        tx = build_optimizer(cfg.optimizer)
+        state = create_train_state(rng, model, tx, (2, 32), lora_enabled=True)
+        mesh = build_mesh(cfg.parallel)
+        state = shard_train_state(state, cfg, mesh)
+        step = make_sharded_train_step(model, state, cfg, mesh, accum_steps=2,
+                                       donate=False)
 
     # Deterministic global batch, identical on every process AND in the
     # single-process reference run (tests/test_distributed.py).

@@ -63,7 +63,7 @@ def _reference_losses(n_steps: int):
     return losses
 
 
-@pytest.mark.parametrize("strategy", ["zero3", "tp"])
+@pytest.mark.parametrize("strategy", ["zero3", "tp", "pipe"])
 def test_two_process_mesh_matches_single_device(tmp_path, strategy):
     n_steps = 4
     out = tmp_path / "rank0.json"
