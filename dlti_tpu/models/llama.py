@@ -352,10 +352,13 @@ class LlamaModel(nn.Module):
         if cache is None:
             # Cover the actual sequence even past the preset's design
             # length: the table is computed (not learned), so extending it
-            # is exact for in-range positions — without this, positions
-            # >= max_seq_len hit jnp.take's NaN fill and training at a
-            # longer seq_len silently NaNs (caught by the r03 experiment
-            # matrix at llama_tiny seq 512 > max_seq_len 128).
+            # is exact for in-range positions. This sizing is the
+            # LOAD-BEARING invariant: apply_rope now gathers with
+            # mode="clip" (r05 — the NaN-fill bounds check cost a
+            # lax.cond per gather and broke vma typing under PP x SP), so
+            # an under-sized table no longer NaNs loudly (the r03 bug
+            # class, seq 512 > table 128) — it would silently clamp.
+            # Keep every table-sizing branch >= max(positions) + 1.
             table_len = max(cfg.max_seq_len, s)
         elif "block_tables" in cache[0]:
             # Paged: capacity = logical window = blocks/seq * block_size.

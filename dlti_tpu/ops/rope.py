@@ -30,8 +30,17 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
     orig_dtype = x.dtype
     half = x.shape[-1] // 2
     # Gather per-token tables: (batch, seq, half) -> broadcast over heads.
-    cos_p = jnp.take(cos, positions, axis=0)[:, :, None, :].astype(jnp.float32)
-    sin_p = jnp.take(sin, positions, axis=0)[:, :, None, :].astype(jnp.float32)
+    # mode="clip", not the default "fill": positions are in-range by
+    # construction (callers size the table to cover the actual sequence —
+    # models/llama.py sizes it past max_seq_len), the NaN-fill bounds
+    # check costs a lax.cond per gather, and that cond's branches type
+    # differently under nested shard_map vma checking (PP x SP: the fill
+    # branch is device-invariant while the gather branch varies over
+    # 'pipe') — clip has no cond at all.
+    cos_p = jnp.take(cos, positions, axis=0,
+                     mode="clip")[:, :, None, :].astype(jnp.float32)
+    sin_p = jnp.take(sin, positions, axis=0,
+                     mode="clip")[:, :, None, :].astype(jnp.float32)
     x = x.astype(jnp.float32)
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate(

@@ -212,29 +212,33 @@ def pipeline_forward(
     pos_mb = positions.reshape(num_microbatches, mb, s)
     # PP x DP / PP x ZeRO-3: batch rows shard over 'data' and 'fsdp'
     # (both carry batch, as in the flat batch_pspec) as auto axes inside
-    # the shard_map.
+    # the shard_map. PP x SP: the sequence dim additionally shards over
+    # 'sequence' — inside the stages, ring_attention delegates to
+    # reference_attention and GSPMD partitions it over the auto
+    # 'sequence' axis (see ring_attention's nested-delegation comment).
     row_axes = tuple(a for a in ("data", "fsdp")
                      if mesh.shape.get(a, 1) > 1) or None
-    if row_axes:
+    seq_ax = "sequence" if mesh.shape.get("sequence", 1) > 1 else None
+    if row_axes or seq_ax:
         # Keep each microbatch row-sharded. Without the constraint the
         # (b, s) -> (M, mb, s) reshape migrates the batch sharding onto
         # the microbatch index M, and the tick loop's x_mb[m] gathers.
         x_mb = jax.lax.with_sharding_constraint(
-            x_mb, NamedSharding(mesh, P(None, row_axes, None, None)))
+            x_mb, NamedSharding(mesh, P(None, row_axes, seq_ax, None)))
         pos_mb = jax.lax.with_sharding_constraint(
-            pos_mb, NamedSharding(mesh, P(None, row_axes, None)))
+            pos_mb, NamedSharding(mesh, P(None, row_axes, seq_ax)))
     # Packed batches: segment ids travel with their microbatch so each
     # stage applies the same intra-doc attention mask the unpipelined
     # model would. A zero array means "one segment" (mask is a no-op) and
     # keeps the scanned stage body shape-stable either way.
     seg_mb = (segment_ids.reshape(num_microbatches, mb, s)
               if segment_ids is not None else None)
-    if seg_mb is not None and row_axes:
+    if seg_mb is not None and (row_axes or seq_ax):
         # Same row-sharding pin as x_mb/pos_mb above: without it the
         # reshape migrates the batch sharding onto the microbatch index
         # and every tick's seg_mb[m] gathers across the batch axes.
         seg_mb = jax.lax.with_sharding_constraint(
-            seg_mb, NamedSharding(mesh, P(None, row_axes, None)))
+            seg_mb, NamedSharding(mesh, P(None, row_axes, seq_ax)))
 
     # Pass the mesh: MoE's expert-dispatch constraint (moe.py
     # _expert_constraint) pins the (E, C, h) dispatched activations to
@@ -298,6 +302,11 @@ def pipeline_forward(
         in_specs=(jax.tree_util.tree_map(lambda _: P("pipe"), pparams["layers"]),
                   P(), P(), P(), P(), P()),
         out_specs=(P(), P()),
+        # check_vma stays ON for every composition (incl. PP x SP, whose
+        # nested ring passes the checker via explicit pcasts in
+        # ring_attention_local): disabling it makes the shard_map
+        # transpose skip the psum for replicated inputs — gradients come
+        # out silently wrong.
     )
     def run_pipeline(local_layers, x_mb, pos_mb, seg_mb, tm_mb, rng):
         # Inside: one pipeline stage per device along 'pipe'.
@@ -358,12 +367,12 @@ def pipeline_forward(
     tm_arg = (token_mask.reshape(num_microbatches, mb, s)
               if (moe and token_mask is not None)
               else jnp.ones((num_microbatches, mb, s), jnp.int32))
-    if moe and token_mask is not None and row_axes:
+    if moe and token_mask is not None and (row_axes or seq_ax):
         # Same row-sharding pin as x_mb/pos_mb/seg_mb above: without it
         # the (b, s) -> (M, mb, s) reshape migrates the batch sharding
         # onto the microbatch index and every tick's tm_mb[m] gathers.
         tm_arg = jax.lax.with_sharding_constraint(
-            tm_arg, NamedSharding(mesh, P(None, row_axes, None)))
+            tm_arg, NamedSharding(mesh, P(None, row_axes, seq_ax)))
     y, aux_vec = run_pipeline(pparams["layers"], x_mb, pos_mb, seg_arg,
                               tm_arg, rng_arg)
     y = y.reshape(b, s, -1)

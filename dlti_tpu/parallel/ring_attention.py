@@ -230,6 +230,35 @@ def ring_attention(
     spec = P(batch_axes, seq_axis, h_ax, None)
     pos_spec = P(batch_axes, seq_axis)
 
+    # Inside an enclosing shard_map (PP x SP: the pipe schedule is manual
+    # over 'pipe'), a NESTED manual ring is off the table on this jax: a
+    # nested shard_map either computes silently wrong gradients
+    # (check_vma=False skips the transpose's replication psums — measured
+    # embed grads off by 17-370x) or fails verification/lowering
+    # (check_vma=True: cond-branch vma mismatches in the skip cond's
+    # transpose, then an sdy.manual_computation local-shape error).
+    # Delegate to reference_attention instead and let GSPMD partition it
+    # over the AUTO 'sequence' axis — all-gather-style sequence
+    # parallelism: activations stay sequence-sharded outside attention,
+    # XLA inserts the k/v gathers, numerics and gradients are exact by
+    # construction (no nested manual region at all). The flat path below
+    # keeps the true ring schedule.
+    # No try/except here: if a jax upgrade changes this introspection
+    # API, fail LOUD — silently assuming "not nested" would route PP x SP
+    # into the known-broken nested manual ring (wrong gradients).
+    am = jax.sharding.get_abstract_mesh()
+    nested = (am is not None and not am.empty
+              and any(ty == jax.sharding.AxisType.Manual
+                      and am.shape[name] > 1
+                      for name, ty in zip(am.axis_names, am.axis_types)))
+    if nested:
+        from dlti_tpu.ops.attention import reference_attention
+
+        return reference_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            q_positions=positions, kv_positions=positions, window=window,
+        )
+
     body = functools.partial(
         ring_attention_local, axis_name=seq_axis, axis_size=n, causal=causal,
         window=int(window or 0),

@@ -71,17 +71,21 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # inside the pipe shard_map (per-tick all-gather at use, grads
     # pinned to the reduce-scatter layout) — the same mechanism that
     # carried PP x TP.
-    # 'tensor', 'data', 'expert' compose: stage-internal TP, batch-row
-    # DP, and expert parallelism (stacked MoE weights shard the expert
-    # dim; dispatch all-to-all via GSPMD) all ride as auto axes inside
-    # the pipeline's shard_map — pipe x tensor x data is full 3D, and
-    # pipe x fsdp (ZeRO-3) / pipe x expert extend it. Only the
-    # 'sequence' axis remains out: ring attention is its own manual
-    # shard_map over 'sequence' and cannot nest inside the pipe one.
-    if par.sequence > 1:
-        illegal.append(f"sequence={par.sequence} (ring attention is a "
-                       "manual shard_map over 'sequence'; nesting it "
-                       "inside the pipe shard_map is unsupported)")
+    # 'tensor', 'data', 'expert', and 'sequence' all compose:
+    # stage-internal TP, batch-row DP, and expert parallelism ride as
+    # GSPMD auto axes inside the pipeline's shard_map; SP does too —
+    # under pipe, ring_attention DELEGATES to reference_attention and
+    # GSPMD partitions it over the auto 'sequence' axis (all-gather SP;
+    # a nested manual ring computes wrong grads or fails verification
+    # on this jax — see ring_attention's delegation comment). pipe x
+    # tensor x data is full 3D; fsdp (ZeRO-3), expert, and sequence
+    # extend it.
+    if par.sequence > 1 and cfg.train.loss_chunk:
+        # Mirror the flat-path rejection (make_sharded_train_step): the
+        # chunk reshape would regather the 'sequence'-sharded hidden.
+        illegal.append(f"sequence={par.sequence} with train.loss_chunk "
+                       "(the chunk reshape regathers the sequence-"
+                       "sharded activations; set loss_chunk=0)")
     if par.fsdp > 1 and int(par.zero_stage) != 3:
         illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
                        "axis only carries ZeRO-3 param sharding)")
@@ -132,13 +136,14 @@ def _validate_pipeline_config(cfg: Config) -> None:
         raise ValueError(
             "pipeline parallelism (parallel.pipe="
             f"{par.pipe}) does not compose with: {', '.join(illegal)}. "
-            "Legal: pipe x tensor x data x fsdp x expert (GPipe stages, "
-            "stage-internal TP, batch-row DP, ZeRO-1/2/3, expert "
-            "parallelism) with bf16-or-int8-base LoRA or full fine-tune, "
-            "dense or MoE models, packed or padded batches, fp16 scaler, "
-            "loss_chunk, any named remat policy — single-host, or "
-            "multi-host when data*fsdp divides by process_count (batch "
-            "rows shard across hosts, pipe stages process-local)")
+            "Legal: pipe x tensor x data x fsdp x sequence x expert "
+            "(GPipe stages, stage-internal TP, batch-row DP, ZeRO-1/2/3, "
+            "GSPMD-partitioned SP, expert parallelism) with "
+            "bf16-or-int8-base LoRA or full fine-tune, dense or MoE "
+            "models, packed or padded batches, fp16 scaler, loss_chunk, "
+            "any named remat policy — single-host, or multi-host when "
+            "data*fsdp divides by process_count (batch rows shard across "
+            "hosts, pipe stages process-local)")
     if cfg.train.grad_accum_steps < 1:
         raise ValueError("grad_accum_steps must be >= 1 under pipe")
 

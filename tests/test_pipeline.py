@@ -139,9 +139,12 @@ def test_trainer_rejects_illegal_pipe_compositions():
     from dlti_tpu.config import ZeROStage
     from dlti_tpu.training.trainer import Trainer
 
+    # SP composes with pipe, but not together with loss_chunk (the chunk
+    # reshape regathers the sequence-sharded hidden — flat-path parity).
     bad = Config(
         model=CFG, lora=LoRAConfig(r=2, alpha=4),
         parallel=ParallelConfig(pipe=2, sequence=2),
+        train=TrainConfig(loss_chunk=8),
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad)
@@ -152,6 +155,13 @@ def test_trainer_rejects_illegal_pipe_compositions():
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad2)
+    # Host offload remains excluded under pipe.
+    bad3 = Config(
+        model=CFG, lora=LoRAConfig(r=2, alpha=4),
+        parallel=ParallelConfig(pipe=2, data=2, offload_optimizer=True),
+    )
+    with pytest.raises(ValueError, match="does not compose"):
+        Trainer(bad3)
 
 
 def test_pipeline_train_step_matches_single_device(pipe_mesh):
@@ -747,6 +757,69 @@ def test_pipeline_remat_policy_matches_no_remat(pipe_mesh, policy):
         dataclasses.replace(CFG, remat=True, remat_policy=policy))
     np.testing.assert_allclose(remat_loss, base_loss, rtol=1e-6)
     np.testing.assert_allclose(remat_w, base_w, rtol=1e-6, atol=1e-7)
+
+
+def test_pipe_x_sequence_matches_single_device():
+    """PP x SP (the last mesh axis): under the pipe shard_map, sequence
+    parallelism delegates attention to GSPMD over the AUTO 'sequence'
+    axis (all-gather-style SP; a nested manual ring either computes
+    wrong gradients with check_vma=False or fails verification on this
+    jax — see ring_attention's nested-delegation comment). Activations
+    stay sequence-sharded via the batch pins; the pipelined train step
+    reproduces the single-device step: same loss, same updated params.
+
+    SGD, not Adam: partitioned-reduction grads differ from the flat step
+    at epsilon scale, and Adam's first step (~ +/- lr * sign) amplifies
+    that into sign flips on near-zero grads — a property of the
+    optimizer, not an error. With SGD the param delta IS the grad
+    (scaled), so the comparison is smooth."""
+    import optax
+
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+    from dlti_tpu.training.step import make_train_step
+
+    par = ParallelConfig(pipe=2, sequence=2)
+    mesh = build_mesh(par)
+    assert mesh.shape["pipe"] == 2 and mesh.shape["sequence"] == 2
+
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    tx = optax.sgd(0.1)
+    model = LlamaForCausalLM(CFG, lora)  # ref: plain attention, no mesh
+    batch_flat = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                        CFG.vocab_size),
+        "loss_mask": jnp.ones((8, 16), jnp.int32),
+    }
+    ref_batch = {k: v[None] for k, v in batch_flat.items()}
+    rng = jax.random.PRNGKey(4)
+    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                               lora_enabled=True)
+    ref_step = jax.jit(make_train_step(model, accum_steps=1))
+    ref_state, ref_m = ref_step(state, ref_batch, rng)
+
+    cfg = Config(model=CFG, lora=lora,
+                 optimizer=OptimizerConfig(warmup_steps=0),
+                 parallel=par,
+                 data=DataConfig(max_seq_len=16),
+                 train=TrainConfig(micro_batch_size=8, grad_accum_steps=1))
+    pstate = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                lora_enabled=True)
+    pstate = to_pipeline_state(pstate, CFG.num_layers)
+    pstate = pstate.replace(params=jax.tree_util.tree_map(
+        jax.device_put, pstate.params,
+        pipeline_param_shardings(pstate.params, mesh)))
+    pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
+    pstate, pm = pstep(pstate, batch_flat, rng)
+
+    np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    back = from_pipeline_params(pstate.params, CFG.num_layers)
+    for layer in (0, CFG.num_layers - 1):
+        got = np.asarray(
+            back["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
+        want = np.asarray(
+            ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 
 def test_pipe_x_expert_matches_flat():
