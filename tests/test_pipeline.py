@@ -250,82 +250,19 @@ def test_pipeline_steps_per_sync_matches(tmp_path):
                                    atol=1e-6, rtol=1e-5)
 
 
-def test_pipe_x_tensor_matches_single_device():
-    """PP x TP (VERDICT r03 #8): pipe=2 x tensor=2 — stage-internal tensor
-    sharding over a ('pipe','tensor') mesh, 'tensor' riding GSPMD inside
-    the pipeline's shard_map — reproduces the single-device step: same
-    loss, same updated LoRA params."""
-    from dlti_tpu.parallel.pipeline import to_pipeline_state
-    from dlti_tpu.training.step import make_train_step
-
-    mesh = build_mesh(ParallelConfig(pipe=2, tensor=2))
-    assert mesh.shape["pipe"] == 2 and mesh.shape["tensor"] == 2
-
-    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
-    model = LlamaForCausalLM(CFG, lora)
-    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
-    state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
-                               lora_enabled=True)
-    batch_flat = {
-        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
-                                        CFG.vocab_size),
-        "loss_mask": jnp.ones((8, 16), jnp.int32),
-    }
-    ref_step = jax.jit(make_train_step(model, accum_steps=1))
-    ref_batch = {k: v[None] for k, v in batch_flat.items()}
-    rng = jax.random.PRNGKey(4)
-    ref_state, ref_m = ref_step(state, ref_batch, rng)
-
-    cfg = Config(model=CFG, lora=lora,
-                 optimizer=OptimizerConfig(warmup_steps=0),
-                 parallel=ParallelConfig(pipe=2, tensor=2),
-                 data=DataConfig(max_seq_len=16),
-                 train=TrainConfig(micro_batch_size=8, grad_accum_steps=1))
-    pstate = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
-                                lora_enabled=True)
-    pstate = to_pipeline_state(pstate, CFG.num_layers)
-    sh = pipeline_param_shardings(pstate.params, mesh)
-    # TP placement really happened: a q_proj kernel leaf must be sharded
-    # over 'tensor' on its out dim (dim 2 with the leading layer dim).
-    q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
-    assert q_spec == jax.sharding.PartitionSpec("pipe", None, "tensor"), q_spec
-    pstate = pstate.replace(
-        params=jax.tree_util.tree_map(jax.device_put, pstate.params, sh))
-    pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
-    pstate, pm = pstep(pstate, batch_flat, rng)
-
-    np.testing.assert_allclose(float(pm["loss"]), float(ref_m["loss"]),
-                               rtol=1e-5)
-    back = from_pipeline_params(pstate.params, CFG.num_layers)
-    for layer in (0, CFG.num_layers - 1):
-        got = np.asarray(
-            back["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
-        want = np.asarray(
-            ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
-        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
-
-
-def test_pipe_x_zero3_matches_single_device(monkeypatch):
-    """PP x ZeRO-3 (VERDICT r04 #4): pipe=2 x fsdp=2 — stacked leaves
-    shard over 'fsdp' on a non-layer dim, 'fsdp' riding GSPMD as an auto
-    axis inside the pipe shard_map (per-tick all-gather at use,
-    reduce-scatter grads) — reproduces the single-device step: same
-    loss, same updated LoRA params. The fsdp placement is asserted real
-    (addressable shards smaller than the leaf)."""
-    import dlti_tpu.parallel.sharding as sh_mod
-    from dlti_tpu.config import ZeROStage
+def _run_pipe_vs_single_device(par, extra_checks=None):
+    """Shared harness for the PP-composition equivalence family: run the
+    single-device reference step and the pipelined step on ``par``'s
+    mesh with identical init/batch/rng, assert equal loss and updated
+    LoRA params. ``extra_checks(sh, pstate)`` runs after placement (for
+    spec and physical-shard assertions). Sharded optimizer state goes
+    through the production ``opt_state_shardings`` whenever ``par`` has
+    a ZeRO stage, so the composition exercises the real opt layout."""
     from dlti_tpu.parallel.pipeline import to_pipeline_state
     from dlti_tpu.parallel.sharding import opt_state_shardings
     from dlti_tpu.training.step import make_train_step
 
-    # llama_tiny-scale dims sit under the production FSDP size floor;
-    # lower it so placement actually happens in this test.
-    monkeypatch.setattr(sh_mod, "_MIN_FSDP_DIM", 8)
-
-    par = ParallelConfig(pipe=2, fsdp=2, zero_stage=ZeROStage.ZERO3)
     mesh = build_mesh(par)
-    assert mesh.shape["pipe"] == 2 and mesh.shape["fsdp"] == 2
-
     lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
     model = LlamaForCausalLM(CFG, lora)
     tx = build_optimizer(OptimizerConfig(warmup_steps=0))
@@ -350,24 +287,15 @@ def test_pipe_x_zero3_matches_single_device(monkeypatch):
                                 lora_enabled=True)
     pstate = to_pipeline_state(pstate, CFG.num_layers)
     sh = pipeline_param_shardings(pstate.params, mesh)
-    # FSDP placement really happened on a stacked frozen kernel: dim 0 is
-    # 'pipe', a later dim 'fsdp'.
-    q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
-    assert q_spec[0] == "pipe" and "fsdp" in q_spec, q_spec
-    pstate = pstate.replace(
-        params=jax.tree_util.tree_map(jax.device_put, pstate.params, sh),
-        opt_state=jax.device_put(
+    replace = {"params": jax.tree_util.tree_map(
+        jax.device_put, pstate.params, sh)}
+    if int(par.zero_stage):
+        replace["opt_state"] = jax.device_put(
             pstate.opt_state, opt_state_shardings(pstate.opt_state, cfg,
-                                                  mesh)))
-    leaf = pstate.params["layers"]["attn"]["q_proj"]["kernel"]
-    # Physical fsdp placement on its own dim (the pipe split on dim 0
-    # already makes shard != global, so check the fsdp-sharded dim
-    # specifically): with fsdp=2 the non-layer sharded dim is halved.
-    fsdp_d = q_spec.index("fsdp")
-    assert all(s.data.shape[fsdp_d] == leaf.shape[fsdp_d] // 2
-               for s in leaf.addressable_shards), (
-        f"fsdp sharding was not physically placed: "
-        f"{[s.data.shape for s in leaf.addressable_shards]}")
+                                                  mesh))
+    pstate = pstate.replace(**replace)
+    if extra_checks is not None:
+        extra_checks(sh, pstate)
     pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
     pstate, pm = pstep(pstate, batch_flat, rng)
 
@@ -380,6 +308,59 @@ def test_pipe_x_zero3_matches_single_device(monkeypatch):
         want = np.asarray(
             ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def _assert_physically_sharded(leaf, spec, axis, factor=2):
+    """The dim carrying ``axis`` in ``spec`` is really split ``factor``
+    ways across the leaf's addressable shards."""
+    d = spec.index(axis)
+    assert all(s.data.shape[d] == leaf.shape[d] // factor
+               for s in leaf.addressable_shards), (
+        axis, [s.data.shape for s in leaf.addressable_shards])
+
+
+def test_pipe_x_tensor_matches_single_device():
+    """PP x TP (VERDICT r03 #8): pipe=2 x tensor=2 — stage-internal tensor
+    sharding over a ('pipe','tensor') mesh, 'tensor' riding GSPMD inside
+    the pipeline's shard_map — reproduces the single-device step: same
+    loss, same updated LoRA params."""
+    def checks(sh, pstate):
+        # TP placement really happened: a q_proj kernel leaf must be
+        # sharded over 'tensor' on its out dim (dim 2 with the leading
+        # layer dim), and physically split.
+        q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
+        assert q_spec == jax.sharding.PartitionSpec("pipe", None, "tensor"), \
+            q_spec
+        _assert_physically_sharded(
+            pstate.params["layers"]["attn"]["q_proj"]["kernel"], q_spec,
+            "tensor")
+
+    _run_pipe_vs_single_device(ParallelConfig(pipe=2, tensor=2), checks)
+
+
+def test_pipe_x_zero3_matches_single_device(monkeypatch):
+    """PP x ZeRO-3 (VERDICT r04 #4): pipe=2 x fsdp=2 — stacked leaves
+    shard over 'fsdp' on a non-layer dim, 'fsdp' riding GSPMD as an auto
+    axis inside the pipe shard_map (per-tick all-gather at use,
+    reduce-scatter grads) — reproduces the single-device step: same
+    loss, same updated LoRA params. The fsdp placement is asserted real
+    (the fsdp-sharded dim physically halved)."""
+    import dlti_tpu.parallel.sharding as sh_mod
+    from dlti_tpu.config import ZeROStage
+
+    # llama_tiny-scale dims sit under the production FSDP size floor;
+    # lower it so placement actually happens in this test.
+    monkeypatch.setattr(sh_mod, "_MIN_FSDP_DIM", 8)
+
+    def checks(sh, pstate):
+        q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
+        assert q_spec[0] == "pipe" and "fsdp" in q_spec, q_spec
+        _assert_physically_sharded(
+            pstate.params["layers"]["attn"]["q_proj"]["kernel"], q_spec,
+            "fsdp")
+
+    _run_pipe_vs_single_device(
+        ParallelConfig(pipe=2, fsdp=2, zero_stage=ZeROStage.ZERO3), checks)
 
 
 def test_pipeline_packed_matches_unpipelined(pipe_mesh):
@@ -757,6 +738,30 @@ def test_pipeline_remat_policy_matches_no_remat(pipe_mesh, policy):
         dataclasses.replace(CFG, remat=True, remat_policy=policy))
     np.testing.assert_allclose(remat_loss, base_loss, rtol=1e-6)
     np.testing.assert_allclose(remat_w, base_w, rtol=1e-6, atol=1e-7)
+
+
+def test_pipe_x_tensor_x_zero3_matches_single_device(monkeypatch):
+    """The big three together — pipe=2 x tensor=2 x fsdp=2 (GPipe +
+    stage-internal TP + ZeRO-3 param sharding, all 8 devices): stacked
+    leaves carry P('pipe', 'fsdp', 'tensor'), BOTH inner axes physically
+    split, optimizer state through the production ZeRO-3 layout, and the
+    step reproduces the single-device step."""
+    import dlti_tpu.parallel.sharding as sh_mod
+    from dlti_tpu.config import ZeROStage
+
+    monkeypatch.setattr(sh_mod, "_MIN_FSDP_DIM", 8)
+
+    def checks(sh, pstate):
+        q_spec = sh["layers"]["attn"]["q_proj"]["kernel"].spec
+        assert (q_spec[0] == "pipe" and "tensor" in q_spec
+                and "fsdp" in q_spec), q_spec
+        leaf = pstate.params["layers"]["attn"]["q_proj"]["kernel"]
+        _assert_physically_sharded(leaf, q_spec, "tensor")
+        _assert_physically_sharded(leaf, q_spec, "fsdp")
+
+    _run_pipe_vs_single_device(
+        ParallelConfig(pipe=2, tensor=2, fsdp=2,
+                       zero_stage=ZeROStage.ZERO3), checks)
 
 
 def test_pipe_x_sequence_matches_single_device():
