@@ -8,21 +8,31 @@
 #   bash benchmarks_dev/chip_day.sh            # all stages
 #   bash benchmarks_dev/chip_day.sh A C        # just stages A, C
 #
-# Stages (r05 order = VERDICT r04 priority; C early because D/E/F need
-# the 7B export):
+# Stages (r05 order = VERDICT r04 priority; D/E use the host-built
+# random-init 7B export (benchmarks_dev/make_random_7b_export.py —
+# serving throughput is weight-value-independent, the r03 methodology)
+# so they no longer wait behind the ~2 h chip-bound retrain):
 #   A  bench.py x3 (the #1 verdict item: >=60% MFU, local verification
 #      ahead of the driver's official run)
-#   C  7B retrain (~120 steps) + host-side consolidated export
 #   D  serve 7B int8 + loadgen headline (28 slots, K=64) x5 + occupancy
 #      (budget-clamped windows fix, CPU-verified in r04, measured here)
-#   F  pretrained-7B convergence: fine-tune from the stage-C export
-#      (VERDICT r04 missing-item #2)
 #   E  int8 KV A/B at fixed HBM (bf16@20 slots vs int8@40 slots)
+#   C  7B retrain (~120 steps) + host-side consolidated export
+#   F  pretrained-7B convergence: fine-tune from the stage-C export
+#      (VERDICT r04 missing-item #2; needs the TRAINED export)
 #   B  speculation win on the trained 300M export (favorable workload)
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p results
-STAGES=${@:-A C D F E B}
+STAGES=${@:-A D E C F B}
+
+# Servable 7B export for the weight-independent stages: the trained one
+# when stage C has run, else the host-built random-init one.
+serving_export() {
+  if [ -d exports/glaive_7b_r05 ]; then echo exports/glaive_7b_r05;
+  elif [ -d exports/random_7b ]; then echo exports/random_7b;
+  else echo ""; fi
+}
 
 probe() {
   timeout 240 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
@@ -73,9 +83,15 @@ C)
   ;;
 D)
   if [ -s results/serving_headline_r05.json ]; then log "D: exists, skip"; continue; fi
-  if [ ! -d exports/glaive_7b_r05 ]; then log "D: no 7B export (run C)"; continue; fi
-  log "D: serve 7B int8 + loadgen headline x5"
-  timeout 900 python scripts/serve.py --model-dir exports/glaive_7b_r05 \
+  EXP=$(serving_export)
+  if [ -z "$EXP" ]; then log "D: no servable 7B export (run make_random_7b_export.py or C)"; continue; fi
+  log "D: serve 7B int8 ($EXP) + loadgen headline x5"
+  # Stale run files from a previous (possibly different-export)
+  # invocation must not backfill this one's aggregate.
+  rm -f results/serving_headline_r05_run*.json
+  # Server timeout covers load+compile (~5 min) + readiness wait + five
+  # loadgen runs; the stage kills it explicitly when done.
+  timeout 7200 python scripts/serve.py --model-dir "$EXP" \
       --quantization int8 --max-seqs 28 --num-blocks 910 --block-size 16 \
       --max-model-len 512 --steps-per-sync 64 --port 8077 \
       > results/serve_r05.log 2>&1 &
@@ -94,8 +110,8 @@ D)
   done
   timeout 60 curl -s http://127.0.0.1:8077/stats > results/serving_r05_stats.json
   kill $SRV 2>/dev/null
-  python - <<'PY'
-import json, statistics
+  CHIP_DAY_EXPORT="$EXP" python - <<'PY'
+import json, os, statistics
 runs = []
 for i in range(1, 6):
     try:
@@ -111,7 +127,11 @@ st = json.load(open("results/serving_r05_stats.json"))
 occ = (st.get("decode_slot_steps", 0)
        / max(1, 28 * st.get("decode_steps", 1)))
 out = {"what": "r05 serving headline with budget-clamped windows + "
-              "per-step occupancy accounting (x5, all runs reported)",
+              "per-step occupancy accounting (x5, all runs reported). "
+              "NOTE which export was served: random weights decode the "
+              "full token budget (no early EOS), trained weights may "
+              "stop early — rates are only comparable per-export.",
+       "export": os.environ.get("CHIP_DAY_EXPORT", "?"),
        "runs_tok_s": rates,
        "warm_median_tok_s": statistics.median(rates[1:]) if len(rates) > 1 else None,
        "occupancy": round(occ, 4), "stats": st}
@@ -128,9 +148,10 @@ F)
   ;;
 E)
   if [ -s results/int8_kv_ab_r05.json ]; then log "E: exists, skip"; continue; fi
-  if [ ! -d exports/glaive_7b_r05 ]; then log "E: no 7B export (run C)"; continue; fi
-  log "E: int8 KV A/B at fixed HBM (bf16@20 vs int8@40 slots)"
-  timeout 5400 python benchmarks_dev/int8_kv_ab.py --export exports/glaive_7b_r05 \
+  EXP=$(serving_export)
+  if [ -z "$EXP" ]; then log "E: no servable 7B export (run make_random_7b_export.py or C)"; continue; fi
+  log "E: int8 KV A/B at fixed HBM (bf16@20 vs int8@40 slots, $EXP)"
+  timeout 5400 python benchmarks_dev/int8_kv_ab.py --export "$EXP" \
       --json-out results/int8_kv_ab_r05.json 2>&1 | tail -3
   ;;
 B)

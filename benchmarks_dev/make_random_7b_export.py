@@ -1,0 +1,53 @@
+"""Build a servable random-init 7B export HOST-SIDE (no chip needed).
+
+Decouples the chip-day serving measurements (occupancy/headline, int8-KV
+A/B — both weight-value-independent, as the r03 methodology notes in
+``results/serving_7b_report.json``) from the ~2 h chip-bound 7B retrain:
+with this export on disk, stages D/E fire the moment the relay answers
+instead of waiting behind stage C.
+
+    python benchmarks_dev/make_random_7b_export.py [--out exports/random_7b]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+os.chdir(_repo)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="exports/random_7b")
+    ap.add_argument("--model", default="llama2_7b")
+    args = ap.parse_args()
+
+    from dlti_tpu.checkpoint.export import export_merged_model
+    from dlti_tpu.config import Config, LoRAConfig, MODEL_PRESETS
+    from dlti_tpu.models import LlamaForCausalLM
+
+    cfg = Config(model=MODEL_PRESETS[args.model],
+                 lora=LoRAConfig(enabled=False))
+    model = LlamaForCausalLM(cfg.model, None)
+    t0 = time.time()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(f"init {n/1e9:.2f}B params in {time.time()-t0:.0f}s", flush=True)
+    t0 = time.time()
+    export_merged_model(args.out, params, cfg, merge_lora=False)
+    print(f"exported to {args.out} in {time.time()-t0:.0f}s", flush=True)
+    print("EXPORT_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
