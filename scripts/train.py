@@ -4,7 +4,8 @@
 The reference ships one script per strategy (``training/train_baseline.py``,
 ``train_deepspeed_zero{1,2,3}.py``) with drifting argparse defaults
 (SURVEY.md §5.6). Here a single CLI selects the strategy with ``--preset``
-and the mesh with ``--num-devices/--tensor/--sequence``; everything else is
+and the mesh with ``--num-devices/--tensor/--sequence/--expert/--pipe``
+(`--data` sets the batch-row extent under ``--pipe``); everything else is
 the shared typed config tree.
 
 Examples:
@@ -72,14 +73,24 @@ def parse_args():
                    help="pack sequences to fill seq_len (perf option; reference pads)")
     # Mesh axes (the torchrun/deepspeed --num_gpus analog).
     p.add_argument("--num-devices", type=int, default=0,
-                   help="DP/FSDP extent; 0 = all visible devices / (tensor*sequence)")
+                   help="DP/FSDP extent; 0 = all visible devices / "
+                        "(tensor*sequence*expert)")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel extent")
     p.add_argument("--sequence", type=int, default=1,
                    help="sequence-parallel (ring attention) extent")
+    p.add_argument("--expert", type=int, default=1,
+                   help="expert-parallel extent (MoE models: experts "
+                        "shard over this axis)")
     p.add_argument("--pipe", type=int, default=1,
                    help="pipeline-parallel stages (GPipe schedule; "
                         "microbatches = --gradient-accumulation-steps). "
-                        "Does not compose with ZeRO/TP/SP — pure pipe only")
+                        "Composes with every other mesh axis: under "
+                        "--pipe, --data sets the batch-row extent "
+                        "(ZeRO presets shard over it)")
+    p.add_argument("--data", type=int, default=1,
+                   help="batch-row (DP) extent under --pipe; with a "
+                        "zero3 preset this is the FSDP extent. Ignored "
+                        "without --pipe (use --num-devices there)")
     p.add_argument("--offload-optimizer", action="store_true",
                    help="ZeRO-3 host-offload parity (ds_config_zero3.json:19-23)")
     p.add_argument("--offload-params", action="store_true",
@@ -182,36 +193,60 @@ def build_config(args):
     cfg = preset(args.preset, model=args.model)
     par = cfg.parallel
     if args.pipe > 1:
-        # Pure GPipe over the 'pipe' axis. Every flag the user passed is
-        # forwarded so Trainer._validate_pipeline_config rejects illegal
+        # GPipe over the 'pipe' axis, composing with every other mesh
+        # axis (r05): ZeRO presets shard over the --data extent (zero3:
+        # fsdp), TP/SP/EP ride GSPMD inside the stages. Every flag the
+        # user passed is forwarded so
+        # Trainer._validate_pipeline_config rejects genuinely illegal
         # combinations loudly instead of them being silently dropped.
-        if args.preset != "baseline":
+        # Batch-row extent: --data wins; else inherit the preset's own
+        # extent (zero3_8dev encodes fsdp=8, zero1_4dev data=4).
+        preset_rows = par.fsdp if int(par.zero_stage) == 3 else par.data
+        rows = args.data if args.data > 1 else max(preset_rows, 1)
+        if int(par.zero_stage) == 3 and rows == 1:
             raise SystemExit(
-                f"--pipe does not compose with --preset {args.preset} "
-                f"(ZeRO shards do not ride the pipe axis); use the "
-                f"baseline preset")
-        if args.num_devices and args.num_devices != (
-                args.pipe * args.tensor * args.sequence):
+                "--preset zero3 with --pipe needs a batch-row extent for "
+                "the FSDP axis: pass --data N or use a zero3_Ndev preset "
+                "(fsdp=1 would silently disable ZeRO-3 param sharding)")
+        data_ext, fsdp_ext = rows, 1
+        if int(par.zero_stage) == 3:
+            data_ext, fsdp_ext = 1, rows
+        mesh_n = (args.pipe * args.tensor * args.sequence * args.expert
+                  * rows)
+        if args.num_devices and args.num_devices != mesh_n:
             raise SystemExit(
                 f"--num-devices {args.num_devices} conflicts with --pipe "
-                f"{args.pipe} (a pure pipe mesh uses exactly "
-                f"pipe*tensor*sequence devices; drop --num-devices)")
-        par = par.__class__(pipe=args.pipe, tensor=args.tensor,
-                            sequence=args.sequence,
+                f"{args.pipe} (the pipe mesh uses exactly "
+                f"pipe*tensor*sequence*expert*data = {mesh_n} devices; "
+                f"drop --num-devices or fix --data)")
+        par = par.__class__(zero_stage=par.zero_stage,
+                            pipe=args.pipe, tensor=args.tensor,
+                            sequence=args.sequence, expert=args.expert,
+                            data=data_ext, fsdp=fsdp_ext,
                             offload_optimizer=args.offload_optimizer,
                             offload_params=args.offload_params)
     else:
+        if args.data > 1:
+            # Loud-reject rule: a mesh flag must never be silently
+            # dropped. Without --pipe the DP/FSDP extent is
+            # --num-devices.
+            raise SystemExit(
+                f"--data {args.data} only applies under --pipe; without "
+                f"it use --num-devices to set the DP/FSDP extent")
         n = args.num_devices or max(
-            jax.device_count() // (args.tensor * args.sequence), 1
+            jax.device_count() // (args.tensor * args.sequence
+                                   * args.expert), 1
         )
         if int(par.zero_stage) == 3:
             par = par.__class__(zero_stage=par.zero_stage, fsdp=n,
                                 tensor=args.tensor, sequence=args.sequence,
+                                expert=args.expert,
                                 offload_optimizer=args.offload_optimizer,
                                 offload_params=args.offload_params)
         else:
             par = par.__class__(zero_stage=par.zero_stage, data=n,
                                 tensor=args.tensor, sequence=args.sequence,
+                                expert=args.expert,
                                 offload_optimizer=args.offload_optimizer,
                                 offload_params=args.offload_params)
 

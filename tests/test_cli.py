@@ -83,6 +83,25 @@ def trained_csv(workdir, prepared_data):
     return csv
 
 
+def test_train_cli_pipe_composes_with_zero_preset(workdir, prepared_data):
+    """r05: --pipe composes with ZeRO presets from the CLI — --data sets
+    the batch-row extent (zero1: 'data' axis) alongside the pipe stages."""
+    proc = _run([
+        "scripts/train.py", "--preset", "zero1", "--pipe", "2",
+        "--data", "2",
+        "--model", "llama_tiny", "--tokenizer", "byte",
+        "--dataset-path", str(prepared_data),
+        "--max-steps", "2", "--max-seq-len", "64", "--lora-r", "4",
+        "--per-device-batch-size", "1",
+        "--gradient-accumulation-steps", "2", "--warmup-steps", "1",
+        "--save-strategy", "no",
+        "--metrics-csv", str(workdir / "pipe_zero1.csv"),
+        "--output-dir", str(workdir / "ckpt_pipe_zero1"),
+    ])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert (workdir / "pipe_zero1.csv").exists()
+
+
 def test_train_cli_writes_reference_schema(trained_csv):
     import pandas as pd
 
