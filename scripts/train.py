@@ -87,10 +87,11 @@ def parse_args():
                         "Composes with every other mesh axis: under "
                         "--pipe, --data sets the batch-row extent "
                         "(ZeRO presets shard over it)")
-    p.add_argument("--data", type=int, default=1,
+    p.add_argument("--data", type=int, default=None,
                    help="batch-row (DP) extent under --pipe; with a "
-                        "zero3 preset this is the FSDP extent. Ignored "
-                        "without --pipe (use --num-devices there)")
+                        "zero3 preset this is the FSDP extent; default: "
+                        "the preset's own extent. Rejected without "
+                        "--pipe (use --num-devices there)")
     p.add_argument("--offload-optimizer", action="store_true",
                    help="ZeRO-3 host-offload parity (ds_config_zero3.json:19-23)")
     p.add_argument("--offload-params", action="store_true",
@@ -199,10 +200,14 @@ def build_config(args):
         # user passed is forwarded so
         # Trainer._validate_pipeline_config rejects genuinely illegal
         # combinations loudly instead of them being silently dropped.
-        # Batch-row extent: --data wins; else inherit the preset's own
-        # extent (zero3_8dev encodes fsdp=8, zero1_4dev data=4).
+        # Batch-row extent: an EXPLICIT --data always wins (even --data 1
+        # for a pure pipe mesh — a mesh flag is never silently dropped);
+        # default inherits the preset's own extent (zero3_8dev encodes
+        # fsdp=8, zero1_4dev data=4).
         preset_rows = par.fsdp if int(par.zero_stage) == 3 else par.data
-        rows = args.data if args.data > 1 else max(preset_rows, 1)
+        rows = args.data if args.data is not None else max(preset_rows, 1)
+        if rows < 1:
+            raise SystemExit(f"--data {rows} must be >= 1")
         if int(par.zero_stage) == 3 and rows == 1:
             raise SystemExit(
                 "--preset zero3 with --pipe needs a batch-row extent for "
@@ -226,7 +231,7 @@ def build_config(args):
                             offload_optimizer=args.offload_optimizer,
                             offload_params=args.offload_params)
     else:
-        if args.data > 1:
+        if args.data is not None:
             # Loud-reject rule: a mesh flag must never be silently
             # dropped. Without --pipe the DP/FSDP extent is
             # --num-devices.

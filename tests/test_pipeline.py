@@ -363,6 +363,33 @@ def test_pipe_x_zero3_matches_single_device(monkeypatch):
         ParallelConfig(pipe=2, fsdp=2, zero_stage=ZeROStage.ZERO3), checks)
 
 
+@pytest.mark.parametrize("family,overrides", [
+    ("mistral", dict(sliding_window=6)),
+    ("qwen2", dict(attention_bias=True)),
+    ("gemma", dict(tie_embeddings=True, mlp_activation="gelu_tanh",
+                   rmsnorm_offset=True, embedding_scale=True)),
+])
+def test_pipeline_forward_model_families(pipe_mesh, family, overrides):
+    """Every family switch rides the pipelined stage body unchanged:
+    Mistral's sliding window, Qwen2's qkv bias, Gemma's (1+w) RMSNorm +
+    scaled/tied embeddings + gelu MLP — pipelined logits equal the
+    unpipelined model's."""
+    import dataclasses
+
+    fam_cfg = dataclasses.replace(CFG, **overrides)
+    model = LlamaForCausalLM(fam_cfg, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                             fam_cfg.vocab_size)
+    want, _ = model.apply({"params": params}, ids, deterministic=True)
+    pp = to_pipeline_params(params, fam_cfg.num_layers)
+    got = pipeline_forward(pp, ids, fam_cfg, pipe_mesh, num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5,
+                               err_msg=f"{family} pipelined forward diverged")
+
+
 def test_pipeline_packed_matches_unpipelined(pipe_mesh):
     """Packed batches under PP: segment ids and per-doc positions ride
     each microbatch through the stages, so the pipelined step reproduces
