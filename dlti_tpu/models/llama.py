@@ -166,7 +166,10 @@ class LlamaAttention(nn.Module):
                     k_scale=new_cache.get("k_scale"),
                     v_scale=new_cache.get("v_scale"),
                     window=cfg.sliding_window,
-                    interpret=jax.default_backend() != "tpu",
+                    # == "cpu", not != "tpu": interpret must never flip
+                    # on for a real accelerator whose backend carries a
+                    # plugin name (see ops/attention.py's flash gate).
+                    interpret=jax.default_backend() == "cpu",
                 ).astype(q.dtype)
             else:
                 ck, cv = paged_gather(new_cache, cache["block_tables"])

@@ -140,9 +140,16 @@ def multi_head_attention(
     if use_flash:
         from dlti_tpu.ops.pallas.flash_attention import flash_attention
 
+        # interpret ONLY on the cpu backend: impl="flash" then works —
+        # slowly — on the CPU test harness, so flash-path compositions
+        # (e.g. flash inside pipeline stages) are testable without a
+        # chip. Gate on == "cpu", not != "tpu": this image's relay
+        # backend is named "axon", and a != "tpu" check would silently
+        # flip the hot kernel to interpret mode on the real chip.
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             block_q=block_q, block_kv=block_kv, window=window,
+            interpret=jax.default_backend() == "cpu",
         )
     return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                                window=window)

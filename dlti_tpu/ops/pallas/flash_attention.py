@@ -44,6 +44,19 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def out_struct(shape, dtype, like):
+    """``jax.ShapeDtypeStruct`` carrying the varying-manual-axes (vma) of
+    ``like``: inside a ``check_vma`` shard_map (e.g. the pipeline
+    schedule's manual 'pipe' region) a pallas_call's out_shape must state
+    how its outputs vary across manual axes, or tracing fails with
+    "`vma` on `jax.ShapeDtypeStruct` must not be `None`". Outside any
+    shard_map, vma is empty and this is a plain struct."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _band_mask(qi, ki, block_q, block_kv, group, causal, window, seq_q,
                seq_kv):
     """Elementwise allowed-mask for the (qi, ki) tile.
@@ -291,8 +304,8 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, *, h_kv, scale, block_q, block_kv,
     return pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, group, sq, 1), jnp.float32),  # logsumexp
+            out_struct((bh, group, sq, d), q.dtype, q),
+            out_struct((bh, group, sq, 1), jnp.float32, q),  # logsumexp
         ),
         grid=grid,
         in_specs=in_specs,
@@ -516,7 +529,7 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, h_kv, scale, block_q,
                           block_kv=block_kv, group=group, causal=causal,
                           window=window, seq_q=sq, seq_kv=skv,
                           has_segs=has_segs, window_blocks=win_blocks),
-        out_shape=jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
+        out_shape=out_struct((bh, group, sq, d), q.dtype, q),
         grid=(bh, nq, win_blocks or nk),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -549,8 +562,8 @@ def _flash_bwd(q, k, v, o, lse, do, q_seg, kv_seg, *, h_kv, scale, block_q,
                           block_kv=block_kv, group=group, causal=causal,
                           window=window, seq_q=sq, seq_kv=skv,
                           has_segs=has_segs, window_q_blocks=win_q_blocks),
-        out_shape=(jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, skv, d), v.dtype)),
+        out_shape=(out_struct((bh, skv, d), k.dtype, k),
+                   out_struct((bh, skv, d), v.dtype, v)),
         grid=(bh, nk, win_q_blocks or nq),
         in_specs=in_specs_t,
         out_specs=(kv_spec_t, kv_spec_t),

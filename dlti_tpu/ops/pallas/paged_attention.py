@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dlti_tpu.ops.pallas.flash_attention import out_struct
+
 NEG_INF = -1e30
 
 
@@ -201,8 +203,7 @@ def paged_decode_attention(
                 pltpu.VMEM((kv_heads, hpg, head_dim), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, hpg, head_dim),
-                                       q.dtype),
+        out_shape=out_struct((batch, kv_heads, hpg, head_dim), q.dtype, q),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=int(2 * 2 * batch * num_heads * max_blocks * block_size

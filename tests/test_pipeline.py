@@ -390,6 +390,30 @@ def test_pipeline_forward_model_families(pipe_mesh, family, overrides):
                                err_msg=f"{family} pipelined forward diverged")
 
 
+def test_pipeline_flash_attention_matches_unpipelined(pipe_mesh):
+    """The Pallas flash path runs INSIDE pipe stages (production config
+    on chip: attention_impl auto -> flash): the kernels' out_shape now
+    carries the enclosing shard_map's varying-manual-axes, without which
+    tracing fails ("vma must not be None") — a latent chip bug for any
+    PP run with flash. Interpret mode on CPU; logits equal the
+    unpipelined flash model."""
+    import dataclasses
+
+    flash_cfg = dataclasses.replace(CFG, attention_impl="flash",
+                                    flash_block_q=16, flash_block_kv=16)
+    model = LlamaForCausalLM(flash_cfg, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                             flash_cfg.vocab_size)
+    want, _ = model.apply({"params": params}, ids, deterministic=True)
+    pp = to_pipeline_params(params, flash_cfg.num_layers)
+    got = pipeline_forward(pp, ids, flash_cfg, pipe_mesh,
+                           num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_pipeline_packed_matches_unpipelined(pipe_mesh):
     """Packed batches under PP: segment ids and per-doc positions ride
     each microbatch through the stages, so the pipelined step reproduces
