@@ -9,7 +9,8 @@ mirror the vLLM/OpenAI surface the reference's pins imply:
 * ``POST /v1/chat/completions``   — chat with the Llama-2 template the
   reference's data pipeline defines (``scripts/prepare_dataset.py:12-25``:
   ``<s>[INST] {q} [/INST] {a}</s>``)
-* ``GET /v1/models`` · ``GET /health`` · ``GET /stats``
+* ``GET /v1/models`` · ``GET /health`` · ``GET /stats`` ·
+  ``GET /metrics`` (Prometheus text exposition of the same counters)
 
 Stdlib only (``http.server`` + threads): the engine steps in one background
 thread (the TPU is a single serialized stream anyway); handler threads block
@@ -241,18 +242,40 @@ class _Handler(BaseHTTPRequestHandler):
             logprobs=bool(body.get("logprobs", False)),
         )
 
+    def _stats_dict(self) -> dict:
+        eng = self.async_engine.engine
+        return {
+            **eng.stats,
+            "active_seqs": eng.num_active,
+            "waiting": len(eng.waiting),
+            "free_blocks": eng.block_manager.num_free,
+        }
+
     # -- routes --------------------------------------------------------
     def do_GET(self):
         if self.path == "/health":
             self._json(200, {"status": "ok"})
         elif self.path == "/stats":
-            eng = self.async_engine.engine
-            self._json(200, {
-                **eng.stats,
-                "active_seqs": eng.num_active,
-                "waiting": len(eng.waiting),
-                "free_blocks": eng.block_manager.num_free,
-            })
+            self._json(200, self._stats_dict())
+        elif self.path == "/metrics":
+            # Prometheus text exposition (vLLM-parity observability): the
+            # same counters/gauges /stats serves, scrapeable by a stock
+            # Prometheus without an adapter.
+            lines = []
+            for k, v in sorted(self._stats_dict().items()):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                name = f"dlti_{k}"
+                kind = ("gauge" if k in ("active_seqs", "waiting",
+                                         "free_blocks") else "counter")
+                lines += [f"# TYPE {name} {kind}", f"{name} {v}"]
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/v1/models":
             self._json(200, {"object": "list", "data": [{
                 "id": self.cfg.model_name, "object": "model",

@@ -1,0 +1,91 @@
+"""Fast-tier pin of the pipeline placement rules (no jit, milliseconds).
+
+The full PP-composition equivalence family is slow-tier
+(tests/test_pipeline.py); this keeps the DEFAULT pre-commit gate
+covering the r05 sharding rules — one spec-level assertion per axis —
+so a placement regression cannot ship between full-suite runs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from jax.sharding import PartitionSpec as P
+
+import dlti_tpu.parallel.sharding as sh_mod
+from dlti_tpu.config import ParallelConfig, ZeROStage
+from dlti_tpu.parallel.mesh import build_mesh
+from dlti_tpu.parallel.pipeline import pipeline_param_shardings
+
+
+def _pparams():
+    return {
+        "embed_tokens": np.zeros((64, 16), np.float32),
+        "lm_head": np.zeros((16, 64), np.float32),
+        "final_norm": {"scale": np.zeros((16,), np.float32)},
+        "layers": {
+            "attn": {"q_proj": {"kernel": np.zeros((2, 16, 16), np.float32)}},
+            "mlp": {"w1": np.zeros((2, 4, 16, 32), np.float32)},
+        },
+    }
+
+
+def test_pipe_tp_fsdp_expert_specs(monkeypatch):
+    """One placement check per axis: pipe on the layer dim, tensor on the
+    TP-rule dim, fsdp on the largest free dim, expert on the (shifted)
+    expert dim, vocab rules on embed/head, norm replicated."""
+    mesh = build_mesh(ParallelConfig(pipe=2, tensor=2, fsdp=2,
+                                     zero_stage=ZeROStage.ZERO3))
+    # Production floor: tiny leaves (norm scales) stay replicated even
+    # though divisible — the all-gather latency isn't worth it.
+    sh = pipeline_param_shardings(_pparams(), mesh)
+    assert sh["final_norm"]["scale"].spec == P(None,)
+    assert "fsdp" not in sh["layers"]["attn"]["q_proj"]["kernel"].spec
+
+    # Floor lowered (test scale): every axis lands where the rule says.
+    monkeypatch.setattr(sh_mod, "_MIN_FSDP_DIM", 8)
+    sh = pipeline_param_shardings(_pparams(), mesh)
+    assert sh["layers"]["attn"]["q_proj"]["kernel"].spec == \
+        P("pipe", "fsdp", "tensor")
+    assert sh["embed_tokens"].spec[0] == "tensor"   # vocab rows
+    assert sh["lm_head"].spec[1] == "tensor"        # vocab cols
+
+
+def test_pipe_expert_spec():
+    mesh = build_mesh(ParallelConfig(pipe=2, expert=4))
+    sh = pipeline_param_shardings(_pparams(), mesh)
+    w1_spec = sh["layers"]["mlp"]["w1"].spec
+    assert w1_spec[0] == "pipe" and w1_spec[1] == "expert", w1_spec
+
+
+def test_trainer_pipe_legality_fast():
+    """The legality list's r05 shape, without building any step: every
+    mesh axis composes; offload and SP x loss_chunk stay rejected."""
+    from dlti_tpu.config import (
+        Config, LoRAConfig, ModelConfig, ParallelConfig, TrainConfig,
+    )
+    from dlti_tpu.training.trainer import _validate_pipeline_config
+
+    cfg_model = ModelConfig(vocab_size=64, hidden_size=16,
+                            intermediate_size=32, num_layers=2,
+                            num_heads=2, num_kv_heads=2, max_seq_len=16,
+                            remat=False)
+
+    def cfg_with(par, **train_kw):
+        return Config(model=cfg_model, lora=LoRAConfig(r=2, alpha=4),
+                      parallel=par, train=TrainConfig(**train_kw))
+
+    # Every axis at once passes validation.
+    _validate_pipeline_config(cfg_with(ParallelConfig(
+        pipe=2, tensor=2, data=2, sequence=2, expert=2,
+        fsdp=2, zero_stage=ZeROStage.ZERO3)))
+    # Rejections stay loud.
+    with pytest.raises(ValueError, match="does not compose"):
+        _validate_pipeline_config(cfg_with(ParallelConfig(
+            pipe=2, data=2, offload_optimizer=True)))
+    with pytest.raises(ValueError, match="does not compose"):
+        _validate_pipeline_config(cfg_with(
+            ParallelConfig(pipe=2, sequence=2), loss_chunk=8))

@@ -72,6 +72,28 @@ def test_health_models_stats(live_server):
     assert status == 200 and "free_blocks" in stats
 
 
+def test_metrics_prometheus_exposition(live_server):
+    """GET /metrics renders the /stats counters in Prometheus text
+    format (vLLM-parity observability): TYPE lines + numeric samples,
+    scrapeable without an adapter."""
+    host, port = live_server
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type", "").startswith("text/plain")
+    text = resp.read().decode()
+    conn.close()
+    assert "# TYPE dlti_free_blocks gauge" in text
+    assert "# TYPE dlti_requests counter" in text
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.split()
+        assert name.startswith("dlti_")
+        float(value)  # every sample parses as a number
+
+
 def test_completions_roundtrip(live_server):
     host, port = live_server
     status, data = _post(host, port, "/v1/completions", {
