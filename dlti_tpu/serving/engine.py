@@ -149,6 +149,12 @@ class Request:
     num_preemptions: int = 0
     # Which replica owns this request (set by ReplicatedEngine.submit).
     replica: int = 0
+    # Early-cancel flag (server stop-string matching, client disconnect):
+    # SET from any thread (a GIL-atomic bool write, the same contract as
+    # AsyncEngine.submit), CONSUMED by the stepper thread at the next
+    # token-emission walk — the slot is released there, so a cancelled
+    # request costs at most one decode window.
+    cancel_requested: bool = False
 
     @property
     def done(self) -> bool:
@@ -787,6 +793,13 @@ class InferenceEngine:
         """
         admissions: List[tuple] = []
         for slot in self.slots:
+            # Cancelled while queued (disconnect before admission): finish
+            # without ever taking a slot or prefilling.
+            while self.waiting and self.waiting[0].cancel_requested:
+                req = self.waiting.popleft()
+                req.finish_reason = "stop"
+                req.finish_time = time.monotonic()
+                self.finished.append(req)
             if not self.waiting or not slot.free:
                 continue
             req = self.waiting[0]
@@ -1226,7 +1239,12 @@ class InferenceEngine:
         self.stats["generated_tokens"] += 1
 
         reason = None
-        if token == self.cfg.eos_token_id or token in req.params.stop_token_ids:
+        if req.cancel_requested:
+            # Server-side early cancel (stop-string hit, disconnect):
+            # finish as a normal stop so usage/latency accounting and
+            # slot release follow the standard path.
+            reason = "stop"
+        elif token == self.cfg.eos_token_id or token in req.params.stop_token_ids:
             reason = "stop"
         elif len(req.output_token_ids) >= req.params.max_tokens:
             reason = "length"

@@ -225,6 +225,61 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, "invalid JSON body")
             return None
 
+    class _StopMatcher:
+        """Stateful windowed stop-string scanner shared by the stream and
+        non-stream paths (one implementation of the window arithmetic, so
+        the two cannot diverge): feed(text) -> (cut, safe) with the scan
+        window advanced past already-scanned text."""
+
+        def __init__(self, stops: tuple):
+            self.stops = stops
+            self._max = max((len(s) for s in stops), default=0)
+            self._prev = 0
+
+        def feed(self, text: str) -> tuple:
+            cut, safe = _Handler._scan_stops(
+                text, self.stops, start=self._prev - self._max + 1)
+            self._prev = len(text)
+            return cut, safe
+
+    @staticmethod
+    def _scan_stops(text: str, stops: tuple, start: int = 0) -> tuple:
+        """(cut, safe): ``cut`` is the index of the earliest stop-string
+        match (None if absent); ``safe`` is how much of ``text`` may be
+        emitted now — held back so a stop string arriving across token
+        boundaries is never partially streamed and then impossible to
+        retract (the OpenAI contract excludes the stop string from the
+        returned text). ``start`` windows the search: a caller scanning
+        per token passes the previous length minus the longest stop, so
+        the total scan work stays linear in the output length."""
+        cut = None
+        for s in stops:
+            i = text.find(s, max(0, start))
+            if i != -1 and (cut is None or i < cut):
+                cut = i
+        if cut is not None:
+            return cut, cut
+        hold = 0
+        for s in stops:
+            for k in range(1, len(s)):
+                if text.endswith(s[:k]):
+                    hold = max(hold, k)
+        return None, len(text) - hold
+
+    @staticmethod
+    def _stops_from(body: dict) -> tuple:
+        """OpenAI ``stop``: a string or list of strings (<= 4)."""
+        stop = body.get("stop")
+        if stop is None:
+            return ()
+        if isinstance(stop, str):
+            stop = [stop]
+        if (not isinstance(stop, list) or len(stop) > 4
+                or not all(isinstance(s, str) and s for s in stop)):
+            raise ValueError(
+                "stop must be a non-empty string or a list of up to 4")
+        return tuple(stop)
+
     def _params_from(self, body: dict) -> SamplingParams:
         # Every client-supplied field is cast here, before the request
         # reaches the engine stepper thread — a malformed value must fail
@@ -313,6 +368,7 @@ class _Handler(BaseHTTPRequestHandler):
         prompt_ids = tok.encode(prompt, add_bos=True)
         try:
             params = self._params_from(body)
+            stops = self._stops_from(body)
         except (TypeError, ValueError) as e:
             return self._error(400, f"invalid sampling parameter: {e}")
         max_len = self.async_engine.engine.cfg.max_model_len
@@ -330,9 +386,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(503, str(e))
 
         if body.get("stream"):
-            self._stream_response(req, q, chat, created)
+            self._stream_response(req, q, chat, created, stops)
         else:
-            self._full_response(req, q, chat, created)
+            self._full_response(req, q, chat, created, stops)
 
     def _collect(self, q: queue.Queue):
         """Yield events until done/error/timeout."""
@@ -351,19 +407,34 @@ class _Handler(BaseHTTPRequestHandler):
                 return
 
     def _full_response(self, req: Request, q: queue.Queue, chat: bool,
-                       created: int) -> None:
+                       created: int, stops: tuple = ()) -> None:
         token_ids: List[int] = []
         logprobs: List[float] = []
         finish = "stop"
+        cut = None
+        matcher = self._StopMatcher(stops)
         for ev in self._collect(q):
             if ev[0] == "token":
                 token_ids.append(ev[1])
                 logprobs.append(ev[2])
+                if stops and cut is None:
+                    # Stop STRINGS (OpenAI `stop`; token-boundary-agnostic,
+                    # so matched on detokenized text here, not in the
+                    # engine): request early cancel, keep draining until
+                    # the engine's done event so the slot release is
+                    # observed. The scan is windowed past already-scanned
+                    # text; the per-token re-decode matches the streaming
+                    # path's incremental-detokenization contract.
+                    cut, _ = matcher.feed(self.tokenizer.decode(token_ids))
+                    if cut is not None:
+                        req.cancel_requested = True
             elif ev[0] == "done":
                 finish = ev[1]
             else:
                 return self._error(500, ev[1])
         text = self.tokenizer.decode(token_ids)
+        if cut is not None:
+            text, finish = text[:cut], "stop"
         usage = {
             "prompt_tokens": len(req.prompt_token_ids),
             "completion_tokens": len(token_ids),
@@ -385,7 +456,7 @@ class _Handler(BaseHTTPRequestHandler):
         })
 
     def _stream_response(self, req: Request, q: queue.Queue, chat: bool,
-                         created: int) -> None:
+                         created: int, stops: tuple = ()) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -410,13 +481,43 @@ class _Handler(BaseHTTPRequestHandler):
                     "model": self.cfg.model_name,
                     "choices": [{"index": 0, "delta": {"role": "assistant"},
                                  "finish_reason": None}]}))
+            cancelled = False
+            matcher = self._StopMatcher(stops)
             for ev in self._collect(q):
                 if ev[0] == "token":
+                    if cancelled:
+                        # Stop already matched: drain (the engine finishes
+                        # within one decode window of the cancel flag) so
+                        # the final usage chunk reads a settled request.
+                        continue
                     token_ids.append(ev[1])
                     text = self.tokenizer.decode(token_ids)
-                    delta, emitted = text[len(emitted):], text
+                    if stops:
+                        # Stop strings: emit only up to the earliest match
+                        # (the stop string itself is never streamed), and
+                        # hold back any tail that could be the start of a
+                        # match arriving across token boundaries.
+                        cut, safe = matcher.feed(text)
+                        if cut is not None:
+                            delta = text[len(emitted):cut]
+                            emitted += delta
+                            if delta:
+                                key = "delta" if chat else "text"
+                                val = {"content": delta} if chat else delta
+                                chunk(json.dumps({
+                                    "id": req.request_id, "object": obj,
+                                    "created": created,
+                                    "model": self.cfg.model_name,
+                                    "choices": [{"index": 0, key: val,
+                                                 "finish_reason": None}]}))
+                            req.cancel_requested = True
+                            cancelled = True
+                            continue
+                        text = text[:safe]
+                    delta = text[len(emitted):]
+                    emitted += delta
                     if not delta:
-                        continue  # partial unicode; wait for more tokens
+                        continue  # partial unicode / held-back stop prefix
                     key = "delta" if chat else "text"
                     val = {"content": delta} if chat else delta
                     chunk(json.dumps({
@@ -424,7 +525,23 @@ class _Handler(BaseHTTPRequestHandler):
                         "model": self.cfg.model_name,
                         "choices": [{"index": 0, key: val, "finish_reason": None}]}))
                 elif ev[0] == "done":
-                    finish = ev[1]
+                    finish = "stop" if cancelled else ev[1]
+                    if stops and not cancelled:
+                        # Flush the held-back tail: the request ended
+                        # without a stop match, so the conservative
+                        # hold-back (a possible stop prefix) is real
+                        # output the client must still receive.
+                        tail = self.tokenizer.decode(token_ids)[len(emitted):]
+                        if tail:
+                            emitted += tail
+                            key = "delta" if chat else "text"
+                            val = {"content": tail} if chat else tail
+                            chunk(json.dumps({
+                                "id": req.request_id, "object": obj,
+                                "created": created,
+                                "model": self.cfg.model_name,
+                                "choices": [{"index": 0, key: val,
+                                             "finish_reason": None}]}))
                 else:
                     chunk(json.dumps({"error": {"message": ev[1]}}))
                     break
@@ -451,6 +568,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
+            # Early-cancel the orphaned request: without this the engine
+            # keeps burning decode windows into a queue nobody reads,
+            # up to max_tokens, while live requests wait for the slot.
+            req.cancel_requested = True
             get_logger().info("client disconnected mid-stream: %s", req.request_id)
 
 

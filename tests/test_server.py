@@ -115,6 +115,105 @@ def test_completions_deterministic_greedy(live_server):
     assert json.loads(d1)["choices"][0]["text"] == json.loads(d2)["choices"][0]["text"]
 
 
+def _pick_stop(host, port):
+    """(full_text, stop, request_body): a per-request-seeded sampled
+    completion (reproducible by the engine's seed contract) and an inner
+    2-gram whose FIRST occurrence is past index 0, so truncation is
+    non-trivial; falls back to index 0 if the tiny model's output is too
+    repetitive."""
+    base = {"prompt": "abcdef", "max_tokens": 12, "temperature": 1.0,
+            "seed": 11}
+    _, d = _post(host, port, "/v1/completions", base)
+    full = json.loads(d)["choices"][0]["text"]
+    assert len(full) >= 2, f"output too short to test stops: {full!r}"
+    stop = full[0:2]
+    for i in range(1, len(full) - 1):
+        cand = full[i:i + 2]
+        if full.find(cand) == i:
+            stop = cand
+            break
+    return full, stop, base
+
+
+def test_stop_strings_full_response(live_server):
+    """OpenAI `stop` strings (token-boundary-agnostic, matched on
+    detokenized text): the response truncates BEFORE the match, excludes
+    the stop string, reports finish_reason stop, and the engine is
+    early-cancelled instead of decoding to max_tokens."""
+    host, port = live_server
+    full, stop, base = _pick_stop(host, port)
+    _, d = _post(host, port, "/v1/completions", {**base, "stop": stop})
+    obj = json.loads(d)
+    got = obj["choices"][0]["text"]
+    assert got == full[: full.find(stop)], (full, stop, got)
+    assert stop not in got
+    assert obj["choices"][0]["finish_reason"] == "stop"
+    # invalid stop values are a 400, not a crashed stepper
+    status, d = _post(host, port, "/v1/completions",
+                      {**base, "stop": ["a", "b", "c", "d", "e"]})
+    assert status == 400
+
+
+def test_stop_strings_streaming(live_server):
+    """Streaming with `stop`: the stop string is never emitted in any
+    delta (held back across token boundaries), and the final chunk
+    carries finish_reason stop."""
+    host, port = live_server
+    full, stop, base = _pick_stop(host, port)
+
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({**base, "stop": stop, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    raw = resp.read().decode()
+    conn.close()
+    deltas, finish = [], None
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        ev = json.loads(line[len("data: "):])
+        ch = ev["choices"][0]
+        if ch.get("text"):
+            deltas.append(ch["text"])
+        if ch.get("finish_reason"):
+            finish = ch["finish_reason"]
+    text = "".join(deltas)
+    assert text == full[: full.find(stop)], (full, stop, text)
+    assert stop not in text
+    assert finish == "stop"
+
+
+def test_stop_strings_streaming_tail_flush(live_server):
+    """A stop string that never matches but whose PREFIX ends the output
+    engages the hold-back; the done-event flush must still deliver the
+    held tail so streaming equals non-streaming."""
+    host, port = live_server
+    full, _, base = _pick_stop(host, port)
+    stop = full[-1] + "\x00"  # prefix = final char; full match impossible
+
+    conn = http.client.HTTPConnection(host, port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({**base, "stop": stop, "stream": True}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    deltas, finish = [], None
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        ev = json.loads(line[len("data: "):])
+        ch = ev["choices"][0]
+        if ch.get("text"):
+            deltas.append(ch["text"])
+        if ch.get("finish_reason"):
+            finish = ch["finish_reason"]
+    assert "".join(deltas) == full, (full, deltas)
+    assert finish == "length"
+
+
 def test_chat_completions(live_server):
     host, port = live_server
     status, data = _post(host, port, "/v1/chat/completions", {
