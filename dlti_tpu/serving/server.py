@@ -24,6 +24,7 @@ import queue
 import threading
 import time
 import uuid
+import dataclasses
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -376,10 +377,37 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, f"prompt has {len(prompt_ids)} tokens; "
                                     f"max_model_len is {max_len}")
 
+        try:
+            n = int(body.get("n", 1))
+        except (TypeError, ValueError):
+            return self._error(400, "n must be an integer")
+        if not 1 <= n <= self.async_engine.engine.cfg.max_seqs:
+            return self._error(
+                400, f"n must be in [1, {self.async_engine.engine.cfg.max_seqs}]")
+        if n > 1 and body.get("stream"):
+            return self._error(400, "n > 1 does not support stream=true")
+        if n > 1 and (params.temperature == 0.0 or params.top_k == 1):
+            return self._error(
+                400, "n > 1 with deterministic sampling (temperature=0 or "
+                     "top_k=1) would return n identical choices; relax the "
+                     "sampling or drop n")
+
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
         try:
-            req, q = self.async_engine.submit(prompt_ids, params, rid)
+            if n == 1:
+                req, q = self.async_engine.submit(prompt_ids, params, rid)
+            else:
+                # n choices = n engine requests decoding CONCURRENTLY in
+                # the continuous batch (they share prefill via the prefix
+                # cache). A user seed derives per-choice seeds so the
+                # response stays reproducible without n identical samples.
+                subs = []
+                for i in range(n):
+                    p_i = params if params.seed is None else \
+                        dataclasses.replace(params, seed=params.seed + i)
+                    subs.append(self.async_engine.submit(
+                        prompt_ids, p_i, f"{rid}-{i}"))
         except ValueError as e:
             return self._error(400, str(e))
         except RuntimeError as e:  # engine parked after unrecoverable fault
@@ -387,8 +415,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         if body.get("stream"):
             self._stream_response(req, q, chat, created, stops)
-        else:
+        elif n == 1:
             self._full_response(req, q, chat, created, stops)
+        else:
+            self._multi_response(subs, rid, chat, created, stops)
 
     def _collect(self, q: queue.Queue):
         """Yield events until done/error/timeout."""
@@ -406,8 +436,17 @@ class _Handler(BaseHTTPRequestHandler):
             if ev[0] in ("done", "error"):
                 return
 
-    def _full_response(self, req: Request, q: queue.Queue, chat: bool,
-                       created: int, stops: tuple = ()) -> None:
+    def _collect_choice(self, req: Request, q: queue.Queue,
+                        stops: tuple) -> tuple:
+        """Drain one non-streaming request to completion: returns
+        ((token_ids, logprobs, text, finish), error_message) with exactly
+        one of the pair set. THE one collect/stop-scan/truncate
+        implementation for the n==1 and n>1 paths, so they cannot
+        diverge. Stop STRINGS (OpenAI `stop`; token-boundary-agnostic, so
+        matched on detokenized text here, not in the engine) request
+        early cancel and keep draining until the engine's done event so
+        the slot release is observed; the scan is windowed past
+        already-scanned text."""
         token_ids: List[int] = []
         logprobs: List[float] = []
         finish = "stop"
@@ -418,23 +457,24 @@ class _Handler(BaseHTTPRequestHandler):
                 token_ids.append(ev[1])
                 logprobs.append(ev[2])
                 if stops and cut is None:
-                    # Stop STRINGS (OpenAI `stop`; token-boundary-agnostic,
-                    # so matched on detokenized text here, not in the
-                    # engine): request early cancel, keep draining until
-                    # the engine's done event so the slot release is
-                    # observed. The scan is windowed past already-scanned
-                    # text; the per-token re-decode matches the streaming
-                    # path's incremental-detokenization contract.
                     cut, _ = matcher.feed(self.tokenizer.decode(token_ids))
                     if cut is not None:
                         req.cancel_requested = True
             elif ev[0] == "done":
                 finish = ev[1]
             else:
-                return self._error(500, ev[1])
+                return None, ev[1]
         text = self.tokenizer.decode(token_ids)
         if cut is not None:
             text, finish = text[:cut], "stop"
+        return (token_ids, logprobs, text, finish), None
+
+    def _full_response(self, req: Request, q: queue.Queue, chat: bool,
+                       created: int, stops: tuple = ()) -> None:
+        got, err = self._collect_choice(req, q, stops)
+        if err is not None:
+            return self._error(500, err)
+        token_ids, logprobs, text, finish = got
         usage = {
             "prompt_tokens": len(req.prompt_token_ids),
             "completion_tokens": len(token_ids),
@@ -453,6 +493,47 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200, {
             "id": req.request_id, "object": obj, "created": created,
             "model": self.cfg.model_name, "choices": [choice], "usage": usage,
+        })
+
+    def _multi_response(self, subs: list, rid: str, chat: bool,
+                        created: int, stops: tuple = ()) -> None:
+        """OpenAI ``n`` > 1: the n requests decode concurrently in the
+        continuous batch (submitted before this runs); collect each in
+        turn — later queues buffer while earlier ones drain."""
+        choices = []
+        total_completion = 0
+        prompt_tokens = len(subs[0][0].prompt_token_ids)
+        for i, (req, q) in enumerate(subs):
+            got, err = self._collect_choice(req, q, stops)
+            if err is not None:
+                # One choice failed/timed out: early-cancel every other
+                # still-running choice before erroring — without this the
+                # remaining n-1 requests decode to max_tokens into queues
+                # nobody reads (the orphan-burn disconnect-cancel exists
+                # to prevent).
+                for other, _ in subs:
+                    other.cancel_requested = True
+                return self._error(500, err)
+            token_ids, logprobs, text, finish = got
+            total_completion += len(token_ids)
+            if chat:
+                choice = {"index": i,
+                          "message": {"role": "assistant", "content": text},
+                          "finish_reason": finish}
+            else:
+                choice = {"index": i, "text": text, "finish_reason": finish}
+            if req.params.logprobs:
+                choice["logprobs"] = {"token_logprobs": logprobs,
+                                      "tokens": token_ids}
+            choices.append(choice)
+        self._json(200, {
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created, "model": self.cfg.model_name,
+            "choices": choices,
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": total_completion,
+                      "total_tokens": prompt_tokens + total_completion},
         })
 
     def _stream_response(self, req: Request, q: queue.Queue, chat: bool,

@@ -214,6 +214,34 @@ def test_stop_strings_streaming_tail_flush(live_server):
     assert finish == "length"
 
 
+def test_n_choices(live_server):
+    """OpenAI `n`: n concurrent engine requests -> n indexed choices;
+    a user seed derives per-choice seeds so choices differ but the whole
+    response reproduces; guards reject stream+n and greedy+n."""
+    host, port = live_server
+    body = {"prompt": "abcdef", "max_tokens": 6, "temperature": 1.0,
+            "seed": 3, "n": 3}
+    status, d = _post(host, port, "/v1/completions", body)
+    assert status == 200, d
+    obj = json.loads(d)
+    texts = [c["text"] for c in sorted(obj["choices"],
+                                       key=lambda c: c["index"])]
+    assert len(texts) == 3
+    assert len(set(texts)) > 1, "per-choice seeds produced identical samples"
+    assert obj["usage"]["completion_tokens"] == 18
+    # Reproducible end to end.
+    _, d2 = _post(host, port, "/v1/completions", body)
+    assert [c["text"] for c in sorted(json.loads(d2)["choices"],
+                                      key=lambda c: c["index"])] == texts
+    # Loud rejections.
+    status, _ = _post(host, port, "/v1/completions",
+                      {**body, "stream": True})
+    assert status == 400
+    status, _ = _post(host, port, "/v1/completions",
+                      {**body, "temperature": 0.0})
+    assert status == 400
+
+
 def test_chat_completions(live_server):
     host, port = live_server
     status, data = _post(host, port, "/v1/chat/completions", {
