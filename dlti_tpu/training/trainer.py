@@ -89,11 +89,16 @@ def _validate_pipeline_config(cfg: Config) -> None:
     if par.fsdp > 1 and int(par.zero_stage) != 3:
         illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
                        "axis only carries ZeRO-3 param sharding)")
-    if par.offload_optimizer or par.offload_params:
-        illegal.append("host offload (the streaming/boundary-transfer "
-                       "machinery lives in make_sharded_train_step; "
-                       "pinned_host leaves cannot enter the pipe "
-                       "shard_map as stage-sharded operands)")
+    # offload_optimizer composes (r05): moments rest in pinned host
+    # memory and cross at step boundaries, the flat path's fallback
+    # pattern — see _build_step. offload_params does not: frozen params
+    # enter the pipe shard_map as stage-sharded operands, and pinned_host
+    # leaves cannot (the in-step streaming machinery lives in
+    # make_sharded_train_step's flat layout).
+    if par.offload_params:
+        illegal.append("offload_params (pinned_host leaves cannot enter "
+                       "the pipe shard_map as stage-sharded operands; "
+                       "offload_optimizer DOES compose)")
     # fp16 dynamic loss scaling composes: the pipelined step scales the
     # loss, unscales grads, and evolves TrainState.scaler via the same
     # apply_loss_scaler helper the flat step uses.
@@ -266,6 +271,32 @@ class Trainer:
                 flat = {k: v.reshape((-1,) + v.shape[2:])
                         for k, v in batch.items()}
                 return pipe_step(state, flat, rng)
+
+            if self.cfg.parallel.offload_optimizer:
+                # PP x optimizer host-offload: Adam moments REST in
+                # pinned host memory (opt_state_shardings carries the
+                # memory kind) and cross at step boundaries — the same
+                # fallback transfer the flat path uses when only the
+                # optimizer is offloaded. Peak HBM holds moments only
+                # for the step's duration.
+                from jax.sharding import NamedSharding
+
+                from dlti_tpu.parallel.sharding import opt_state_shardings
+
+                opt_host = opt_state_shardings(state.opt_state, self.cfg,
+                                               self.mesh)
+                opt_dev = jax.tree_util.tree_map(
+                    lambda s: (NamedSharding(self.mesh, s.spec)
+                               if isinstance(s, NamedSharding) else s),
+                    opt_host)
+                inner = step_fn
+
+                def step_fn(state, batch, rng):
+                    state = state.replace(opt_state=jax.device_put(
+                        state.opt_state, opt_dev))
+                    new_state, m = inner(state, batch, rng)
+                    return new_state.replace(opt_state=jax.device_put(
+                        new_state.opt_state, opt_host)), m
 
             return step_fn
         if self.mesh is not None:

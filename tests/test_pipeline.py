@@ -155,10 +155,11 @@ def test_trainer_rejects_illegal_pipe_compositions():
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad2)
-    # Host offload remains excluded under pipe.
+    # Param offload remains excluded under pipe (optimizer offload
+    # composes as of r05).
     bad3 = Config(
         model=CFG, lora=LoRAConfig(r=2, alpha=4),
-        parallel=ParallelConfig(pipe=2, data=2, offload_optimizer=True),
+        parallel=ParallelConfig(pipe=2, data=2, offload_params=True),
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad3)
@@ -706,12 +707,13 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
     from dlti_tpu.data import ByteTokenizer, make_batches
     from dlti_tpu.training.trainer import Trainer
 
-    def run(zero_stage, tag):
+    def run(zero_stage, tag, offload=False):
         cfg = Config(
             model=CFG,
             lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
             optimizer=OptimizerConfig(warmup_steps=2),
-            parallel=ParallelConfig(pipe=2, data=2, zero_stage=zero_stage),
+            parallel=ParallelConfig(pipe=2, data=2, zero_stage=zero_stage,
+                                    offload_optimizer=offload),
             data=DataConfig(max_seq_len=32, tokenizer="byte"),
             checkpoint=CheckpointConfig(output_dir=str(tmp_path / tag),
                                         save_strategy="no"),
@@ -727,22 +729,34 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
         trainer = Trainer(cfg)
         state = trainer.init_state()
         sharded = 0
+        on_host = 0
         for leaf in jax.tree_util.tree_leaves(state.opt_state):
             if hasattr(leaf, "addressable_shards") and leaf.ndim >= 1:
                 if any(s.data.shape != leaf.shape
                        for s in leaf.addressable_shards):
                     sharded += 1
+                if getattr(leaf.sharding, "memory_kind", None) == \
+                        "pinned_host":
+                    on_host += 1
         state, record = trainer.train(dataset=ds)
-        return sharded, record.final_loss
+        return sharded, on_host, record.final_loss
 
-    sharded0, loss0 = run(ZeROStage.NONE, "base")
-    sharded1, loss1 = run(ZeROStage.ZERO1, "zero1")
-    sharded2, loss2 = run(ZeROStage.ZERO2, "zero2")
+    sharded0, host0, loss0 = run(ZeROStage.NONE, "base")
+    sharded1, host1, loss1 = run(ZeROStage.ZERO1, "zero1")
+    sharded2, host2, loss2 = run(ZeROStage.ZERO2, "zero2")
     assert sharded0 == 0, "baseline pipe run must replicate opt state"
     assert sharded1 > 0, "ZeRO-1 x PP must shard optimizer moments"
     assert sharded2 > 0, "ZeRO-2 x PP must shard optimizer moments"
+    assert host0 == host1 == host2 == 0
     np.testing.assert_allclose(loss1, loss0, rtol=1e-6)
     np.testing.assert_allclose(loss2, loss0, rtol=1e-6)
+    # PP x optimizer host-offload (r05): moments REST in pinned host
+    # memory, cross at step boundaries, trajectory unchanged.
+    shardedo, hosto, losso = run(ZeROStage.ZERO1, "zero1_offload",
+                                 offload=True)
+    assert shardedo > 0
+    assert hosto > 0, "offload_optimizer x PP must place moments on host"
+    np.testing.assert_allclose(losso, loss0, rtol=1e-6)
 
 
 @pytest.mark.parametrize("policy", ["nothing_saveable", "dots_saveable",

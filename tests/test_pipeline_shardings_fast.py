@@ -63,7 +63,8 @@ def test_pipe_expert_spec():
 
 def test_trainer_pipe_legality_fast():
     """The legality list's r05 shape, without building any step: every
-    mesh axis composes; offload and SP x loss_chunk stay rejected."""
+    mesh axis composes; param offload and SP x loss_chunk stay
+    rejected."""
     from dlti_tpu.config import (
         Config, LoRAConfig, ModelConfig, ParallelConfig, TrainConfig,
     )
@@ -85,7 +86,7 @@ def test_trainer_pipe_legality_fast():
     # Rejections stay loud.
     with pytest.raises(ValueError, match="does not compose"):
         _validate_pipeline_config(cfg_with(ParallelConfig(
-            pipe=2, data=2, offload_optimizer=True)))
+            pipe=2, data=2, offload_params=True)))
     with pytest.raises(ValueError, match="does not compose"):
         _validate_pipeline_config(cfg_with(
             ParallelConfig(pipe=2, sequence=2), loss_chunk=8))
