@@ -428,6 +428,69 @@ def make_sharded_train_step(
     return step_with_offload
 
 
+def wrap_boundary_offload(step_fn, state, mesh: Mesh, lora_enabled: bool):
+    """Step-boundary host-offload fallback for layouts that cannot
+    stream in-step (the pipe path; flat layouts use
+    ``make_sharded_train_step``'s own wrapper): derive host/device
+    shardings from the PLACED state, move offloaded leaves HBM-ward for
+    the step's duration, splice the still-valid host frozen-param copies
+    back after (they never change — half the DMA traffic for LoRA).
+
+    Returns ``step_fn`` unchanged when nothing actually rests in host
+    memory (backend without pinned_host, or offload disabled): wrapping
+    anyway would splice back frozen buffers the step's donation already
+    invalidated ("Array has been deleted" on step 2).
+    """
+    from dlti_tpu.training.state import combine_params, partition_params
+
+    def shardings(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.sharding if hasattr(x, "sharding") else x, tree)
+
+    opt_host = shardings(state.opt_state)
+    par_host = shardings(state.params)
+
+    def on_host(tree):
+        return any(getattr(s, "memory_kind", None) == "pinned_host"
+                   for s in jax.tree_util.tree_leaves(tree)
+                   if isinstance(s, NamedSharding))
+
+    params_offloaded = on_host(par_host)
+    if not params_offloaded and not on_host(opt_host):
+        return step_fn
+
+    def dev(tree):
+        return jax.tree_util.tree_map(
+            lambda s: (NamedSharding(mesh, s.spec)
+                       if isinstance(s, NamedSharding) else s), tree)
+
+    opt_dev, par_dev = dev(opt_host), dev(par_host)
+
+    def wrapped(st, batch, rng):
+        host_state = st
+        st = st.replace(
+            opt_state=jax.device_put(st.opt_state, opt_dev),
+            params=jax.device_put(st.params, par_dev),
+        )
+        new_state, m = step_fn(st, batch, rng)
+        new_params = new_state.params
+        if params_offloaded:
+            t_new, _ = partition_params(new_params, lora_enabled)
+            _, f_host = partition_params(host_state.params, lora_enabled)
+            new_params = combine_params(t_new, f_host)
+        return new_state.replace(
+            opt_state=jax.device_put(new_state.opt_state, opt_host),
+            params=new_params,
+        ), m
+
+    if params_offloaded:
+        # The device param shardings double as the eval-side shim input
+        # (eval feeds params into the same pipe shard_map, which cannot
+        # take pinned_host stage-sharded operands).
+        wrapped.params_dev_shardings = par_dev
+    return wrapped
+
+
 _HOST_COMPUTE_PROBE_CACHE: dict = {}
 
 

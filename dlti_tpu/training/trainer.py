@@ -89,16 +89,16 @@ def _validate_pipeline_config(cfg: Config) -> None:
     if par.fsdp > 1 and int(par.zero_stage) != 3:
         illegal.append(f"fsdp={par.fsdp} without zero_stage=3 (the fsdp "
                        "axis only carries ZeRO-3 param sharding)")
-    # offload_optimizer composes (r05): moments rest in pinned host
-    # memory and cross at step boundaries, the flat path's fallback
-    # pattern — see _build_step. offload_params does not: frozen params
-    # enter the pipe shard_map as stage-sharded operands, and pinned_host
-    # leaves cannot (the in-step streaming machinery lives in
-    # make_sharded_train_step's flat layout).
-    if par.offload_params:
-        illegal.append("offload_params (pinned_host leaves cannot enter "
-                       "the pipe shard_map as stage-sharded operands; "
-                       "offload_optimizer DOES compose)")
+    # Host offload composes (r05) in boundary-transfer mode — the flat
+    # path's fallback semantics: offloaded leaves (optimizer moments
+    # and/or the frozen base) rest in pinned host memory between steps
+    # and cross at step boundaries (_build_step). In-step per-layer
+    # STREAMING stays flat-only (pinned_host operands cannot enter the
+    # pipe shard_map stage-sharded). offload_params needs LoRA: it
+    # offloads the frozen base, and a full fine-tune has none.
+    if par.offload_params and not cfg.lora.enabled:
+        illegal.append("offload_params without LoRA (it offloads the "
+                       "frozen base params; a full fine-tune has none)")
     # fp16 dynamic loss scaling composes: the pipelined step scales the
     # loss, unscales grads, and evolves TrainState.scaler via the same
     # apply_loss_scaler helper the flat step uses.
@@ -233,10 +233,30 @@ class Trainer:
             # NONE (or a size-1 axis) falls out replicated.
             from dlti_tpu.parallel.sharding import opt_state_shardings
 
+            param_sh = pipeline_param_shardings(state.params, self.mesh)
+            if self.cfg.parallel.offload_params:
+                # PP x param host-offload (boundary-transfer mode, the
+                # flat path's fallback semantics): FROZEN base leaves
+                # rest in pinned host memory between steps; trainable
+                # (LoRA) leaves stay device-resident. _build_step moves
+                # the frozen tree HBM-ward per step and splices the
+                # still-valid host copies back after.
+                from dlti_tpu.parallel.sharding import _host_memory_kind
+                from dlti_tpu.training.state import (
+                    combine_params, partition_params,
+                )
+
+                kind = _host_memory_kind(self.mesh)
+                if kind is not None:
+                    trainable_sh, frozen_sh = partition_params(
+                        param_sh, self.cfg.lora.enabled)
+                    frozen_sh = jax.tree_util.tree_map(
+                        lambda s: NamedSharding(self.mesh, s.spec,
+                                                memory_kind=kind),
+                        frozen_sh)
+                    param_sh = combine_params(trainable_sh, frozen_sh)
             state = state.replace(
-                params=jax.device_put(
-                    state.params,
-                    pipeline_param_shardings(state.params, self.mesh)),
+                params=jax.device_put(state.params, param_sh),
                 opt_state=jax.device_put(
                     state.opt_state,
                     opt_state_shardings(state.opt_state, self.cfg,
@@ -272,31 +292,17 @@ class Trainer:
                         for k, v in batch.items()}
                 return pipe_step(state, flat, rng)
 
-            if self.cfg.parallel.offload_optimizer:
-                # PP x optimizer host-offload: Adam moments REST in
-                # pinned host memory (opt_state_shardings carries the
-                # memory kind) and cross at step boundaries — the same
-                # fallback transfer the flat path uses when only the
-                # optimizer is offloaded. Peak HBM holds moments only
-                # for the step's duration.
-                from jax.sharding import NamedSharding
+            if (self.cfg.parallel.offload_optimizer
+                    or self.cfg.parallel.offload_params):
+                # PP x host offload (boundary-transfer mode, the flat
+                # path's fallback semantics): one shared wrapper — it
+                # derives shardings from the PLACED state and is a no-op
+                # when nothing actually rests in host memory (backend
+                # without pinned_host).
+                from dlti_tpu.parallel.sharding import wrap_boundary_offload
 
-                from dlti_tpu.parallel.sharding import opt_state_shardings
-
-                opt_host = opt_state_shardings(state.opt_state, self.cfg,
-                                               self.mesh)
-                opt_dev = jax.tree_util.tree_map(
-                    lambda s: (NamedSharding(self.mesh, s.spec)
-                               if isinstance(s, NamedSharding) else s),
-                    opt_host)
-                inner = step_fn
-
-                def step_fn(state, batch, rng):
-                    state = state.replace(opt_state=jax.device_put(
-                        state.opt_state, opt_dev))
-                    new_state, m = inner(state, batch, rng)
-                    return new_state.replace(opt_state=jax.device_put(
-                        new_state.opt_state, opt_host)), m
+                step_fn = wrap_boundary_offload(
+                    step_fn, state, self.mesh, self.cfg.lora.enabled)
 
             return step_fn
         if self.mesh is not None:
@@ -424,6 +430,21 @@ class Trainer:
                 # Packed eval batches are fine: make_pipeline_eval_step
                 # passes segment_ids/positions through pipeline_forward.
                 eval_fn = make_pipeline_eval_step(cfg, self.mesh)
+                params_dev_sh = getattr(step_fn,
+                                        "params_dev_shardings", None)
+                if params_dev_sh is not None:
+                    # PP x offload_params: eval feeds params into the
+                    # same pipe shard_map, which cannot take pinned_host
+                    # stage-sharded operands — move the frozen tree
+                    # HBM-ward for the eval pass, same boundary transfer
+                    # as the train step.
+                    inner_eval = eval_fn
+
+                    def eval_fn(state, batch,
+                                _inner=inner_eval, _sh=params_dev_sh):
+                        return _inner(state.replace(
+                            params=jax.device_put(state.params, _sh)),
+                            batch)
             else:
                 from dlti_tpu.training.step import make_eval_step
 

@@ -155,10 +155,10 @@ def test_trainer_rejects_illegal_pipe_compositions():
     )
     with pytest.raises(ValueError, match="does not compose"):
         Trainer(bad2)
-    # Param offload remains excluded under pipe (optimizer offload
-    # composes as of r05).
+    # Param offload needs LoRA (it offloads the frozen base; full
+    # fine-tune has none) — rejected without it, legal with it.
     bad3 = Config(
-        model=CFG, lora=LoRAConfig(r=2, alpha=4),
+        model=CFG, lora=LoRAConfig(enabled=False),
         parallel=ParallelConfig(pipe=2, data=2, offload_params=True),
     )
     with pytest.raises(ValueError, match="does not compose"):
@@ -707,19 +707,24 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
     from dlti_tpu.data import ByteTokenizer, make_batches
     from dlti_tpu.training.trainer import Trainer
 
-    def run(zero_stage, tag, offload=False):
+    def run(zero_stage, tag, offload=False, offload_p=False):
         cfg = Config(
             model=CFG,
             lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
             optimizer=OptimizerConfig(warmup_steps=2),
             parallel=ParallelConfig(pipe=2, data=2, zero_stage=zero_stage,
-                                    offload_optimizer=offload),
+                                    offload_optimizer=offload,
+                                    offload_params=offload_p),
             data=DataConfig(max_seq_len=32, tokenizer="byte"),
             checkpoint=CheckpointConfig(output_dir=str(tmp_path / tag),
                                         save_strategy="no"),
             train=TrainConfig(num_epochs=1, micro_batch_size=4,
                               grad_accum_steps=2, max_steps=4,
                               logging_steps=100,
+                              # Offload runs also exercise the PP eval
+                              # path (host params must be shimmed
+                              # HBM-ward before the eval shard_map).
+                              eval_steps=2 if offload_p else 0,
                               metrics_csv=str(tmp_path / f"{tag}.csv")),
         )
         texts = [f"sample {i} text {i * 7}" for i in range(160)]
@@ -738,8 +743,12 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
                 if getattr(leaf.sharding, "memory_kind", None) == \
                         "pinned_host":
                     on_host += 1
-        state, record = trainer.train(dataset=ds)
-        return sharded, on_host, record.final_loss
+        p_host = sum(
+            1 for leaf in jax.tree_util.tree_leaves(state.params)
+            if getattr(leaf.sharding, "memory_kind", None) == "pinned_host")
+        state, record = trainer.train(
+            dataset=ds, eval_dataset=ds if offload_p else None)
+        return sharded, on_host + p_host, record.final_loss
 
     sharded0, host0, loss0 = run(ZeROStage.NONE, "base")
     sharded1, host1, loss1 = run(ZeROStage.ZERO1, "zero1")
@@ -750,10 +759,11 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
     assert host0 == host1 == host2 == 0
     np.testing.assert_allclose(loss1, loss0, rtol=1e-6)
     np.testing.assert_allclose(loss2, loss0, rtol=1e-6)
-    # PP x optimizer host-offload (r05): moments REST in pinned host
-    # memory, cross at step boundaries, trajectory unchanged.
+    # PP x host offload (r05, boundary-transfer mode): optimizer moments
+    # AND the frozen base REST in pinned host memory, cross at step
+    # boundaries, trajectory unchanged.
     shardedo, hosto, losso = run(ZeROStage.ZERO1, "zero1_offload",
-                                 offload=True)
+                                 offload=True, offload_p=True)
     assert shardedo > 0
     assert hosto > 0, "offload_optimizer x PP must place moments on host"
     np.testing.assert_allclose(losso, loss0, rtol=1e-6)

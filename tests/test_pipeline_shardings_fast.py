@@ -63,8 +63,8 @@ def test_pipe_expert_spec():
 
 def test_trainer_pipe_legality_fast():
     """The legality list's r05 shape, without building any step: every
-    mesh axis composes; param offload and SP x loss_chunk stay
-    rejected."""
+    mesh axis composes; param offload without LoRA and SP x loss_chunk
+    stay rejected."""
     from dlti_tpu.config import (
         Config, LoRAConfig, ModelConfig, ParallelConfig, TrainConfig,
     )
@@ -75,18 +75,23 @@ def test_trainer_pipe_legality_fast():
                             num_heads=2, num_kv_heads=2, max_seq_len=16,
                             remat=False)
 
-    def cfg_with(par, **train_kw):
-        return Config(model=cfg_model, lora=LoRAConfig(r=2, alpha=4),
+    def cfg_with(par, lora=None, **train_kw):
+        return Config(model=cfg_model,
+                      lora=lora or LoRAConfig(r=2, alpha=4),
                       parallel=par, train=TrainConfig(**train_kw))
 
     # Every axis at once passes validation.
     _validate_pipeline_config(cfg_with(ParallelConfig(
         pipe=2, tensor=2, data=2, sequence=2, expert=2,
         fsdp=2, zero_stage=ZeROStage.ZERO3)))
-    # Rejections stay loud.
+    # Offload (both kinds, boundary-transfer mode) passes with LoRA...
+    _validate_pipeline_config(cfg_with(ParallelConfig(
+        pipe=2, data=2, offload_optimizer=True, offload_params=True)))
+    # ...and rejections stay loud.
     with pytest.raises(ValueError, match="does not compose"):
-        _validate_pipeline_config(cfg_with(ParallelConfig(
-            pipe=2, data=2, offload_params=True)))
+        _validate_pipeline_config(cfg_with(
+            ParallelConfig(pipe=2, data=2, offload_params=True),
+            lora=LoRAConfig(enabled=False)))
     with pytest.raises(ValueError, match="does not compose"):
         _validate_pipeline_config(cfg_with(
             ParallelConfig(pipe=2, sequence=2), loss_chunk=8))
