@@ -277,6 +277,37 @@ def pipeline_forward(
                 aux = jnp.float32(0.0)
             return out, aux
 
+        stride = cfg.remat_stride if cfg.remat else 0
+        if cfg.remat and stride > 1 and layers_per_stage % stride == 0:
+            # Selective remat under pipe (flat-path remat_stride parity):
+            # scan over GROUPS of `stride` layers, rematting all but the
+            # last in each group — every stride-th block keeps its
+            # activations, trading ~1/stride of the backward recompute
+            # for that fraction of saved activations per stage. Numerics
+            # identical (remat changes only what the backward recomputes).
+            fn = jax.checkpoint(body, policy=_remat_policy(cfg.remat_policy))
+
+            def group_fn(carry, group):
+                params_g, idx_g = group
+                h = carry
+                aux_sum = jnp.float32(0.0)
+                for j in range(stride):  # static unroll within the group
+                    layer_j = jax.tree_util.tree_map(
+                        lambda v: v[j], params_g)
+                    apply_j = body if j == stride - 1 else fn
+                    h, aux = apply_j(h, (layer_j, idx_g[j]))
+                    aux_sum = aux_sum + aux
+                return h, aux_sum
+
+            grouped = (
+                jax.tree_util.tree_map(
+                    lambda v: v.reshape(
+                        (layers_per_stage // stride, stride) + v.shape[1:]),
+                    layer_params),
+                jnp.arange(layers_per_stage).reshape(-1, stride),
+            )
+            x, aux_groups = jax.lax.scan(group_fn, x, grouped)
+            return x, jnp.sum(aux_groups)
         if cfg.remat:
             # Same policy table as the flat path (llama._remat_policy):
             # the int8/no-remat bench winner aside, 7B-class PP runs need
@@ -448,16 +479,18 @@ def make_pipeline_train_step(
     from dlti_tpu.training.state import combine_params, partition_params
     from dlti_tpu.training.step import causal_lm_loss
 
-    if cfg.model.remat and cfg.model.remat_stride > 1:
+    layers_per_stage = cfg.model.num_layers // mesh.shape["pipe"]
+    if (cfg.model.remat and cfg.model.remat_stride > 1
+            and layers_per_stage % cfg.model.remat_stride != 0):
         from dlti_tpu.utils.logging import get_logger
 
-        # The pipeline body is a lax.scan over identical per-stage layers;
-        # a per-layer stride predicate is not expressible there, so every
-        # scanned layer remats.
+        # Selective remat scans layer GROUPS of `stride`; a stride that
+        # does not divide the per-stage layer count cannot group evenly,
+        # so every scanned layer remats (plain jax.checkpoint).
         get_logger().warning(
-            "remat_stride=%d is ignored under pipeline parallelism "
-            "(scan-uniform layers remat every block)",
-            cfg.model.remat_stride)
+            "remat_stride=%d does not divide layers_per_stage=%d under "
+            "pipe=%d; every block remats",
+            cfg.model.remat_stride, layers_per_stage, mesh.shape["pipe"])
 
     lora = cfg.lora if cfg.lora.enabled else None
 

@@ -406,26 +406,13 @@ def make_sharded_train_step(
             step_fn, cfg, mesh, st_sh, st_sh_dev, b_sh, rng_sh, donate)
 
     # Fallback (runtime without host-compute operands, or only the
-    # *optimizer* is offloaded): step-boundary whole-state transfer — HBM
-    # holds offloaded tensors only for the duration of a step.
-    def step_with_offload(state, batch, rng):
-        host_state = state
-        dev_state = jax.device_put(state, st_sh_dev)   # host -> HBM
-        new_state, metrics = jitted(dev_state, batch, rng)
-        if frozen_offloaded:
-            # Frozen base params never change: splice the still-valid host
-            # copies back in so device_put below doesn't re-transfer them
-            # HBM -> host every step (half the offload DMA traffic for a
-            # LoRA run).
-            from dlti_tpu.training.state import combine_params, partition_params
-
-            t_new, _ = partition_params(new_state.params, cfg.lora.enabled)
-            _, f_host = partition_params(host_state.params, cfg.lora.enabled)
-            new_state = new_state.replace(params=combine_params(t_new, f_host))
-        new_state = jax.device_put(new_state, st_sh)   # changed leaves -> host
-        return new_state, metrics
-
-    return step_with_offload
+    # *optimizer* is offloaded): step-boundary transfer via the ONE
+    # shared wrapper (also the pipe path's offload mode) — HBM holds
+    # offloaded tensors only for the duration of a step. The wrapper
+    # derives shardings from ``state``'s actual placement, so it must be
+    # the PLACED state (every caller passes the shard_train_state
+    # output).
+    return wrap_boundary_offload(jitted, state, mesh, cfg.lora.enabled)
 
 
 def wrap_boundary_offload(step_fn, state, mesh: Mesh, lora_enabled: bool):

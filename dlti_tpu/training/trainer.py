@@ -114,11 +114,12 @@ def _validate_pipeline_config(cfg: Config) -> None:
     # double-count), psum'd over 'pipe'; EP composes too (see above).
     # Packed sequences compose: segment ids ride each microbatch through
     # the stages (pipeline_forward segment_ids), per-doc positions included.
-    # Every named remat policy composes as of r05: the scanned stage body
-    # passes cfg.remat_policy through the same policy table the flat path
-    # uses (llama._remat_policy). remat_stride alone stays a warning in
-    # make_pipeline_train_step (a per-layer stride predicate is not
-    # expressible in a scan over uniform layers).
+    # Every named remat policy composes as of r05 (the scanned stage body
+    # passes cfg.remat_policy through the flat path's policy table), and
+    # remat_stride does too: layers scan in GROUPS of stride with every
+    # stride-th block keeping its activations (pipeline_forward); a
+    # non-dividing stride warns in make_pipeline_train_step and falls
+    # back to full remat.
     import jax as _jax
 
     if _jax.process_count() > 1:
@@ -435,16 +436,17 @@ class Trainer:
                 if params_dev_sh is not None:
                     # PP x offload_params: eval feeds params into the
                     # same pipe shard_map, which cannot take pinned_host
-                    # stage-sharded operands — move the frozen tree
-                    # HBM-ward for the eval pass, same boundary transfer
-                    # as the train step.
+                    # stage-sharded operands. Tag the shardings for
+                    # _run_eval, which transfers the frozen tree
+                    # HBM-ward ONCE per eval pass (not per batch — a 7B
+                    # base x 50 eval batches would be hundreds of GB of
+                    # needless DMA) and releases the copy after.
                     inner_eval = eval_fn
 
-                    def eval_fn(state, batch,
-                                _inner=inner_eval, _sh=params_dev_sh):
-                        return _inner(state.replace(
-                            params=jax.device_put(state.params, _sh)),
-                            batch)
+                    def eval_fn(state, batch, _inner=inner_eval):
+                        return _inner(state, batch)
+
+                    eval_fn.params_dev_shardings = params_dev_sh
             else:
                 from dlti_tpu.training.step import make_eval_step
 
@@ -676,6 +678,13 @@ class Trainer:
         self._stop_requested = True
 
     def _run_eval(self, eval_fn, state, eval_dataset, step: int) -> float:
+        dev_sh = getattr(eval_fn, "params_dev_shardings", None)
+        if dev_sh is not None:
+            # PP x offload_params: one host->HBM transfer of the frozen
+            # tree covers the WHOLE eval pass; the device copy goes out
+            # of scope (and frees) when this returns.
+            state = state.replace(
+                params=jax.device_put(state.params, dev_sh))
         losses, toks = [], 0.0
         for batch in eval_dataset.epoch(0):
             flat = {

@@ -748,24 +748,28 @@ def test_pipeline_zero1_shards_opt_state_same_losses(tmp_path):
             if getattr(leaf.sharding, "memory_kind", None) == "pinned_host")
         state, record = trainer.train(
             dataset=ds, eval_dataset=ds if offload_p else None)
-        return sharded, on_host + p_host, record.final_loss
+        return sharded, on_host, p_host, record.final_loss
 
-    sharded0, host0, loss0 = run(ZeROStage.NONE, "base")
-    sharded1, host1, loss1 = run(ZeROStage.ZERO1, "zero1")
-    sharded2, host2, loss2 = run(ZeROStage.ZERO2, "zero2")
+    sharded0, host0, phost0, loss0 = run(ZeROStage.NONE, "base")
+    sharded1, host1, phost1, loss1 = run(ZeROStage.ZERO1, "zero1")
+    sharded2, host2, phost2, loss2 = run(ZeROStage.ZERO2, "zero2")
     assert sharded0 == 0, "baseline pipe run must replicate opt state"
     assert sharded1 > 0, "ZeRO-1 x PP must shard optimizer moments"
     assert sharded2 > 0, "ZeRO-2 x PP must shard optimizer moments"
     assert host0 == host1 == host2 == 0
+    assert phost0 == phost1 == phost2 == 0
     np.testing.assert_allclose(loss1, loss0, rtol=1e-6)
     np.testing.assert_allclose(loss2, loss0, rtol=1e-6)
     # PP x host offload (r05, boundary-transfer mode): optimizer moments
-    # AND the frozen base REST in pinned host memory, cross at step
-    # boundaries, trajectory unchanged.
-    shardedo, hosto, losso = run(ZeROStage.ZERO1, "zero1_offload",
-                                 offload=True, offload_p=True)
+    # AND the frozen base REST in pinned host memory (asserted
+    # SEPARATELY so neither placement can silently regress), cross at
+    # step boundaries, trajectory unchanged — with the eval pass
+    # exercising the one-transfer-per-pass shim.
+    shardedo, hosto, phosto, losso = run(ZeROStage.ZERO1, "zero1_offload",
+                                         offload=True, offload_p=True)
     assert shardedo > 0
     assert hosto > 0, "offload_optimizer x PP must place moments on host"
+    assert phosto > 0, "offload_params x PP must place frozen base on host"
     np.testing.assert_allclose(losso, loss0, rtol=1e-6)
 
 
@@ -900,6 +904,49 @@ def test_pipe_x_sequence_matches_single_device():
         want = np.asarray(
             ref_state.params["model"][f"layers_{layer}"]["attn"]["q_proj"]["lora_b"])
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_remat_stride_matches_no_remat():
+    """Selective remat under PP (r05): layers scan in groups of `stride`
+    with every stride-th block keeping its activations — numerics equal
+    the no-remat pipelined step (pipe=2 so layers_per_stage=2 divides
+    stride=2)."""
+    import dataclasses
+
+    from dlti_tpu.parallel.pipeline import to_pipeline_state
+
+    mesh = build_mesh(ParallelConfig(pipe=2))
+    lora = LoRAConfig(r=2, alpha=4, dropout=0.0)
+    tx = build_optimizer(OptimizerConfig(warmup_steps=0))
+    batch_flat = {
+        "input_ids": jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                        CFG.vocab_size),
+        "loss_mask": jnp.ones((8, 16), jnp.int32),
+    }
+    rng = jax.random.PRNGKey(4)
+
+    def run(mc):
+        model = LlamaForCausalLM(mc, lora)
+        state = create_train_state(jax.random.PRNGKey(0), model, tx, (4, 16),
+                                   lora_enabled=True)
+        cfg = Config(model=mc, lora=lora,
+                     optimizer=OptimizerConfig(warmup_steps=0),
+                     parallel=ParallelConfig(pipe=2),
+                     data=DataConfig(max_seq_len=16),
+                     train=TrainConfig(micro_batch_size=8,
+                                       grad_accum_steps=1))
+        pstate = to_pipeline_state(state, mc.num_layers)
+        pstep = make_pipeline_train_step(cfg, tx, mesh, num_microbatches=4)
+        pstate, pm = pstep(pstate, batch_flat, rng)
+        back = from_pipeline_params(pstate.params, mc.num_layers)
+        return float(pm["loss"]), np.asarray(
+            back["model"]["layers_0"]["attn"]["q_proj"]["lora_b"])
+
+    base_loss, base_w = run(CFG)
+    strided_loss, strided_w = run(dataclasses.replace(
+        CFG, remat=True, remat_policy="dots_saveable", remat_stride=2))
+    np.testing.assert_allclose(strided_loss, base_loss, rtol=1e-6)
+    np.testing.assert_allclose(strided_w, base_w, rtol=1e-6, atol=1e-7)
 
 
 def test_pipe_x_expert_matches_flat():
