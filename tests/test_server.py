@@ -115,6 +115,41 @@ def test_completions_deterministic_greedy(live_server):
     assert json.loads(d1)["choices"][0]["text"] == json.loads(d2)["choices"][0]["text"]
 
 
+def test_stop_matcher_invariants():
+    """Property test for the windowed stop scanner (no server needed):
+    over randomized stops and incremental text feeds, the emitted prefix
+    never contains a stop string, the cut always equals the earliest
+    full-text match, and the safe boundary never retracts emitted
+    text."""
+    import random
+
+    from dlti_tpu.serving.server import _Handler
+
+    rng = random.Random(7)
+    alphabet = "abc"
+    for _ in range(300):
+        stops = tuple(
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 3)))
+            for _ in range(rng.randint(1, 3)))
+        full = "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 30)))
+        matcher = _Handler._StopMatcher(stops)
+        text, emitted = "", 0
+        cut = None
+        while len(text) < len(full) and cut is None:
+            text = full[: len(text) + rng.randint(1, 4)]
+            cut, safe = matcher.feed(text)
+            if cut is not None:
+                break
+            assert safe >= emitted, (full, stops, text, safe, emitted)
+            for s in stops:
+                assert s not in text[:safe], (full, stops, text, safe)
+            emitted = safe
+        expected = min((i for i in (full[: len(text)].find(s)
+                                    for s in stops) if i != -1),
+                       default=None)
+        assert cut == expected, (full, stops, text, cut, expected)
+
+
 def _pick_stop(host, port):
     """(full_text, stop, request_body): a per-request-seeded sampled
     completion (reproducible by the engine's seed contract) and an inner
