@@ -678,10 +678,32 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
     httpd, async_engine = make_server(engine, tokenizer, cfg)
     get_logger().info("serving on http://%s:%d (model=%s)",
                       cfg.host, cfg.port, cfg.model_name)
+    # SIGTERM (k8s eviction, orchestrator `kill`) gets the same clean
+    # path as Ctrl-C: unblock serve_forever so the finally drains the
+    # stepper and closes the socket instead of dying mid-decode.
+    # httpd.shutdown() must run OFF the serving thread (it joins it).
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    prev_handler = None
+    installed = False
+    try:
+        prev_handler = _signal.signal(_signal.SIGTERM, _on_term)
+        installed = True
+    except ValueError:
+        pass  # not the main thread (embedded use): SIGTERM stays default
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if installed:
+            # Restore (trainer.py's pattern): a stale handler closing
+            # over the dead httpd would otherwise swallow every later
+            # SIGTERM for the process lifetime.
+            _signal.signal(_signal.SIGTERM,
+                           prev_handler or _signal.SIG_DFL)
         async_engine.shutdown()
         httpd.server_close()
