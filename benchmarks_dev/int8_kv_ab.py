@@ -10,7 +10,7 @@ byte budget; the int8 arm spends it on 2x the slots:
 Engine-direct (no server/link noise in scheduling), deep queue, greedy,
 fixed-length outputs, >= 3 repeats per arm, all runs reported.
 
-  python benchmarks_dev/int8_kv_ab.py --export exports/glaive_7b_r04
+  python benchmarks_dev/int8_kv_ab.py --export exports/glaive_7b_r05
   python benchmarks_dev/int8_kv_ab.py --cpu          # mechanism check
 """
 
@@ -28,7 +28,7 @@ os.chdir(_repo)
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--export", default="exports/glaive_7b_r04")
+    ap.add_argument("--export", default="exports/glaive_7b_r05")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--requests", type=int, default=112)
