@@ -178,14 +178,24 @@ def _probe_backend() -> None:
             break
         attempt += 1
         t0 = time.monotonic()
+        # Clamp so even the last attempt returns control before the
+        # slack boundary — the loop (not the watchdog) must emit the
+        # rc=3 JSON. Exception: the guaranteed FIRST probe. With a
+        # deadline below the slack floor (BENCH_DEADLINE_S < MIN_SLACK_S,
+        # the smoke case), remaining - MIN_SLACK_S clamps to the 10 s
+        # floor — too short for real backend init on a slow-init relay,
+        # so a healthy backend would be reported as 'failed 1x' in
+        # exactly the scenario the always-probe-once rule covers. Give
+        # that first probe the full remaining budget instead.
+        slack_bounded = remaining - MIN_SLACK_S
+        if attempt == 1 and slack_bounded < 10:
+            probe_t = min(PROBE_TIMEOUT_S, max(10, remaining))
+        else:
+            probe_t = min(PROBE_TIMEOUT_S, max(10, slack_bounded))
         try:
-            # Clamp so even the last attempt returns control before the
-            # slack boundary — the loop (not the watchdog) must emit the
-            # rc=3 JSON.
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
-                               timeout=min(PROBE_TIMEOUT_S,
-                                           max(10, remaining - MIN_SLACK_S)))
+                               timeout=probe_t)
         except subprocess.TimeoutExpired:
             r = None
         dt = time.monotonic() - t0

@@ -41,6 +41,13 @@ class LoadGenConfig:
     chat: bool = False
     timeout_s: float = 300.0
     seed: int = 0
+    # After the run, scrape the server's /metrics and attach its ON-ENGINE
+    # request-lifecycle histograms (TTFT/TPOT/queue time) to the report —
+    # the engine's own view of the latencies this loadgen measures from
+    # outside, so client-vs-server skew (network, HTTP framing, queueing
+    # before admission) is visible in one report. Off by default: the
+    # target may not expose dlti_* metrics.
+    scrape_server_metrics: bool = False
 
 
 @dataclass
@@ -76,6 +83,9 @@ class LoadReport:
     ttft_p99_s: float = 0.0
     tpot_mean_ms: float = 0.0
     errors: List[str] = field(default_factory=list)
+    # Server-side histogram summaries ({metric: {count, sum, mean}}) when
+    # cfg.scrape_server_metrics is set; empty otherwise.
+    server_histograms: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -216,6 +226,59 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                 pass
 
 
+async def _scrape_histograms(host: str, port: int,
+                             timeout_s: float = 10.0) -> dict:
+    """GET /metrics and fold Prometheus histogram series into
+    ``{name: {count, sum, mean}}``. Best-effort: any failure (no route,
+    refused connection, unparseable body) returns ``{}`` — scraping must
+    never fail a load test."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout_s)
+        req = (f"GET /metrics HTTP/1.1\r\nHost: {host}:{port}\r\n"
+               f"Connection: close\r\n\r\n").encode()
+        writer.write(req)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), timeout_s)
+        if b" 200 " not in status_line and not status_line.endswith(b" 200\r\n"):
+            return {}
+        headers: dict = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), timeout_s)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = b"".join([c async for c in _iter_body(reader, headers, timeout_s)])
+        writer.close()
+    except Exception:
+        return {}
+    out: dict = {}
+    for line in raw.decode(errors="replace").splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.partition(" ")
+        base = None
+        if name.endswith("_sum"):
+            base, key = name[:-4], "sum"
+        elif name.endswith("_count"):
+            base, key = name[:-6], "count"
+        if base is None:
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        out.setdefault(base, {})[key] = v
+    hists = {}
+    for base, d in out.items():
+        if "count" in d and "sum" in d:
+            n = d["count"]
+            hists[base] = {"count": int(n), "sum": round(d["sum"], 6),
+                           "mean": round(d["sum"] / n, 6) if n else 0.0}
+    return hists
+
+
 def _build_body(cfg: LoadGenConfig, rng: random.Random) -> Tuple[str, dict]:
     prompt = rng.choice(cfg.prompts) if cfg.prompts else cfg.prompt
     if cfg.chat:
@@ -254,6 +317,8 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         await asyncio.gather(*(one() for _ in range(cfg.num_requests)),
                              return_exceptions=True)
     duration = time.monotonic() - t0
+    server_hists = (await _scrape_histograms(cfg.host, cfg.port)
+                    if cfg.scrape_server_metrics else {})
 
     ok = [r for r in records if r.ok]
     lat = [r.latency for r in ok]
@@ -277,6 +342,7 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         ttft_p99_s=round(_percentile(ttfts, 99), 4),
         tpot_mean_ms=round(sum(tpots_ms) / len(tpots_ms), 2) if tpots_ms else 0.0,
         errors=[r.error for r in records if r.error][:10],
+        server_histograms=server_hists,
     )
 
 

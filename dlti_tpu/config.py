@@ -317,6 +317,29 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified telemetry layer (``dlti_tpu.telemetry``): span tracing,
+    per-step JSONL stream, multi-host heartbeat. All off by default — the
+    tracer's disabled path is one attribute read per span site."""
+
+    # Directory for Chrome-trace JSON exports (Perfetto-viewable) of the
+    # host-side span tracer: per-step trainer phases (batch fetch,
+    # host→device, dispatch, sync, eval, save) and per-request engine
+    # lifecycle spans. "" = tracer disabled.
+    trace_dir: str = ""
+    # Span ring-buffer capacity (events kept; oldest dropped beyond it).
+    trace_capacity: int = 65536
+    # Per-step JSONL telemetry stream (rank-0): step, loss, grad_norm, lr,
+    # tokens/s/chip, MFU, HBM peak — a superset of the reference CSV
+    # columns (telemetry.steplog). "" = off.
+    step_log_path: str = ""
+    # Multi-host heartbeat cadence in optimizer steps (0 = off): every
+    # process reports its step (collective on multi-host meshes) and rank
+    # 0 logs straggler lag.
+    heartbeat_interval_steps: int = 0
+
+
+@dataclass(frozen=True)
 class Config:
     """Root config."""
 
@@ -327,6 +350,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     experiment_name: str = ""
 
     def replace(self, **kwargs: Any) -> "Config":
@@ -360,13 +384,14 @@ class Config:
                     continue
                 f = fields[k]
                 if dataclasses.is_dataclass(f.type) or f.name in (
-                    "model", "lora", "optimizer", "parallel", "data", "checkpoint", "train",
+                    "model", "lora", "optimizer", "parallel", "data",
+                    "checkpoint", "train", "telemetry",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
                         "optimizer": OptimizerConfig, "parallel": ParallelConfig,
                         "data": DataConfig, "checkpoint": CheckpointConfig,
-                        "train": TrainConfig,
+                        "train": TrainConfig, "telemetry": TelemetryConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -32,7 +32,9 @@ from jax.ad_checkpoint import checkpoint_name
 from dlti_tpu.config import LoRAConfig, ModelConfig
 from dlti_tpu.models.lora import LoRADense
 from dlti_tpu.ops.attention import reference_attention
-from dlti_tpu.ops.rope import apply_rope, rope_frequencies
+from dlti_tpu.ops.rope import (
+    apply_rope, assert_rope_table_covers, rope_frequencies,
+)
 
 
 from dlti_tpu.utils.dtypes import resolve_dtype as _dtype  # shared table
@@ -363,11 +365,22 @@ class LlamaModel(nn.Module):
             # class, seq 512 > table 128) — it would silently clamp.
             # Keep every table-sizing branch >= max(positions) + 1.
             table_len = max(cfg.max_seq_len, s)
+            # Trace-time enforcement of the invariant above (ADVICE r05):
+            # positions here are bounded by the static sequence length
+            # (arange(s) by default; packed per-doc positions < s), so an
+            # under-sized table fails the trace instead of silently
+            # clamping rotary angles.
+            assert_rope_table_covers(table_len, s, "training/no-cache path")
         elif "block_tables" in cache[0]:
             # Paged: capacity = logical window = blocks/seq * block_size.
+            # Positions are bounded by the engine's seq_len < capacity =
+            # table_len by construction (not statically knowable here).
             table_len = cache[0]["block_tables"].shape[1] * cache[0]["k"].shape[1]
         else:
             table_len = cache[0]["k"].shape[1]
+            # Decode over a dense cache: the query chunk's positions lie
+            # inside the cache window; the chunk itself must fit.
+            assert_rope_table_covers(table_len, s, "dense-cache decode path")
         cos, sin = rope_frequencies(cfg.resolved_head_dim, table_len, cfg.rope_theta)
 
         block_cls = LlamaBlock

@@ -12,6 +12,27 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def assert_rope_table_covers(table_len: int, needed_len: int,
+                             context: str = "") -> None:
+    """Trace-time guard for the table-sizing invariant.
+
+    :func:`apply_rope` gathers with ``mode="clip"`` (no per-gather bounds
+    check — see the comment there), so an under-sized cos/sin table no
+    longer NaNs loudly: it silently clamps rotary angles (the r03 bug
+    class, seq 512 > table 128). Call this wherever the maximum position
+    is STATICALLY known (both arguments are Python ints at trace time —
+    sequence lengths and table sizes are static under jit), so a future
+    mis-sized caller fails at trace time instead of training on wrong
+    rotations.
+    """
+    if table_len < needed_len:
+        raise ValueError(
+            f"RoPE table of length {table_len} cannot cover positions up "
+            f"to {needed_len - 1}{' (' + context + ')' if context else ''}; "
+            "apply_rope gathers with mode='clip' and would silently clamp "
+            "rotary angles — size the table to >= max position + 1")
+
+
 def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0) -> tuple:
     """Precompute cos/sin tables of shape ``(max_seq_len, head_dim // 2)``."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))

@@ -192,8 +192,15 @@ def pipeline_forward(
     # Cover the actual sequence even past the preset's design length
     # (same fix as models/llama.py: positions >= table length hit
     # jnp.take's NaN fill and training silently NaNs).
-    cos, sin = rope_frequencies(cfg.resolved_head_dim,
-                                max(cfg.max_seq_len, s), cfg.rope_theta)
+    table_len = max(cfg.max_seq_len, s)
+    # Trace-time guard (ADVICE r05): apply_rope clip-gathers, so an
+    # under-sized table would silently clamp angles — fail the trace here
+    # where the max position (< s) is statically known.
+    from dlti_tpu.ops.rope import assert_rope_table_covers
+
+    assert_rope_table_covers(table_len, s, "pipeline forward")
+    cos, sin = rope_frequencies(cfg.resolved_head_dim, table_len,
+                                cfg.rope_theta)
 
     # Embed outside the pipelined region (replicated). int8 frozen-base
     # trees quantize the embedding too — gather int8 ROWS then scale

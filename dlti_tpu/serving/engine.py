@@ -39,6 +39,7 @@ from dlti_tpu.models import LlamaForCausalLM
 from dlti_tpu.ops.kv_cache import init_paged_cache
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.sampling import SamplingParams, sample_tokens
+from dlti_tpu.telemetry import RequestTelemetry
 from dlti_tpu.utils.logging import get_logger
 
 
@@ -149,6 +150,10 @@ class Request:
     num_preemptions: int = 0
     # Which replica owns this request (set by ReplicatedEngine.submit).
     replica: int = 0
+    # When the request was first admitted into a decode slot (monotonic;
+    # None while queued). Kept across preemption/re-admission so the
+    # queue-time histogram measures the first wait only.
+    admitted_time: Optional[float] = None
     # Early-cancel flag (server stop-string matching, client disconnect):
     # SET from any thread (a GIL-atomic bool write, the same contract as
     # AsyncEngine.submit), CONSUMED by the stepper thread at the next
@@ -212,7 +217,15 @@ class InferenceEngine:
         lora_cfg: Optional[LoRAConfig] = None,
         mesh=None,
         donate_params: bool = False,
+        telemetry: Optional[RequestTelemetry] = None,
     ):
+        # Request-lifecycle telemetry (dlti_tpu.telemetry.lifecycle):
+        # TTFT/TPOT/queue-time histograms observed on-engine + per-request
+        # Chrome-trace spans. A shared instance (ReplicatedEngine) makes
+        # the histograms aggregate across replicas.
+        self.telemetry = telemetry if telemetry is not None \
+            else RequestTelemetry()
+        self._tracer = self.telemetry.tracer
         if mesh is not None:
             # Tensor-parallel serving: weights and KV pools shard over the
             # 'tensor' axis (attention heads / MLP hidden / vocab); GSPMD
@@ -732,6 +745,10 @@ class InferenceEngine:
         )
         self.waiting.append(req)
         self.stats["requests"] += 1
+        # Tracer-only (no engine state): an instant event under the
+        # tracer's own lock, a no-op when tracing is disabled — within
+        # the thread-safety contract above.
+        self.telemetry.on_submitted(req)
         return req
 
     @property
@@ -766,13 +783,20 @@ class InferenceEngine:
         # their rows to the trash block — no KV interleaving hazard — and
         # they join the NEXT round's decode batch (their first token comes
         # from prefill sampling either way, so TTFT only improves).
+        tr = self._tracer
         pending = None
         if any(not s.free and not s.prefilling for s in self.slots):
-            pending = self._decode_dispatch()
-        self._admit()
+            with tr.span("engine/decode_dispatch", cat="engine"):
+                pending = self._decode_dispatch()
+        with tr.span("engine/admit", cat="engine"):
+            self._admit()
         if self.cfg.max_prefill_tokens_per_step > 0:
-            self._prefill_work()
-        return self._decode_complete(pending) if pending is not None else []
+            with tr.span("engine/prefill_chunks", cat="engine"):
+                self._prefill_work()
+        if pending is None:
+            return []
+        with tr.span("engine/decode_sync", cat="engine"):
+            return self._decode_complete(pending)
 
     # ------------------------------------------------------------------
     # Scheduling internals
@@ -800,6 +824,7 @@ class InferenceEngine:
                 req.finish_reason = "stop"
                 req.finish_time = time.monotonic()
                 self.finished.append(req)
+                self.telemetry.on_finished(req)
             if not self.waiting or not slot.free:
                 continue
             req = self.waiting[0]
@@ -879,6 +904,7 @@ class InferenceEngine:
         """Host-side bookkeeping for an admitted request (block table row,
         sampling params, per-slot key + generated-token count)."""
         ec = self.cfg
+        self.telemetry.on_admitted(req)
         slot.request = req
         slot.blocks = blocks
         slot.seq_len = n
@@ -1229,6 +1255,7 @@ class InferenceEngine:
         now = time.monotonic()
         if req.first_token_time is None:
             req.first_token_time = now
+            self.telemetry.on_first_token(req)
         req.output_token_ids.append(token)
         req.output_logprobs.append(logprob)
         slot.last_token = token
@@ -1254,6 +1281,7 @@ class InferenceEngine:
             req.finish_reason = reason
             req.finish_time = now
             self.finished.append(req)
+            self.telemetry.on_finished(req)
             self._release(slot)
             return True
         return False
@@ -1313,6 +1341,8 @@ class InferenceEngine:
             req.finish_reason = reason
             req.finish_time = time.monotonic()
             aborted.append(req)
+        for req in aborted:
+            self.telemetry.on_finished(req)
         return aborted
 
     def _preempt_youngest(self, exclude: _Slot) -> bool:
@@ -1324,6 +1354,7 @@ class InferenceEngine:
         req = victim.request
         req.num_preemptions += 1
         self.stats["preemptions"] += 1
+        self.telemetry.on_preempted(req)
         self.waiting.appendleft(req)
         self._release(victim)
         self.logger.info("preempted %s (recompute on readmit)", req.request_id)

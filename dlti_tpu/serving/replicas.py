@@ -28,6 +28,7 @@ from dlti_tpu.config import LoRAConfig, ModelConfig, ParallelConfig
 from dlti_tpu.serving.engine import (
     EngineConfig, GenerationResult, InferenceEngine, Request, SamplingParams,
 )
+from dlti_tpu.telemetry import RequestTelemetry
 
 
 class ReplicatedEngine:
@@ -57,6 +58,10 @@ class ReplicatedEngine:
                 f"devices, have {len(devices)}")
         from dlti_tpu.parallel.mesh import build_mesh
 
+        # One shared request-telemetry instance: every replica observes
+        # into the same TTFT/TPOT/queue-time histograms, so the fleet's
+        # latency distributions aggregate without a merge step.
+        self.telemetry = RequestTelemetry()
         self.engines: List[InferenceEngine] = []
         for r in range(replicas):
             group = devices[r * tensor:(r + 1) * tensor]
@@ -69,7 +74,7 @@ class ReplicatedEngine:
                           else jax.device_put(params, group[0]))
             self.engines.append(
                 InferenceEngine(model_cfg, rep_params, engine_cfg, lora_cfg,
-                                mesh=mesh))
+                                mesh=mesh, telemetry=self.telemetry))
         self._rr = 0
         # Own id namespace: each engine's req-N counter starts at 0, so
         # auto-ids from different replicas would collide in any id-keyed

@@ -32,7 +32,38 @@ from typing import Dict, List, Optional, Tuple
 from dlti_tpu.data.tokenizer import Tokenizer
 from dlti_tpu.serving.engine import InferenceEngine, Request
 from dlti_tpu.serving.sampling import SamplingParams
+from dlti_tpu.telemetry import MetricsRegistry, get_tracer
 from dlti_tpu.utils.logging import get_logger
+
+# /stats keys exposed as Prometheus gauges (point-in-time values); every
+# other numeric stat is a monotonic counter. Name-stability contract: the
+# exposition names are dlti_<key> — scraped by external dashboards, so keys
+# here and in the engine's stats dict must not be renamed.
+_GAUGE_KEYS = ("active_seqs", "waiting", "free_blocks")
+
+
+def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
+    """The single backing store for ``/stats`` and ``/metrics``: engine
+    counters ride in as a scalar-source callback (the engine's ``stats``
+    dict stays the source of truth — no registry lock on the decode path),
+    and the engine's request-lifecycle histograms (TTFT / TPOT / queue
+    time) register for exposition."""
+    registry = MetricsRegistry()
+
+    def _engine_scalars() -> dict:
+        eng = async_engine.engine
+        return {
+            **eng.stats,
+            "active_seqs": eng.num_active,
+            "waiting": len(eng.waiting),
+            "free_blocks": eng.block_manager.num_free,
+        }
+
+    registry.add_scalar_source(_engine_scalars, gauge_keys=_GAUGE_KEYS,
+                               prefix="dlti_")
+    for hist in async_engine.engine.telemetry.histograms():
+        registry.register(hist)
+    return registry
 
 
 def llama2_chat_prompt(messages: List[dict]) -> str:
@@ -202,6 +233,7 @@ class _Handler(BaseHTTPRequestHandler):
     async_engine: AsyncEngine
     tokenizer: Tokenizer
     cfg: ServerConfig
+    registry: "MetricsRegistry"
 
     def log_message(self, fmt, *args):  # route through our logger
         get_logger().debug("http: " + fmt, *args)
@@ -298,40 +330,36 @@ class _Handler(BaseHTTPRequestHandler):
             logprobs=bool(body.get("logprobs", False)),
         )
 
-    def _stats_dict(self) -> dict:
-        eng = self.async_engine.engine
-        return {
-            **eng.stats,
-            "active_seqs": eng.num_active,
-            "waiting": len(eng.waiting),
-            "free_blocks": eng.block_manager.num_free,
-        }
-
     # -- routes --------------------------------------------------------
     def do_GET(self):
         if self.path == "/health":
             self._json(200, {"status": "ok"})
         elif self.path == "/stats":
-            self._json(200, self._stats_dict())
+            # Raw engine counters/gauges + request-latency histogram
+            # summaries (count/sum/mean/p50/p90/p99), all served from the
+            # shared MetricsRegistry.
+            self._json(200, self.registry.stats_dict())
         elif self.path == "/metrics":
-            # Prometheus text exposition (vLLM-parity observability): the
-            # same counters/gauges /stats serves, scrapeable by a stock
-            # Prometheus without an adapter.
-            lines = []
-            for k, v in sorted(self._stats_dict().items()):
-                if isinstance(v, bool) or not isinstance(v, (int, float)):
-                    continue
-                name = f"dlti_{k}"
-                kind = ("gauge" if k in ("active_seqs", "waiting",
-                                         "free_blocks") else "counter")
-                lines += [f"# TYPE {name} {kind}", f"{name} {v}"]
-            body = ("\n".join(lines) + "\n").encode()
+            # Prometheus text exposition (vLLM-parity observability),
+            # rendered from the shared MetricsRegistry: the legacy
+            # dlti_<stat> counters/gauges byte-for-byte, plus the
+            # request-lifecycle histograms (TTFT/TPOT/queue time).
+            body = self.registry.render_prometheus().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debug/trace":
+            # Chrome-trace snapshot of the process-global span tracer
+            # (request lifecycle + engine step phases) — save the body
+            # and open it in Perfetto. 404 while tracing is disabled.
+            tracer = get_tracer()
+            if not tracer.enabled:
+                return self._error(404, "tracing disabled (start the "
+                                        "server with --trace-dir)")
+            self._json(200, tracer.to_dict())
         elif self.path == "/v1/models":
             self._json(200, {"object": "list", "data": [{
                 "id": self.cfg.model_name, "object": "model",
@@ -403,11 +431,21 @@ class _Handler(BaseHTTPRequestHandler):
                 # cache). A user seed derives per-choice seeds so the
                 # response stays reproducible without n identical samples.
                 subs = []
-                for i in range(n):
-                    p_i = params if params.seed is None else \
-                        dataclasses.replace(params, seed=params.seed + i)
-                    subs.append(self.async_engine.submit(
-                        prompt_ids, p_i, f"{rid}-{i}"))
+                try:
+                    for i in range(n):
+                        p_i = params if params.seed is None else \
+                            dataclasses.replace(params, seed=params.seed + i)
+                        subs.append(self.async_engine.submit(
+                            prompt_ids, p_i, f"{rid}-{i}"))
+                except Exception:
+                    # A submit failed mid-loop (e.g. the stepper parked
+                    # between choices): early-cancel every choice already
+                    # submitted, or they decode to max_tokens into queues
+                    # nobody reads — the orphan burn the disconnect/stop
+                    # cancels exist to prevent.
+                    for other, _ in subs:
+                        other.cancel_requested = True
+                    raise
         except ValueError as e:
             return self._error(400, str(e))
         except RuntimeError as e:  # engine parked after unrecoverable fault
@@ -662,9 +700,11 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     """Build (but don't start) the HTTP server; caller runs serve_forever()."""
     cfg = cfg or ServerConfig()
     async_engine = AsyncEngine(engine)
+    registry = build_registry(async_engine)
 
     handler = type("BoundHandler", (_Handler,), {
         "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
+        "registry": registry,
     })
     httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
     httpd.daemon_threads = True

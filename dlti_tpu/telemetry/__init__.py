@@ -1,0 +1,42 @@
+"""Unified telemetry layer: metrics registry + structured span tracing.
+
+One subsystem backing both planes' observability (previously scattered
+across a hand-rolled Prometheus emitter in ``serving/server.py``, the
+reference-parity CSV in ``utils/metrics.py``, ``StepTimer`` in
+``utils/logging.py``, and raw ``jax.profiler`` windows in ``trainer.py``):
+
+* :mod:`~dlti_tpu.telemetry.registry` — labeled counters / gauges /
+  histograms + Prometheus text exposition; the single backing store for
+  the server's ``/stats`` and ``/metrics`` endpoints.
+* :mod:`~dlti_tpu.telemetry.tracer` — bounded-ring host-side span tracer
+  (near-zero cost when disabled) exporting Chrome-trace JSON viewable in
+  Perfetto.
+* :mod:`~dlti_tpu.telemetry.lifecycle` — per-request lifecycle telemetry
+  for the serving engine (TTFT/TPOT/queue-time histograms + spans).
+* :mod:`~dlti_tpu.telemetry.steplog` — per-step JSONL stream for training
+  (superset of the reference CSV schema).
+* :mod:`~dlti_tpu.telemetry.heartbeat` — multi-host per-process
+  last-seen-step gauge (straggler visibility).
+"""
+
+from dlti_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    TPOT_BUCKETS,
+)
+from dlti_tpu.telemetry.tracer import (  # noqa: F401
+    SpanTracer,
+    configure_tracer,
+    get_tracer,
+)
+from dlti_tpu.telemetry.lifecycle import RequestTelemetry  # noqa: F401
+from dlti_tpu.telemetry.steplog import (  # noqa: F401
+    StepLogWriter,
+    jsonl_stream_columns,
+    metrics_csv_columns,
+    schedule_lr,
+)
+from dlti_tpu.telemetry.heartbeat import Heartbeat  # noqa: F401

@@ -1,0 +1,73 @@
+"""Multi-host heartbeat: per-process last-seen step, aggregated at rank 0.
+
+Straggler visibility for ``jax.distributed`` runs (the MegaScale
+per-rank-instrumentation idea at its smallest useful size): every process
+calls :meth:`Heartbeat.beat` at the same step cadence — it is a collective
+(``process_allgather``) on multi-host meshes, so the call sites must be
+step-synchronous, which the trainer's bookkeeping loop already is — and
+rank 0 keeps a ``{process_index: (step, wall_time)}`` map it can expose as
+labeled gauges (``dlti_heartbeat_last_step{process="N"}``) and turn into a
+straggler report.
+
+Single-process runs degrade to a local map update (no collective, no jax
+import cost beyond the first call)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+
+class Heartbeat:
+    def __init__(self, registry=None):
+        # process_index -> (last step, wall time it was reported)
+        self.last_seen: Dict[int, Tuple[int, float]] = {}
+        if registry is not None:
+            self.register(registry)
+
+    def register(self, registry) -> None:
+        """Expose per-process last-seen steps as labeled gauges."""
+        self._gauge = registry.gauge(
+            "dlti_heartbeat_last_step",
+            help="last training step each process reported (rank-0 view)")
+
+    def beat(self, step: int) -> Dict[int, Tuple[int, float]]:
+        """Report this process's step; COLLECTIVE on multi-host meshes
+        (every process must call with the same cadence). Returns the
+        rank-0 aggregated map (local map elsewhere)."""
+        import jax
+
+        now = time.time()
+        if jax.process_count() == 1:
+            self.last_seen[0] = (int(step), now)
+        else:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            local = np.asarray([jax.process_index(), int(step)], np.int64)
+            gathered = np.asarray(
+                multihost_utils.process_allgather(local)).reshape(-1, 2)
+            for proc, st in gathered:
+                self.last_seen[int(proc)] = (int(st), now)
+        gauge = getattr(self, "_gauge", None)
+        if gauge is not None:
+            for proc, (st, _) in self.last_seen.items():
+                gauge.labels(process=str(proc)).set(st)
+        return self.last_seen
+
+    def lag(self) -> int:
+        """Max step spread across processes (0 = all in lockstep)."""
+        if not self.last_seen:
+            return 0
+        steps = [st for st, _ in self.last_seen.values()]
+        return max(steps) - min(steps)
+
+    def straggler_report(self) -> Optional[str]:
+        """Human-readable lag summary, or None when in lockstep."""
+        if self.lag() == 0:
+            return None
+        head = max(st for st, _ in self.last_seen.values())
+        behind = {p: head - st for p, (st, _) in self.last_seen.items()
+                  if st < head}
+        parts = ", ".join(f"proc {p}: -{d}" for p, d in sorted(behind.items()))
+        return f"stragglers behind step {head}: {parts}"

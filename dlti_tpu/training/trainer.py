@@ -15,6 +15,7 @@ One class drives what the reference spreads across four scripts
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Iterable, Optional
 
@@ -28,6 +29,9 @@ from dlti_tpu.models import LlamaForCausalLM, count_params
 # not cycle back into the half-initialized parallel package.
 from dlti_tpu.parallel.mesh import build_mesh
 from dlti_tpu.parallel.sharding import make_sharded_train_step, shard_train_state
+from dlti_tpu.telemetry import (
+    Heartbeat, StepLogWriter, configure_tracer, get_tracer, schedule_lr,
+)
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
 from dlti_tpu.training.step import make_train_step
@@ -180,6 +184,11 @@ class Trainer:
         # request_stop(); honored at the next step boundary.
         self._stop_requested = False
         self._last_eval_loss = float("nan")
+        # Host-side span tracer (telemetry.tracer): per-step phase spans
+        # (batch fetch, host→device, dispatch, device sync, eval, save).
+        # Disabled by default; cfg.telemetry.trace_dir enables it in
+        # train() — span sites cost one attribute read while disabled.
+        self._tracer = get_tracer()
 
     # ------------------------------------------------------------------
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -403,6 +412,30 @@ class Trainer:
         tokens_per_step = (
             cfg.train.micro_batch_size * cfg.train.grad_accum_steps * cfg.data.max_seq_len
         )
+
+        # -- unified telemetry (dlti_tpu.telemetry) ---------------------
+        tcfg = cfg.telemetry
+        if tcfg.trace_dir:
+            self._tracer = configure_tracer(enabled=True,
+                                            capacity=tcfg.trace_capacity)
+        tracer = self._tracer
+        steplog = None
+        if tcfg.step_log_path and is_main_process():
+            steplog = StepLogWriter(tcfg.step_log_path, run_meta={
+                "experiment": experiment_name_from_config(cfg),
+                "num_gpus": cfg.parallel.num_devices,
+                "zero_stage": int(cfg.parallel.zero_stage),
+                "strategy": self._strategy(),
+            })
+        heartbeat = None
+        if tcfg.heartbeat_interval_steps > 0:
+            heartbeat = Heartbeat()
+        # Constants for the per-step MFU/throughput fields (same terms
+        # _final_metrics uses for the run-level record).
+        peak_flops = detect_chip_peak_flops() if steplog is not None else 0.0
+        n_for_flops = (cfg.model.num_active_params()
+                       if cfg.model.num_experts > 0 else total)
+
         losses: list = []
         global_step = start_step
         samples_seen = 0
@@ -480,13 +513,16 @@ class Trainer:
             """Classic path: one compiled call + host sync per step."""
             executed = []
             for hb, gb, r in items:
-                if step_fn_warm["done"]:
-                    with timer.measure():
-                        state, m = step_fn(state, gb, r)
-                        m = jax.device_get(m)  # blocks: true step time
-                else:
+                warm = step_fn_warm["done"]
+                if warm:
+                    timer.start()
+                with tracer.span("train/step_dispatch", cat="train"):
                     state, m = step_fn(state, gb, r)
-                    m = jax.device_get(m)
+                with tracer.span("train/device_sync", cat="train"):
+                    m = jax.device_get(m)  # blocks: true step time
+                if warm:
+                    timer.stop()
+                else:
                     step_fn_warm["done"] = True
                 executed.append((hb, r, m))
             return state, executed
@@ -505,8 +541,11 @@ class Trainer:
                        for key in window[0][0]}
             rngs = jnp.stack([r for _, _, r in window])
             with timer.measure(steps=k):
-                state, mstack = multi_fn(state, stacked, rngs)
-                mstack = jax.device_get(mstack)
+                with tracer.span("train/step_dispatch", cat="train",
+                                 window=k):
+                    state, mstack = multi_fn(state, stacked, rngs)
+                with tracer.span("train/device_sync", cat="train"):
+                    mstack = jax.device_get(mstack)
             executed = [(window[i][0], window[i][2],
                          {key: v[i] for key, v in mstack.items()})
                         for i in range(k)]
@@ -542,6 +581,28 @@ class Trainer:
                     # global array's shards span other hosts' devices
                     # and cannot be fetched here.
                     recorder.record(global_step, hb, r, m)
+                if steplog is not None:
+                    # Per-step JSONL telemetry (rank-0): the MegaScale-
+                    # style in-framework stream. Window-executed steps
+                    # share the window's per-step time.
+                    dt = timer.last_step_seconds
+                    tok_s_chip = (tokens_per_step / dt
+                                  / max(jax.device_count(), 1)
+                                  if dt > 0 else 0.0)
+                    peak_gb, peak_src = device_peak_memory()
+                    steplog.log_step(
+                        global_step,
+                        loss=losses[-1],
+                        grad_norm=float(m["grad_norm"]),
+                        lr=schedule_lr(cfg.optimizer, global_step),
+                        tokens_per_second_per_chip=round(tok_s_chip, 2),
+                        mfu_percent=round(compute_mfu(
+                            tok_s_chip, n_for_flops, peak_flops,
+                            trainable_params=trainable), 4),
+                        peak_memory_gb=round(peak_gb, 4),
+                        peak_memory_source=peak_src,
+                        step_time_s=round(dt, 6),
+                    )
                 if global_step % cfg.train.logging_steps == 0 and is_main_process():
                     self.logger.info(
                         "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s | %.0f tok/s/chip",
@@ -550,6 +611,17 @@ class Trainer:
                         timer.steps_per_second * tokens_per_step
                         / max(jax.device_count(), 1),
                     )
+            if heartbeat is not None and (
+                    global_step // tcfg.heartbeat_interval_steps
+                    > step_before // tcfg.heartbeat_interval_steps):
+                # COLLECTIVE on multi-host meshes: every process reaches
+                # this boundary at the same global_step (the loop is
+                # step-synchronous), so the allgather lines up.
+                heartbeat.beat(global_step)
+                if is_main_process():
+                    report = heartbeat.straggler_report()
+                    if report:
+                        self.logger.warning("heartbeat: %s", report)
             if (eval_fn is not None and cfg.train.eval_steps
                     and (global_step // cfg.train.eval_steps
                          > step_before // cfg.train.eval_steps)):
@@ -557,9 +629,18 @@ class Trainer:
             self._maybe_save(state, global_step, epoch_end=False,
                              crossed_from=step_before)
 
+        _EPOCH_END = object()  # sentinel: a batch is never this object
         try:
             for epoch in range(start_epoch, cfg.train.num_epochs):
-                for batch in epoch_batches(epoch):
+                batch_iter = iter(epoch_batches(epoch))
+                while True:
+                    # Manual iteration so the data-pipeline wait is its
+                    # own trace span (the phase MegaScale singles out:
+                    # input stalls masquerade as slow steps otherwise).
+                    with tracer.span("train/batch_fetch", cat="train"):
+                        batch = next(batch_iter, _EPOCH_END)
+                    if batch is _EPOCH_END:
+                        break
                     # A pending window always has len < take <= remaining
                     # step budget (it drains the moment it reaches take),
                     # so this check never skips queued-but-unrun steps.
@@ -582,7 +663,9 @@ class Trainer:
                     if self.mesh is not None:
                         from dlti_tpu.parallel.sharding import make_global_batch
 
-                        batch = make_global_batch(batch, cfg, self.mesh)
+                        with tracer.span("train/host_to_device",
+                                         cat="train"):
+                            batch = make_global_batch(batch, cfg, self.mesh)
                     rng, step_rng = jax.random.split(rng)
                     if multi_fn is None:
                         state, executed = exec_steps(
@@ -666,6 +749,18 @@ class Trainer:
             losses, wall, samples_seen, tokens_per_step, global_step - start_step,
             trainable, total, timer,
         )
+        if steplog is not None:
+            # The final record is the full MetricsRecord dict, which keeps
+            # the JSONL stream a superset of the reference CSV schema.
+            steplog.log_final(record)
+            steplog.close()
+        if tcfg.trace_dir and is_main_process():
+            trace_path = tracer.export(os.path.join(
+                tcfg.trace_dir,
+                f"trace_train_steps_{start_step}-{global_step}.json"))
+            self.logger.info(
+                "telemetry trace -> %s (open in https://ui.perfetto.dev)",
+                trace_path)
         if is_main_process():
             print_metrics_summary(record)
             save_training_metrics(record, csv_path=cfg.train.metrics_csv)
@@ -686,13 +781,14 @@ class Trainer:
             state = state.replace(
                 params=jax.device_put(state.params, dev_sh))
         losses, toks = [], 0.0
-        for batch in eval_dataset.epoch(0):
-            flat = {
-                k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()
-            }  # eval ignores the accum dim
-            m = jax.device_get(eval_fn(state, flat))
-            losses.append(float(m["loss"]) * float(m["num_tokens"]))
-            toks += float(m["num_tokens"])
+        with self._tracer.span("train/eval", cat="train", step=step):
+            for batch in eval_dataset.epoch(0):
+                flat = {
+                    k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()
+                }  # eval ignores the accum dim
+                m = jax.device_get(eval_fn(state, flat))
+                losses.append(float(m["loss"]) * float(m["num_tokens"]))
+                toks += float(m["num_tokens"])
         eval_loss = sum(losses) / toks if toks else float("nan")
         if toks and is_main_process():
             self.logger.info("eval @ step %d | loss %.4f", step, eval_loss)
@@ -720,10 +816,21 @@ class Trainer:
             return
         from dlti_tpu.checkpoint import save_train_state
 
-        save_train_state(
-            cfg.output_dir, step, state,
-            keep=cfg.save_total_limit, async_save=cfg.async_save,
-        )
+        with self._tracer.span("train/checkpoint_save", cat="train",
+                               step=step):
+            save_train_state(
+                cfg.output_dir, step, state,
+                keep=cfg.save_total_limit, async_save=cfg.async_save,
+            )
+
+    def _strategy(self) -> str:
+        """Strategy label for the reference CSV / telemetry stream."""
+        par = self.cfg.parallel
+        if par.pipe > 1:
+            return f"pipe{par.pipe}"
+        if int(par.zero_stage) == 0:
+            return "baseline"
+        return f"zero{int(par.zero_stage)}"
 
     def _final_metrics(
         self, losses, wall, samples_seen, tokens_per_step, steps, trainable, total, timer,
@@ -745,11 +852,7 @@ class Trainer:
             experiment=experiment_name_from_config(cfg),
             num_gpus=cfg.parallel.num_devices,
             zero_stage=int(cfg.parallel.zero_stage),
-            strategy=(
-                f"pipe{cfg.parallel.pipe}" if cfg.parallel.pipe > 1
-                else "baseline" if int(cfg.parallel.zero_stage) == 0
-                else f"zero{int(cfg.parallel.zero_stage)}"
-            ),
+            strategy=self._strategy(),
             training_time_hours=wall / 3600.0,
             samples_per_second=sps,
             peak_memory_gb=peak_gb,

@@ -43,6 +43,10 @@ class StepTimer:
         self._times: list = []
         self._t0: float | None = None
         self._count = 0
+        # Most recent measured per-step time, warm-up included (the
+        # per-step telemetry stream wants every step's own time, not the
+        # smoothed mean the throughput summary uses).
+        self.last_step_seconds = 0.0
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -57,6 +61,7 @@ class StepTimer:
         steps = max(steps, 1)
         warm = self._count < self.warmup_steps
         self._count += steps
+        self.last_step_seconds = dt / steps
         if not warm:
             self._times.append(dt / steps)
 

@@ -19,6 +19,18 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
+# The reference repo's CSV schema (``training/train_baseline.py:246-255``)
+# — the byte-compatible column set MetricsRecord starts from. The parity
+# contract: every metrics surface we add (the CSV extensions below, the
+# telemetry per-step JSONL stream) must stay a SUPERSET of these columns
+# so the reference's analysis workflow keeps porting directly (guarded by
+# tests/test_telemetry.py).
+REFERENCE_CSV_COLUMNS = (
+    "experiment", "num_gpus", "zero_stage", "strategy",
+    "training_time_hours", "samples_per_second", "peak_memory_gb",
+    "final_loss",
+)
+
 # v5e: 197 TFLOP/s bf16 per chip; v5p: 459; v4: 275. Used for MFU.
 # NOTE: ordered most-specific-first — the lookup scans in insertion order and
 # e.g. "v5" is a substring of every v5p device_kind.

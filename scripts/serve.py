@@ -85,6 +85,15 @@ def parse_args():
                         "decision")
     p.add_argument("--spec-cooldown", type=int, default=32,
                    help="engine rounds the gate pauses proposing for")
+    p.add_argument("--trace-dir", default="",
+                   help="enable the host-side span tracer (per-request "
+                        "lifecycle + engine step phases) and export a "
+                        "Chrome-trace JSON here on shutdown; a live "
+                        "snapshot is served at GET /debug/trace. Open "
+                        "either in Perfetto (ui.perfetto.dev)")
+    p.add_argument("--trace-capacity", type=int, default=65536,
+                   help="span ring-buffer capacity (most recent events "
+                        "kept; a long-lived server never grows past it)")
     return p.parse_args()
 
 
@@ -102,6 +111,15 @@ def main() -> None:
     )
 
     tok = get_tokenizer(args.tokenizer)
+
+    tracer = None
+    if args.trace_dir:
+        from dlti_tpu.telemetry import configure_tracer
+
+        # Enable BEFORE the engine is built so its lifecycle hooks see an
+        # enabled tracer from the first request.
+        tracer = configure_tracer(enabled=True,
+                                  capacity=args.trace_capacity)
 
     if args.model_dir:
         from dlti_tpu.checkpoint import load_exported_model
@@ -158,7 +176,13 @@ def main() -> None:
     print(f"decode programs ready in {time.time() - t0:.0f}s")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
-    serve(engine, tok, sc)
+    try:
+        serve(engine, tok, sc)
+    finally:
+        if tracer is not None:
+            path = tracer.export(os.path.join(
+                args.trace_dir, f"trace_serve_{os.getpid()}.json"))
+            print(f"telemetry trace -> {path} (open in ui.perfetto.dev)")
 
 
 if __name__ == "__main__":

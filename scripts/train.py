@@ -151,6 +151,21 @@ def parse_args():
                    help="capture a jax.profiler trace window here (XProf)")
     p.add_argument("--profile-start-step", type=int, default=10)
     p.add_argument("--profile-num-steps", type=int, default=3)
+    # Unified telemetry (dlti_tpu.telemetry) — host-side, always-available
+    # complement to the jax.profiler device traces above.
+    p.add_argument("--trace-dir", default="",
+                   help="export a host-side span trace (per-step phases: "
+                        "batch fetch, host→device, dispatch, sync, eval, "
+                        "save) as Chrome-trace JSON here; open in Perfetto")
+    p.add_argument("--trace-capacity", type=int, default=65536,
+                   help="span ring-buffer capacity (most recent events kept)")
+    p.add_argument("--step-log", default="",
+                   help="per-step JSONL telemetry stream (rank-0): step, "
+                        "loss, grad_norm, lr, tok/s/chip, MFU, HBM peak — "
+                        "a superset of the reference CSV columns")
+    p.add_argument("--heartbeat-interval", type=int, default=0,
+                   help="multi-host heartbeat cadence in steps (rank 0 "
+                        "logs straggler lag; 0 = off)")
     return p.parse_args()
 
 
@@ -188,7 +203,7 @@ def build_config(args):
 
     from dlti_tpu.config import (
         CheckpointConfig, DataConfig, LoRAConfig, OptimizerConfig,
-        TrainConfig, ZeROStage, preset,
+        TelemetryConfig, TrainConfig, ZeROStage, preset,
     )
 
     cfg = preset(args.preset, model=args.model)
@@ -300,6 +315,11 @@ def build_config(args):
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,
                           profile_num_steps=args.profile_num_steps),
+        telemetry=TelemetryConfig(
+            trace_dir=args.trace_dir,
+            trace_capacity=args.trace_capacity,
+            step_log_path=args.step_log,
+            heartbeat_interval_steps=args.heartbeat_interval),
         experiment_name=create_experiment_name(
             par.num_devices, int(par.zero_stage)),
     )

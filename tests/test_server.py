@@ -277,6 +277,43 @@ def test_n_choices(live_server):
     assert status == 400
 
 
+def test_n_choices_submit_fault_cancels_submitted(live_server):
+    """ADVICE r05 orphan-burn fix: when a submit raises mid-loop for
+    n > 1, every already-submitted choice gets cancel_requested set —
+    they must not decode to max_tokens into queues nobody reads."""
+    host, port = live_server
+    # Reach the handler class and its AsyncEngine through the live server
+    # (the BoundHandler type holds them as class attributes).
+    import dlti_tpu.serving.server as server_mod
+
+    # Fetch the async_engine via a throwaway request? Not needed: the
+    # fixture's engine is reachable through the module-level make_server
+    # wiring only, so patch at the AsyncEngine class level instead —
+    # fail the SECOND submit of an n=3 request, then restore.
+    orig_submit = server_mod.AsyncEngine.submit
+    state = {"calls": 0, "submitted": []}
+
+    def flaky_submit(self, prompt_ids, params, request_id=None):
+        state["calls"] += 1
+        if state["calls"] == 2:
+            raise RuntimeError("injected: stepper parked mid-loop")
+        req, q = orig_submit(self, prompt_ids, params, request_id)
+        state["submitted"].append(req)
+        return req, q
+
+    server_mod.AsyncEngine.submit = flaky_submit
+    try:
+        status, d = _post(host, port, "/v1/completions",
+                          {"prompt": "abcdef", "max_tokens": 64,
+                           "temperature": 1.0, "n": 3})
+    finally:
+        server_mod.AsyncEngine.submit = orig_submit
+    assert status == 503, d
+    assert len(state["submitted"]) == 1
+    assert state["submitted"][0].cancel_requested, \
+        "already-submitted choice left decoding after mid-loop fault"
+
+
 def test_chat_completions(live_server):
     host, port = live_server
     status, data = _post(host, port, "/v1/chat/completions", {
@@ -346,17 +383,52 @@ def test_llama2_chat_template():
     assert s == "[INST] <<SYS>>\nSYS\n<</SYS>>\n\nQ1 [/INST] A1 [INST] Q2 [/INST]"
 
 
-def test_loadgen_against_live_server(live_server):
+@pytest.fixture(scope="module")
+def id_tok_server():
+    """A server whose tokenizer renders EVERY sampled id as visible text
+    (IdTokenizer — built for exactly this: a random-weight model's argmax
+    ids exceed the byte tokenizer's printable range, so ByteTokenizer
+    suppresses every SSE delta and zeroes streaming TTFT/TPOT)."""
+    from dlti_tpu.data.tokenizer import IdTokenizer
+
+    model = LlamaForCausalLM(CFG, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=128,
+                      max_model_len=128, cache_dtype="float32",
+                      eos_token_id=-1)
+    engine = InferenceEngine(CFG, params, ec)
+    httpd, async_engine = make_server(
+        engine, IdTokenizer(vocab_size=CFG.vocab_size),
+        ServerConfig(host="127.0.0.1", port=0,
+                     default_params=SamplingParams(max_tokens=8)))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield "127.0.0.1", port
+    httpd.shutdown()
+    async_engine.shutdown()
+    httpd.server_close()
+
+
+def test_loadgen_against_live_server(id_tok_server):
     from dlti_tpu.benchmarks import LoadGenConfig, run_load_test
 
-    host, port = live_server
+    host, port = id_tok_server
     report = run_load_test(LoadGenConfig(
         host=host, port=port, num_requests=8, concurrency=4,
-        max_tokens=4, stream=True, prompt="bench", timeout_s=120))
+        max_tokens=4, stream=True, prompt="bench", timeout_s=120,
+        scrape_server_metrics=True))
     assert report.num_ok == 8, report.errors
     assert report.output_tokens_per_s > 0
     assert report.ttft_p50_s > 0
     assert report.latency_p99_s >= report.latency_p50_s
+    # On-engine histograms rode back with the report: the engine observed
+    # every request's TTFT and queue time itself.
+    ttft = report.server_histograms["dlti_request_ttft_seconds"]
+    assert ttft["count"] >= 8 and ttft["mean"] > 0
+    assert report.server_histograms["dlti_request_queue_time_seconds"][
+        "count"] >= 8
 
     # Non-streaming path exercises usage-based token accounting.
     report = run_load_test(LoadGenConfig(
