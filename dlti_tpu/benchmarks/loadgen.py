@@ -48,6 +48,18 @@ class LoadGenConfig:
     # before admission) is visible in one report. Off by default: the
     # target may not expose dlti_* metrics.
     scrape_server_metrics: bool = False
+    # Multi-tenant workload: > 0 spreads requests round-robin over
+    # synthetic tenants "tenant-0".."tenant-N-1" via the X-Tenant header
+    # (the admission gateway's per-tenant rate limits and fair dequeue
+    # see N distinct principals). 0 = no tenant header.
+    tenants: int = 0
+    # Priority workload mix, "interactive:0.8,batch:0.2" — each request
+    # draws its class from this distribution (seeded) and sends it in the
+    # body. "" = no priority field (server default class).
+    priority_mix: str = ""
+    # Per-request queued-deadline (seconds) sent as body deadline_s when
+    # > 0; a gateway sheds past-deadline queued requests with 503.
+    deadline_s: float = 0.0
 
 
 @dataclass
@@ -58,6 +70,16 @@ class RequestRecord:
     output_tokens: int = 0
     ok: bool = False
     error: str = ""
+    status: int = 0          # HTTP status (0 = transport failure)
+    tenant: str = ""
+    priority: str = ""
+
+    @property
+    def shed(self) -> bool:
+        """Load intentionally refused by the server (gateway 429 queue
+        bound / rate limit, 503 drain or queued-deadline shed) — reported
+        separately from real errors."""
+        return self.status in (429, 503)
 
     @property
     def latency(self) -> float:
@@ -82,6 +104,14 @@ class LoadReport:
     ttft_p90_s: float = 0.0
     ttft_p99_s: float = 0.0
     tpot_mean_ms: float = 0.0
+    # Gateway shed accounting: 429/503 refusals are deliberate
+    # load-shedding, counted apart from num_ok and from real errors.
+    num_shed: int = 0
+    shed_rate: float = 0.0
+    # Per-priority-class latency breakdown ({class: {count, ok, shed,
+    # ttft_p50_s, ttft_p90_s, ttft_p99_s, tpot_mean_ms, latency_p50_s,
+    # latency_p99_s}}); empty without a priority mix.
+    per_class: dict = field(default_factory=dict)
     errors: List[str] = field(default_factory=list)
     # Server-side histogram summaries ({metric: {count, sum, mean}}) when
     # cfg.scrape_server_metrics is set; empty otherwise.
@@ -137,7 +167,8 @@ async def _iter_body(reader, headers: dict, timeout_s: float):
 
 
 async def _http_post_sse(host: str, port: int, path: str, body: dict,
-                         rec: RequestRecord, timeout_s: float) -> None:
+                         rec: RequestRecord, timeout_s: float,
+                         extra_headers: Optional[dict] = None) -> None:
     """POST; if the response is SSE, count data chunks and stamp TTFT."""
     writer = None
     try:
@@ -145,8 +176,10 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             asyncio.open_connection(host, port), timeout_s
         )
         payload = json.dumps(body).encode()
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
         req = (f"POST {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
-               f"Content-Type: application/json\r\n"
+               f"Content-Type: application/json\r\n{extra}"
                f"Content-Length: {len(payload)}\r\nConnection: close\r\n\r\n"
                ).encode() + payload
         writer.write(req)
@@ -158,6 +191,7 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             rec.error = f"malformed/empty status line: {status_line[:80]!r}"
             return
         status = int(parts[1])
+        rec.status = status
         headers = {}
         while True:
             line = await asyncio.wait_for(reader.readline(), timeout_s)
@@ -279,7 +313,29 @@ async def _scrape_histograms(host: str, port: int,
     return hists
 
 
-def _build_body(cfg: LoadGenConfig, rng: random.Random) -> Tuple[str, dict]:
+def parse_priority_mix(spec: str) -> List[Tuple[str, float]]:
+    """"interactive:0.8,batch:0.2" -> [("interactive", 0.8), ...]."""
+    out: List[Tuple[str, float]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            raise ValueError(f"bad priority mix entry {part!r} "
+                             f"(expected class:weight)")
+        if weight < 0:
+            raise ValueError(f"priority weight must be >= 0: {part!r}")
+        out.append((name.strip(), weight))
+    return out
+
+
+def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
+                mix: List[Tuple[str, float]],
+                ) -> Tuple[str, dict, dict, str, str]:
+    """-> (path, body, extra_headers, tenant, priority) for request idx."""
     prompt = rng.choice(cfg.prompts) if cfg.prompts else cfg.prompt
     if cfg.chat:
         path = "/v1/chat/completions"
@@ -289,38 +345,76 @@ def _build_body(cfg: LoadGenConfig, rng: random.Random) -> Tuple[str, dict]:
         body = {"prompt": prompt}
     body.update({"max_tokens": cfg.max_tokens, "temperature": cfg.temperature,
                  "stream": cfg.stream})
-    return path, body
+    headers: dict = {}
+    tenant = priority = ""
+    if cfg.tenants > 0:
+        tenant = f"tenant-{idx % cfg.tenants}"
+        headers["X-Tenant"] = tenant
+    if mix:
+        priority = rng.choices([m[0] for m in mix],
+                               weights=[m[1] for m in mix])[0]
+        body["priority"] = priority
+    if cfg.deadline_s and cfg.deadline_s > 0:
+        body["deadline_s"] = cfg.deadline_s
+    return path, body, headers, tenant, priority
+
+
+def _class_summary(recs: List[RequestRecord]) -> dict:
+    ok = [r for r in recs if r.ok]
+    lat = [r.latency for r in ok]
+    ttfts = [r.ttft for r in ok if r.ttft is not None]
+    tpots_ms = [
+        (r.latency - r.ttft) / max(1, r.output_tokens - 1) * 1000
+        for r in ok if r.ttft is not None and r.output_tokens > 1
+    ]
+    return {
+        "count": len(recs),
+        "ok": len(ok),
+        "shed": sum(1 for r in recs if r.shed),
+        "latency_p50_s": round(_percentile(lat, 50), 4),
+        "latency_p99_s": round(_percentile(lat, 99), 4),
+        "ttft_p50_s": round(_percentile(ttfts, 50), 4),
+        "ttft_p90_s": round(_percentile(ttfts, 90), 4),
+        "ttft_p99_s": round(_percentile(ttfts, 99), 4),
+        "tpot_mean_ms": (round(sum(tpots_ms) / len(tpots_ms), 2)
+                         if tpots_ms else 0.0),
+    }
 
 
 async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     rng = random.Random(cfg.seed)
+    mix = parse_priority_mix(cfg.priority_mix)
     records: List[RequestRecord] = []
     sem = asyncio.Semaphore(cfg.concurrency)
 
-    async def one() -> None:
+    async def one(idx: int) -> None:
         async with sem:
-            path, body = _build_body(cfg, rng)
-            rec = RequestRecord(start=time.monotonic())
+            path, body, headers, tenant, priority = _build_body(
+                cfg, rng, idx, mix)
+            rec = RequestRecord(start=time.monotonic(), tenant=tenant,
+                                priority=priority)
             records.append(rec)
-            await _http_post_sse(cfg.host, cfg.port, path, body, rec, cfg.timeout_s)
+            await _http_post_sse(cfg.host, cfg.port, path, body, rec,
+                                 cfg.timeout_s, extra_headers=headers)
 
     t0 = time.monotonic()
     if cfg.qps:
         # Open loop: Poisson arrivals; concurrency still caps in-flight.
         tasks = []
-        for _ in range(cfg.num_requests):
-            tasks.append(asyncio.create_task(one()))
+        for i in range(cfg.num_requests):
+            tasks.append(asyncio.create_task(one(i)))
             await asyncio.sleep(rng.expovariate(cfg.qps))
         await asyncio.gather(*tasks, return_exceptions=True)
     else:
         # Closed loop: `concurrency` users issuing back-to-back requests.
-        await asyncio.gather(*(one() for _ in range(cfg.num_requests)),
+        await asyncio.gather(*(one(i) for i in range(cfg.num_requests)),
                              return_exceptions=True)
     duration = time.monotonic() - t0
     server_hists = (await _scrape_histograms(cfg.host, cfg.port)
                     if cfg.scrape_server_metrics else {})
 
     ok = [r for r in records if r.ok]
+    shed = [r for r in records if r.shed]
     lat = [r.latency for r in ok]
     ttfts = [r.ttft for r in ok if r.ttft is not None]
     total_out = sum(r.output_tokens for r in ok)
@@ -328,6 +422,11 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         (r.latency - r.ttft) / max(1, r.output_tokens - 1) * 1000
         for r in ok if r.ttft is not None and r.output_tokens > 1
     ]
+    per_class = {}
+    if mix:
+        for cls in {m[0] for m in mix}:
+            per_class[cls] = _class_summary(
+                [r for r in records if r.priority == cls])
     return LoadReport(
         num_requests=len(records),
         num_ok=len(ok),
@@ -341,7 +440,13 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         ttft_p90_s=round(_percentile(ttfts, 90), 4),
         ttft_p99_s=round(_percentile(ttfts, 99), 4),
         tpot_mean_ms=round(sum(tpots_ms) / len(tpots_ms), 2) if tpots_ms else 0.0,
-        errors=[r.error for r in records if r.error][:10],
+        num_shed=len(shed),
+        shed_rate=round(len(shed) / len(records), 4) if records else 0.0,
+        per_class=per_class,
+        # Shed refusals are deliberate back-pressure, not errors; keep the
+        # error list for real failures so a bounded-queue burst doesn't
+        # read as a broken server.
+        errors=[r.error for r in records if r.error and not r.shed][:10],
         server_histograms=server_hists,
     )
 

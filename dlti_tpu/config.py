@@ -340,6 +340,52 @@ class TelemetryConfig:
 
 
 @dataclass(frozen=True)
+class GatewayConfig:
+    """Admission gateway (``dlti_tpu.serving.gateway``): the scheduling
+    front-end between the HTTP layer and the engine(s). Disabled by default
+    — the server then admits directly into the engine, byte-for-byte the
+    legacy behavior."""
+
+    enabled: bool = False
+    # Bounded admission queue: overflow is rejected with HTTP 429 +
+    # Retry-After instead of growing without limit. 0 queued tokens = no
+    # token bound (request-count bound still applies).
+    max_queued_requests: int = 256
+    max_queued_tokens: int = 0
+    # Per-tenant token-bucket rate limiting (requests/s, sustained). 0 =
+    # off. Burst is the bucket capacity; 0 derives max(1, 2*rps).
+    rate_limit_rps: float = 0.0
+    rate_limit_burst: float = 0.0
+    # Weighted fair dequeue across tenants: "tenantA:4,tenantB:1" gives
+    # tenantA 4x tenantB's dequeue share under contention. Unlisted
+    # tenants weigh 1.
+    tenant_weights: str = ""
+    default_tenant: str = "default"
+    # Retry-After value (seconds) for queue-bound rejections (rate-limit
+    # rejections compute their own from the bucket deficit).
+    retry_after_s: float = 1.0
+    # Replica failover: how many times one request may be resubmitted onto
+    # a surviving replica after its replica's step() faulted.
+    max_retries: int = 2
+    # Graceful drain: seconds SIGTERM waits for in-flight requests before
+    # the server exits anyway.
+    drain_grace_s: float = 30.0
+    # Deterministic chaos hook: "REPLICA:STEP" kills replica REPLICA by
+    # raising on its STEP-th step() call (1-based). Also settable via env
+    # DLTI_GATEWAY_FAULT_INJECT; tests and chaos runs use it to exercise
+    # failover without a real device fault.
+    fault_inject_step: str = ""
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Serving-side config block (engine sizing stays in
+    ``serving.engine.EngineConfig``; this holds the layers above it)."""
+
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
+
+
+@dataclass(frozen=True)
 class Config:
     """Root config."""
 
@@ -351,6 +397,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     experiment_name: str = ""
 
     def replace(self, **kwargs: Any) -> "Config":
@@ -385,13 +432,14 @@ class Config:
                 f = fields[k]
                 if dataclasses.is_dataclass(f.type) or f.name in (
                     "model", "lora", "optimizer", "parallel", "data",
-                    "checkpoint", "train", "telemetry",
+                    "checkpoint", "train", "telemetry", "serving", "gateway",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
                         "optimizer": OptimizerConfig, "parallel": ParallelConfig,
                         "data": DataConfig, "checkpoint": CheckpointConfig,
                         "train": TrainConfig, "telemetry": TelemetryConfig,
+                        "serving": ServingConfig, "gateway": GatewayConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

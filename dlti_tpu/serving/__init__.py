@@ -12,6 +12,10 @@ from scratch for TPU:
   top-k / top-p)
 * :mod:`dlti_tpu.serving.engine` — continuous-batching inference engine:
   bucketed prefill + single-token batched decode, one compiled program each
+* :mod:`dlti_tpu.serving.gateway` — admission gateway: bounded queues,
+  per-tenant rate limits, priority/deadline scheduling, graceful drain
+* :mod:`dlti_tpu.serving.replicas` — data-parallel engine replicas with
+  fault isolation and retry-capped failover
 * :mod:`dlti_tpu.serving.server` — OpenAI-compatible HTTP server
 """
 
@@ -24,6 +28,11 @@ from dlti_tpu.serving.engine import (  # noqa: F401
     Request,
 )
 from dlti_tpu.serving.replicas import ReplicatedEngine  # noqa: F401
+from dlti_tpu.serving.gateway import (  # noqa: F401
+    AdmissionError,
+    AdmissionGateway,
+    GatewayRequest,
+)
 from dlti_tpu.serving.server import (  # noqa: F401
     ServerConfig,
     make_server,

@@ -150,6 +150,17 @@ class Request:
     num_preemptions: int = 0
     # Which replica owns this request (set by ReplicatedEngine.submit).
     replica: int = 0
+    # Failover resubmissions consumed (ReplicatedEngine moves a dead
+    # replica's requests onto survivors up to a retry cap).
+    num_retries: int = 0
+    # Admission metadata (set by the gateway when one is configured; the
+    # engine itself schedules FCFS and ignores them).
+    tenant: str = ""
+    priority: str = ""
+    # Absolute monotonic deadline (None = none). The gateway sheds queued
+    # requests past it before prefill and flips cancel_requested on
+    # in-flight ones.
+    deadline: Optional[float] = None
     # When the request was first admitted into a decode slot (monotonic;
     # None while queued). Kept across preemption/re-admission so the
     # queue-time histogram measures the first wait only.
@@ -272,17 +283,27 @@ class InferenceEngine:
             from dlti_tpu.models.quantization import quantize_params_int8
 
             params = quantize_params_int8(params, donate=donate_params)
+        self._device = None
         if mesh is None:
             # Pin host-resident weights to a serving device once.
             # Checkpoint restores hand back numpy arrays; without this
             # every compiled call re-uploads the whole tree (measured:
             # ~40 s per decode step for a 300M model over the remote
-            # relay). Leaves that are already jax.Arrays keep their
-            # placement — ReplicatedEngine pins each replica's copy to
-            # its own device before construction.
-            dev = jax.devices()[0]
+            # relay). Leaves that are already committed jax.Arrays keep
+            # their placement — ReplicatedEngine pins each replica's copy
+            # to its own device before construction — and that device
+            # becomes THE engine device: the KV pool is committed to it
+            # too (below), so warmup's AOT lowering and every compiled
+            # call agree on placement instead of relying on jit's
+            # uncommitted-operand migration.
+            dev = next((d for leaf in jax.tree_util.tree_leaves(params)
+                        if isinstance(leaf, jax.Array)
+                        and getattr(leaf, "committed", False)
+                        for d in leaf.devices()), jax.devices()[0])
+            self._device = dev
             params = jax.tree_util.tree_map(
                 lambda x: x if isinstance(x, jax.Array)
+                and getattr(x, "committed", False)
                 else jax.device_put(x, dev), params)
         self.params = params
 
@@ -299,6 +320,11 @@ class InferenceEngine:
         )
         if mesh is not None:
             self._shard_for_tp(mesh)
+        elif self._device is not None:
+            # Commit the pool to the engine device (see the params pin
+            # above): a replica off the default device otherwise starts
+            # with a device-0 pool that only migrates on first dispatch.
+            self.cache = jax.device_put(self.cache, self._device)
         self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
         self.prefix_cache = None
         if ec.enable_prefix_caching:
@@ -509,19 +535,27 @@ class InferenceEngine:
         the jit path the first time the executable REJECTS the inputs
         (aval/sharding drift — should not happen with the engine's static
         decode shapes, but a warmup must never be able to break serving).
-        Only TypeError (the input-validation error, raised before
-        execution, so no donated buffer is consumed) triggers the
-        fallback; a runtime failure mid-execution may already have
-        consumed the donated KV cache, so retrying via jit would only
-        mask the real error with 'Array has been deleted' — let it
-        propagate."""
+        Only input-validation errors raised BEFORE execution (so no
+        donated buffer is consumed) trigger the fallback: TypeError, and
+        the sharding-mismatch ValueError (e.g. a replica pinned off the
+        default device meeting an executable compiled for it). A runtime
+        failure mid-execution may already have consumed the donated KV
+        cache, so retrying via jit would only mask the real error with
+        'Array has been deleted' — let it propagate."""
         state = {"aot": True}
+
+        def _is_input_rejection(e: Exception) -> bool:
+            return isinstance(e, TypeError) or (
+                isinstance(e, ValueError)
+                and "Compiled object called with input sharding" in str(e))
 
         def call(*a):
             if state["aot"]:
                 try:
                     return compiled(*a)
-                except TypeError as e:
+                except (TypeError, ValueError) as e:
+                    if not _is_input_rejection(e):
+                        raise
                     state["aot"] = False
                     get_logger().warning(
                         "AOT decode executable rejected inputs (%s); "
@@ -544,8 +578,16 @@ class InferenceEngine:
         or the compile finishes under its min-compile-time floor (r04
         advisor finding)."""
         def avals(tree):
+            # Carry each leaf's ACTUAL sharding: a ReplicatedEngine pins
+            # every replica's params/KV to its own device, and an aval
+            # without it lowers for the default device — an executable
+            # replica 1 can only reject at dispatch time. Host-mirror
+            # args (ids/positions/tables/keys) stay plain avals: they
+            # arrive uncommitted and follow the committed operands.
             return jax.tree_util.tree_map(
-                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), tree)
+                lambda v: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=getattr(v, "sharding", None)), tree)
 
         S = self.cfg.max_seqs
         i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
@@ -751,9 +793,22 @@ class InferenceEngine:
         self.telemetry.on_submitted(req)
         return req
 
+    def resubmit(self, req: Request) -> None:
+        """Re-enqueue an EXISTING request (replica failover): the request
+        keeps its id, params, arrival time, and generated-so-far tokens —
+        admission recomputes prompt+output exactly like re-admission after
+        preemption. Same thread-safety contract as :meth:`submit` (one
+        GIL-atomic deque append); ``stats["requests"]`` is NOT incremented
+        — the request was already counted at first submission."""
+        self.waiting.append(req)
+
     @property
     def num_active(self) -> int:
         return sum(not s.free for s in self.slots)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self.block_manager.num_free
 
     @property
     def has_work(self) -> bool:

@@ -1,0 +1,469 @@
+"""Admission gateway: the scheduling front-end between HTTP and engine(s).
+
+vLLM-style engines pair continuous batching with an admission layer for
+production traffic; without one this server admitted unboundedly — every
+request dispatched immediately, nothing shed load, an interactive user and
+a batch job were indistinguishable, and a replica fault errored the fleet.
+This module is that missing layer:
+
+* **Bounded admission queue** — configurable max queued requests and max
+  queued prompt tokens; overflow is rejected with HTTP 429 + ``Retry-After``
+  instead of growing without limit.
+* **Per-tenant token-bucket rate limiting** — tenant identity from the
+  ``X-Tenant`` header (or a stable digest of ``Authorization``), the
+  default tenant otherwise; refusals carry a deficit-derived Retry-After.
+* **Priority + deadline scheduling** — strict ``interactive`` > ``batch``
+  classes, weighted fair dequeue across tenants *within* a class (stride
+  scheduling: pick the queued tenant with the least virtual time, advance
+  it by 1/weight). Requests whose deadline expires while still queued are
+  shed *before* prefill with a 503; expiry mid-decode flips
+  ``cancel_requested`` so they stop burning decode slots.
+* **Graceful drain** — :meth:`AdmissionGateway.drain` (wired to SIGTERM in
+  ``server.serve``) rejects new admissions with 503, lets queued and
+  in-flight requests finish, then the server exits.
+* **Failover visibility** — replica fault/retry counters from
+  :class:`~dlti_tpu.serving.replicas.ReplicatedEngine` ride out through
+  the same ``dlti_gateway_*`` metrics block.
+
+The gateway holds requests in its own per-(priority, tenant) queues and
+dispatches into the engine only while the engine can admit promptly (free
+slot headroom), so ordering decisions happen here — not in the engine's
+FCFS deque. Everything reports through the PR 1 ``MetricsRegistry``
+(``dlti_gateway_*`` series on ``/metrics``) and the lifecycle tracer
+(``gateway/queued`` spans, shed/reject instants).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlti_tpu.config import GatewayConfig
+from dlti_tpu.serving.sampling import SamplingParams
+from dlti_tpu.utils.logging import get_logger
+
+# Strict class order: every queued interactive request dequeues before any
+# batch request (fairness applies across tenants within a class).
+PRIORITIES = ("interactive", "batch")
+
+# Name-stability contract for the /metrics exposition (schema-guarded by
+# tests/test_bench_contract.py, like the dlti_<stat> names before them).
+GATEWAY_METRIC_NAMES = (
+    "dlti_gateway_queue_depth",
+    "dlti_gateway_queued_tokens",
+    "dlti_gateway_inflight",
+    "dlti_gateway_replicas_alive",
+    "dlti_gateway_admitted_total",
+    "dlti_gateway_rejected_total",
+    "dlti_gateway_shed_total",
+    "dlti_gateway_retries_total",
+    "dlti_gateway_replica_faults_total",
+)
+
+
+class AdmissionError(RuntimeError):
+    """Synchronous admission refusal: maps to one HTTP error response."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.retry_after = retry_after
+
+
+class GatewayRequest:
+    """Request facade handed to the HTTP handler at admission time.
+
+    Mirrors the :class:`~dlti_tpu.serving.engine.Request` surface the
+    server reads (id, prompt ids, params, outputs, cancel flag) and binds
+    to the real engine request when the dispatcher admits it — so handlers
+    block on the event queue the moment the gateway accepts, whether the
+    request is queued or running. ``cancel_requested`` set while still
+    queued makes the dispatcher discard the entry without ever prefilling.
+    """
+
+    def __init__(self, request_id: str, prompt_token_ids: List[int],
+                 params: SamplingParams):
+        self.request_id = request_id
+        self.prompt_token_ids = list(prompt_token_ids)
+        self.params = params
+        self._req = None
+        self._cancel = False
+
+    def bind(self, req) -> None:
+        self._req = req
+        if self._cancel:
+            req.cancel_requested = True
+
+    @property
+    def output_token_ids(self) -> list:
+        return self._req.output_token_ids if self._req is not None else []
+
+    @property
+    def done(self) -> bool:
+        return self._req is not None and self._req.done
+
+    @property
+    def cancel_requested(self) -> bool:
+        if self._req is not None:
+            return self._req.cancel_requested
+        return self._cancel
+
+    @cancel_requested.setter
+    def cancel_requested(self, value: bool) -> None:
+        self._cancel = bool(value)
+        if self._req is not None:
+            self._req.cancel_requested = bool(value)
+
+
+@dataclass
+class _Pending:
+    """One gateway-queued admission."""
+
+    handle: GatewayRequest
+    q: "queue.Queue"
+    tenant: str
+    priority: str
+    deadline: Optional[float]  # absolute monotonic, None = none
+    enqueue_t: float = field(default_factory=time.monotonic)
+
+
+class _TokenBucket:
+    """Classic token bucket; caller holds the gateway lock."""
+
+    __slots__ = ("tokens", "stamp")
+
+    def __init__(self, burst: float):
+        self.tokens = burst
+        self.stamp = time.monotonic()
+
+    def take(self, rate: float, burst: float) -> Optional[float]:
+        """Consume one token; returns None on success, else seconds until
+        one accrues (the Retry-After)."""
+        now = time.monotonic()
+        self.tokens = min(burst, self.tokens + (now - self.stamp) * rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return None
+        return max(0.001, (1.0 - self.tokens) / rate)
+
+
+def tenant_from_headers(headers, default: str = "default") -> str:
+    """``X-Tenant`` wins; else a stable digest of the Authorization
+    credential (so per-key limits work without a tenant registry); else
+    the default tenant."""
+    tenant = headers.get("X-Tenant") if headers is not None else None
+    if tenant:
+        return tenant.strip()
+    auth = headers.get("Authorization") if headers is not None else None
+    if auth:
+        return "auth-" + hashlib.sha256(auth.encode()).hexdigest()[:12]
+    return default
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """"tenantA:4,tenantB:1" -> {"tenantA": 4.0, "tenantB": 1.0}."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            raise ValueError(f"bad tenant weight {part!r} "
+                             f"(expected name:weight)")
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0: {part!r}")
+        out[name.strip()] = weight
+    return out
+
+
+class AdmissionGateway:
+    """Bounded, rate-limited, priority/deadline-scheduled admission in
+    front of an :class:`~dlti_tpu.serving.server.AsyncEngine`."""
+
+    def __init__(self, async_engine, cfg: GatewayConfig, registry=None):
+        self.async_engine = async_engine
+        self.cfg = cfg
+        self.logger = get_logger()
+        self._tracer = async_engine.engine.telemetry.tracer
+        self._weights = parse_tenant_weights(cfg.tenant_weights)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # Per-class, per-tenant FIFO queues + stride-scheduling state.
+        self._queues: Dict[str, Dict[str, collections.deque]] = {
+            p: {} for p in PRIORITIES}
+        self._vtime: Dict[str, float] = {}
+        self._vfloor = 0.0
+        self._buckets: Dict[str, _TokenBucket] = {}
+        self._queued_requests = 0
+        self._queued_tokens = 0
+        self._inflight: List[_Pending] = []
+        self._draining = False
+        self._stop = False
+
+        # Metrics: labeled counters are first-class registry objects; live
+        # gauges + the engine-owned failover counters ride a scalar source
+        # (same pattern as the engine stats — no lock on the hot path).
+        self._m_admitted = self._m_rejected = self._m_shed = None
+        if registry is not None:
+            self._m_admitted = registry.counter(
+                "dlti_gateway_admitted_total",
+                help="requests admitted through the gateway")
+            self._m_rejected = registry.counter(
+                "dlti_gateway_rejected_total",
+                help="admissions refused (reason label)")
+            self._m_shed = registry.counter(
+                "dlti_gateway_shed_total",
+                help="queued requests shed at deadline expiry before prefill")
+            registry.add_scalar_source(
+                self._scalars,
+                gauge_keys=("gateway_queue_depth", "gateway_queued_tokens",
+                            "gateway_inflight", "gateway_replicas_alive"),
+                prefix="dlti_")
+
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dlti-gateway-dispatch")
+        self._thread.start()
+
+    # -- observability --------------------------------------------------
+    def _scalars(self) -> dict:
+        eng = self.async_engine.engine
+        fail = getattr(eng, "failover", None) or {}
+        with self._lock:
+            depth, toks, infl = (self._queued_requests, self._queued_tokens,
+                                 len(self._inflight))
+        return {
+            "gateway_queue_depth": depth,
+            "gateway_queued_tokens": toks,
+            "gateway_inflight": infl,
+            "gateway_replicas_alive": getattr(eng, "num_live", 1),
+            "gateway_retries_total": fail.get("retries", 0),
+            "gateway_replica_faults_total": fail.get("replica_faults", 0),
+        }
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- admission ------------------------------------------------------
+    def submit(self, prompt_token_ids, params: SamplingParams,
+               request_id: str, *, tenant: Optional[str] = None,
+               priority: str = "interactive",
+               deadline_s: float = 0.0) -> Tuple[GatewayRequest, queue.Queue]:
+        """Admit or refuse synchronously. Returns ``(handle, event_queue)``
+        — same event protocol as ``AsyncEngine.submit`` plus the terminal
+        ``("reject", status, message)`` for post-admission sheds. Raises
+        :class:`AdmissionError` on refusal (429 bounds/rate, 503 drain)."""
+        tenant = tenant or self.cfg.default_tenant
+        if priority not in PRIORITIES:
+            raise AdmissionError(
+                400, f"priority must be one of {PRIORITIES}, got {priority!r}")
+        n_tokens = len(prompt_token_ids)
+        with self._cond:
+            if self._draining or self._stop:
+                self._reject("draining")
+                raise AdmissionError(
+                    503, "server is draining; not accepting new requests",
+                    retry_after=self.cfg.retry_after_s)
+            if self.cfg.rate_limit_rps > 0:
+                burst = (self.cfg.rate_limit_burst
+                         or max(1.0, 2.0 * self.cfg.rate_limit_rps))
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = _TokenBucket(burst)
+                wait = bucket.take(self.cfg.rate_limit_rps, burst)
+                if wait is not None:
+                    self._reject("rate_limited", tenant=tenant)
+                    raise AdmissionError(
+                        429, f"tenant {tenant!r} over rate limit "
+                             f"({self.cfg.rate_limit_rps:g} req/s)",
+                        retry_after=wait)
+            if self._queued_requests + 1 > self.cfg.max_queued_requests:
+                self._reject("queue_full")
+                raise AdmissionError(
+                    429, f"admission queue full "
+                         f"({self.cfg.max_queued_requests} requests)",
+                    retry_after=self.cfg.retry_after_s)
+            if (self.cfg.max_queued_tokens > 0
+                    and self._queued_tokens + n_tokens
+                    > self.cfg.max_queued_tokens):
+                self._reject("queue_full")
+                raise AdmissionError(
+                    429, f"admission queue full "
+                         f"({self.cfg.max_queued_tokens} queued prompt "
+                         f"tokens)",
+                    retry_after=self.cfg.retry_after_s)
+
+            handle = GatewayRequest(request_id, prompt_token_ids, params)
+            entry = _Pending(
+                handle=handle, q=queue.Queue(), tenant=tenant,
+                priority=priority,
+                deadline=(time.monotonic() + deadline_s
+                          if deadline_s and deadline_s > 0 else None))
+            dq = self._queues[priority].setdefault(tenant, collections.deque())
+            if not dq:
+                # (Re)activating tenant: sync its virtual time to the
+                # floor so an idle spell doesn't bank unbounded credit.
+                self._vtime[tenant] = max(self._vtime.get(tenant, 0.0),
+                                          self._vfloor)
+            dq.append(entry)
+            self._queued_requests += 1
+            self._queued_tokens += n_tokens
+            if self._m_admitted is not None:
+                self._m_admitted.labels(tenant=tenant, priority=priority).inc()
+            self._tracer.instant("gateway/enqueued", cat="gateway",
+                                 id=request_id, tenant=tenant,
+                                 priority=priority)
+            self._cond.notify()
+        return handle, entry.q
+
+    def _reject(self, reason: str, **labels) -> None:
+        if self._m_rejected is not None:
+            self._m_rejected.labels(reason=reason).inc()
+        self._tracer.instant("gateway/rejected", cat="gateway",
+                             reason=reason, **labels)
+
+    # -- scheduling -----------------------------------------------------
+    def _engine_room(self) -> int:
+        """Free decode-slot headroom across live replicas, minus what is
+        already waiting in engine queues: dispatch keeps the engine's FCFS
+        deque near-empty so ordering stays a gateway decision."""
+        eng = self.async_engine.engine
+        engines = (eng.live_engines() if hasattr(eng, "live_engines")
+                   else [eng])
+        return sum(e.cfg.max_seqs - e.num_active - len(e.waiting)
+                   for e in engines)
+
+    def _pop_next_locked(self) -> Optional[_Pending]:
+        for prio in PRIORITIES:
+            by_tenant = self._queues[prio]
+            ready = [t for t, dq in by_tenant.items() if dq]
+            if not ready:
+                continue
+            # Stride scheduling: least virtual time wins; advancing by
+            # 1/weight gives weight-proportional dequeue share.
+            t = min(ready, key=lambda t: (self._vtime.get(t, 0.0), t))
+            self._vtime[t] = (self._vtime.get(t, 0.0)
+                              + 1.0 / self._weights.get(t, 1.0))
+            self._vfloor = self._vtime[t]
+            entry = by_tenant[t].popleft()
+            self._queued_requests -= 1
+            self._queued_tokens -= len(entry.handle.prompt_token_ids)
+            return entry
+        return None
+
+    def _shed_expired_locked(self) -> None:
+        """Deadline enforcement: queued past-deadline entries are shed
+        before prefill (503 to the waiting handler); in-flight ones get
+        ``cancel_requested`` so the engine releases their slot within one
+        decode window."""
+        now = time.monotonic()
+        for prio in PRIORITIES:
+            for tenant, dq in self._queues[prio].items():
+                expired = [e for e in dq
+                           if e.deadline is not None and e.deadline <= now]
+                for e in expired:
+                    dq.remove(e)
+                    self._queued_requests -= 1
+                    self._queued_tokens -= len(e.handle.prompt_token_ids)
+                    if self._m_shed is not None:
+                        self._m_shed.inc()
+                    self._tracer.instant(
+                        "gateway/shed", cat="gateway",
+                        id=e.handle.request_id, tenant=tenant, queued_s=round(
+                            now - e.enqueue_t, 4))
+                    e.q.put(("reject", 503,
+                             "deadline expired while queued (shed before "
+                             "prefill)"))
+        alive = []
+        for e in self._inflight:
+            if e.handle.done:
+                continue
+            if e.deadline is not None and e.deadline <= now:
+                e.handle.cancel_requested = True
+                continue
+            alive.append(e)
+        self._inflight = alive
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                self._shed_expired_locked()
+                entry = None
+                if self._queued_requests > 0 and self._engine_room() > 0:
+                    entry = self._pop_next_locked()
+                if entry is None:
+                    # Deadlines and slot churn are time-driven, so the
+                    # wait is bounded even with no submit notifications.
+                    self._cond.wait(timeout=0.005)
+                    continue
+            if entry.handle.cancel_requested:
+                # Cancelled while queued (client disconnect / timeout):
+                # never reaches the engine.
+                entry.q.put(("done", "stop"))
+                continue
+            try:
+                req, _ = self.async_engine.submit(
+                    entry.handle.prompt_token_ids, entry.handle.params,
+                    entry.handle.request_id, q=entry.q)
+            except Exception as e:  # engine parked / all replicas dead
+                self._reject("engine_unavailable")
+                entry.q.put(("reject", 503, f"{type(e).__name__}: {e}"))
+                continue
+            req.tenant = entry.tenant
+            req.priority = entry.priority
+            req.deadline = entry.deadline
+            entry.handle.bind(req)
+            now = time.monotonic()
+            self._tracer.complete("gateway/queued", entry.enqueue_t, now,
+                                  cat="gateway", id=entry.handle.request_id,
+                                  tenant=entry.tenant,
+                                  priority=entry.priority)
+            with self._cond:
+                self._inflight.append(entry)
+
+    # -- drain / shutdown ----------------------------------------------
+    def drain(self) -> None:
+        """Stop admitting (new submits get 503); queued and in-flight
+        requests run to completion. ``/health`` reports ``draining``."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify()
+        self.logger.info("gateway draining: refusing new admissions")
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until queue + in-flight are empty and the engine has no
+        work (True), or the grace period lapses (False)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (self._queued_requests == 0
+                        and not any(not e.handle.done for e in self._inflight))
+            if idle and not self.async_engine.engine.has_work:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._stop = True
+            for prio in PRIORITIES:
+                for dq in self._queues[prio].values():
+                    while dq:
+                        dq.popleft().q.put(("error", "server shutting down"))
+            self._queued_requests = 0
+            self._queued_tokens = 0
+            self._cond.notify()
+        self._thread.join(timeout=5)

@@ -20,7 +20,9 @@ round-robin from one host thread overlaps their device work.
 from __future__ import annotations
 
 import itertools
-from typing import List, Optional, Sequence
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 
@@ -29,12 +31,45 @@ from dlti_tpu.serving.engine import (
     EngineConfig, GenerationResult, InferenceEngine, Request, SamplingParams,
 )
 from dlti_tpu.telemetry import RequestTelemetry
+from dlti_tpu.utils.logging import get_logger
+
+# Env override for the deterministic chaos hook (same "REPLICA:STEP"
+# format as GatewayConfig.fault_inject_step): lets a chaos run kill a
+# replica on a live server without a config edit.
+FAULT_INJECT_ENV = "DLTI_GATEWAY_FAULT_INJECT"
+
+
+def _parse_fault_inject(spec: str) -> Optional[Tuple[int, int]]:
+    """"REPLICA:STEP" -> (replica_idx, 1-based step count), None if unset."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    try:
+        rep, _, step = spec.partition(":")
+        return int(rep), int(step)
+    except ValueError:
+        raise ValueError(
+            f"fault_inject_step must be 'REPLICA:STEP', got {spec!r}")
+
+
+class ReplicaFault(RuntimeError):
+    """Raised by the fault-injection hook in place of a real device fault."""
 
 
 class ReplicatedEngine:
     """N independent engine replicas (each optionally TP-sharded) behind a
     least-loaded dispatcher. API mirrors :class:`InferenceEngine`:
-    ``submit`` / ``step`` / ``generate`` / ``has_work``."""
+    ``submit`` / ``step`` / ``generate`` / ``has_work``.
+
+    **Fault isolation & failover:** a replica whose ``step()`` raises is
+    marked dead and excluded from dispatch; its in-flight and queued
+    requests are resubmitted on surviving replicas (recompute-on-readmit,
+    the preemption path's semantics) up to ``max_retries`` per request —
+    one replica fault degrades capacity instead of erroring the fleet.
+    Requests past the retry cap (or with no survivors left) finish with
+    ``finish_reason="error"``. ``fault_inject_step`` (or the
+    ``DLTI_GATEWAY_FAULT_INJECT`` env var), format ``"REPLICA:STEP"``,
+    kills a replica deterministically for tests and chaos runs."""
 
     def __init__(
         self,
@@ -46,6 +81,8 @@ class ReplicatedEngine:
         replicas: int = 1,
         tensor: int = 1,
         devices: Optional[Sequence] = None,
+        max_retries: int = 2,
+        fault_inject_step: str = "",
     ):
         devices = list(devices if devices is not None else jax.devices())
         if replicas < 1 or tensor < 1:
@@ -80,17 +117,39 @@ class ReplicatedEngine:
         # auto-ids from different replicas would collide in any id-keyed
         # consumer (server streams, generate()'s by_id map).
         self._req_counter = itertools.count()
+        self.logger = get_logger()
+        self.max_retries = max_retries
+        self._dead: set = set()  # replica indices excluded from dispatch
+        self._step_counts = [0] * replicas
+        self._fault_inject = _parse_fault_inject(
+            os.environ.get(FAULT_INJECT_ENV) or fault_inject_step)
+        # Failover counters, read by the gateway's dlti_gateway_* metrics
+        # (kept out of `stats` so the aggregated per-engine keys — a
+        # /stats name contract — stay untouched).
+        self.failover = {"retries": 0, "replica_faults": 0,
+                         "failover_errors": 0}
 
     # ------------------------------------------------------------------
     def _load(self, eng: InferenceEngine) -> int:
         return len(eng.waiting) + eng.num_active
 
+    def live_engines(self) -> List[InferenceEngine]:
+        return [e for i, e in enumerate(self.engines) if i not in self._dead]
+
+    @property
+    def num_live(self) -> int:
+        return len(self.engines) - len(self._dead)
+
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None) -> Request:
-        """Dispatch to the least-loaded replica (round-robin tiebreak)."""
-        order = (self.engines[self._rr:] + self.engines[:self._rr])
-        self._rr = (self._rr + 1) % len(self.engines)
+        """Dispatch to the least-loaded live replica (round-robin tiebreak)."""
+        live = self.live_engines()
+        if not live:
+            raise RuntimeError("all replicas dead (step faults); "
+                               "engine cannot accept requests")
+        order = (live[self._rr % len(live):] + live[:self._rr % len(live)])
+        self._rr = (self._rr + 1) % len(live)
         eng = min(order, key=self._load)
         if request_id is None:
             request_id = f"rep-req-{next(self._req_counter)}"
@@ -103,22 +162,92 @@ class ReplicatedEngine:
         return any(e.has_work for e in self.engines)
 
     def step(self) -> List[Request]:
-        """One scheduler iteration on every replica that has work.
+        """One scheduler iteration on every live replica that has work.
 
         jit dispatch is async, so each replica's device program launches
         before the next replica's host-side scheduling runs — the chips
         decode concurrently even though this is one Python loop.
+
+        A replica whose step raises is failed over (see
+        :meth:`_fail_replica`); the exception never escapes, so one
+        replica fault can no longer orphan requests on healthy replicas
+        mid-drain (the old ``generate()`` bug) or error the whole fleet.
         """
         finished: List[Request] = []
-        for eng in self.engines:
-            if eng.has_work:
+        for i, eng in enumerate(self.engines):
+            if i in self._dead or not eng.has_work:
+                continue
+            try:
+                self._step_counts[i] += 1
+                if (self._fault_inject is not None
+                        and self._fault_inject[0] == i
+                        and self._step_counts[i] == self._fault_inject[1]):
+                    raise ReplicaFault(
+                        f"gateway.fault_inject_step: injected fault on "
+                        f"replica {i} step {self._step_counts[i]}")
                 finished.extend(eng.step())
+            except Exception as e:  # noqa: BLE001 — isolate per replica
+                finished.extend(self._fail_replica(i, e))
         return finished
+
+    def _fail_replica(self, idx: int, exc: Exception) -> List[Request]:
+        """Mark replica ``idx`` dead and fail its requests over.
+
+        The faulted engine's device state is suspect, so nothing is
+        salvaged from it: its slots are detached host-side (no block frees
+        — the pool dies with the engine) and every stranded request is
+        resubmitted least-loaded onto a survivor, where admission
+        recomputes prompt + generated-so-far exactly like re-admission
+        after preemption. Requests over ``max_retries`` (or with no
+        survivors) finish as ``"error"`` and are returned so callers see
+        them retire."""
+        self._dead.add(idx)
+        self.failover["replica_faults"] += 1
+        eng = self.engines[idx]
+        self.logger.error(
+            "replica %d step failed (%s: %s); failing over %d in-flight + "
+            "%d queued request(s) to %d survivor(s)", idx, type(exc).__name__,
+            exc, eng.num_active, len(eng.waiting), self.num_live)
+        stranded: List[Request] = []
+        for slot in eng.slots:
+            if slot.request is not None and not slot.request.done:
+                stranded.append(slot.request)
+            # Detach host bookkeeping only: the dead engine's pool and KV
+            # are abandoned wholesale, never reused.
+            slot.request = None
+            slot.blocks = []
+            slot.seq_len = 0
+            slot.next_pos = 0
+            slot.prefill_end = 0
+        stranded.extend(eng.waiting)
+        eng.waiting.clear()
+
+        errored: List[Request] = []
+        live = self.live_engines()
+        for req in stranded:
+            if not live or req.num_retries >= self.max_retries:
+                req.finish_reason = "error"
+                req.finish_time = time.monotonic()
+                self.failover["failover_errors"] += 1
+                self.telemetry.on_finished(req)
+                # Visible in the finished ring so the server's event drain
+                # (which walks slots + finished) delivers the error.
+                eng.finished.append(req)
+                errored.append(req)
+                continue
+            req.num_retries += 1
+            self.failover["retries"] += 1
+            target = min(live, key=self._load)
+            target.resubmit(req)
+            req.replica = self.engines.index(target)
+        return errored
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  params: Optional[SamplingParams] = None,
                  ) -> List[GenerationResult]:
-        """Offline batch generation across all replicas."""
+        """Offline batch generation across all replicas. Per-replica step
+        faults fail over inside :meth:`step`, so a single replica death
+        mid-drain no longer orphans requests on healthy replicas."""
         reqs = [self.submit(p, params) for p in prompts]
         while self.has_work:
             self.step()
@@ -127,6 +256,42 @@ class ReplicatedEngine:
             eng = self.engines[r.replica]
             out.append(eng._result(r))
         return out
+
+    # -- InferenceEngine-compat surface (AsyncEngine / gateway) ---------
+    def warmup_decode_ladder(self) -> None:
+        for e in self.engines:
+            e.warmup_decode_ladder()
+
+    @property
+    def cfg(self) -> EngineConfig:
+        return self.engines[0].cfg
+
+    @property
+    def slots(self) -> list:
+        return [s for e in self.engines for s in e.slots]
+
+    @property
+    def finished(self) -> List[Request]:
+        return [r for e in self.engines for r in e.finished]
+
+    @property
+    def waiting(self) -> List[Request]:
+        return [r for e in self.engines for r in e.waiting]
+
+    @property
+    def num_active(self) -> int:
+        return sum(e.num_active for e in self.engines)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return sum(e.num_free_blocks for e in self.live_engines())
+
+    def abort_all(self, reason: str = "abort") -> List[Request]:
+        aborted: List[Request] = []
+        for i, e in enumerate(self.engines):
+            if i not in self._dead:
+                aborted.extend(e.abort_all(reason=reason))
+        return aborted
 
     @property
     def stats(self) -> dict:

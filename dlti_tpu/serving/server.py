@@ -29,8 +29,12 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from dlti_tpu.config import GatewayConfig
 from dlti_tpu.data.tokenizer import Tokenizer
 from dlti_tpu.serving.engine import InferenceEngine, Request
+from dlti_tpu.serving.gateway import (
+    AdmissionError, AdmissionGateway, PRIORITIES, tenant_from_headers,
+)
 from dlti_tpu.serving.sampling import SamplingParams
 from dlti_tpu.telemetry import MetricsRegistry, get_tracer
 from dlti_tpu.utils.logging import get_logger
@@ -56,7 +60,7 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
             **eng.stats,
             "active_seqs": eng.num_active,
             "waiting": len(eng.waiting),
-            "free_blocks": eng.block_manager.num_free,
+            "free_blocks": eng.num_free_blocks,
         }
 
     registry.add_scalar_source(_engine_scalars, gauge_keys=_GAUGE_KEYS,
@@ -118,14 +122,25 @@ class AsyncEngine:
                                         name="dlti-engine-stepper")
         self._thread.start()
 
+    @property
+    def dead(self) -> bool:
+        """True once even fault recovery failed and the stepper parked
+        (every future submit raises; ``/health`` must stop reporting ok)."""
+        return self._dead
+
     def submit(self, prompt_ids: List[int], params: SamplingParams,
-               request_id: Optional[str] = None) -> Tuple[Request, queue.Queue]:
+               request_id: Optional[str] = None,
+               q: Optional[queue.Queue] = None,
+               ) -> Tuple[Request, queue.Queue]:
         """Enqueue a request; returns (request, event queue).
 
         Queue events: ``("token", token_id, logprob)`` per generated token,
         then ``("done", finish_reason)`` — or ``("error", message)``.
+        ``q`` lets a caller that pre-created the consumer queue (the
+        admission gateway hands it to the HTTP handler before dispatch)
+        receive events on its own instance.
         """
-        q: queue.Queue = queue.Queue()
+        q = q if q is not None else queue.Queue()
         with self._work:
             if self._dead:
                 raise RuntimeError(
@@ -209,7 +224,13 @@ class AsyncEngine:
                 q.put(("token", req.output_token_ids[i], req.output_logprobs[i]))
             self._seen[req.request_id] = len(req.output_token_ids)
             if req.done:
-                q.put(("done", req.finish_reason))
+                if req.finish_reason == "error":
+                    # Replica failover exhausted its retries (or no
+                    # survivors): this one request failed, fleet stays up.
+                    q.put(("error", "request failed: replica fault, "
+                                    "retries exhausted"))
+                else:
+                    q.put(("done", req.finish_reason))
                 del self._queues[req.request_id]
                 del self._seen[req.request_id]
 
@@ -221,6 +242,9 @@ class ServerConfig:
     model_name: str = "dlti-tpu-model"
     request_timeout_s: float = 600.0
     default_params: SamplingParams = field(default_factory=SamplingParams)
+    # Admission gateway (dlti_tpu.serving.gateway): None or disabled keeps
+    # the legacy direct-admission path byte-for-byte.
+    gateway: Optional["GatewayConfig"] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -234,21 +258,36 @@ class _Handler(BaseHTTPRequestHandler):
     tokenizer: Tokenizer
     cfg: ServerConfig
     registry: "MetricsRegistry"
+    gateway = None  # AdmissionGateway when ServerConfig.gateway enables it
 
     def log_message(self, fmt, *args):  # route through our logger
         get_logger().debug("http: " + fmt, *args)
 
     # -- helpers -------------------------------------------------------
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict,
+              headers: Optional[dict] = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, message: str) -> None:
-        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+    def _error(self, code: int, message: str,
+               retry_after: Optional[float] = None) -> None:
+        headers = None
+        if retry_after is not None:
+            # Integral seconds per RFC 9110 §10.2.3, never rounded to 0 —
+            # a 429 whose Retry-After says "now" just invites the same
+            # overload back immediately.
+            headers = {"Retry-After": str(max(1, int(-(-retry_after // 1))))}
+        err_type = ("rate_limit_error" if code == 429
+                    else "overloaded_error" if code == 503
+                    else "invalid_request_error")
+        self._json(code, {"error": {"message": message, "type": err_type}},
+                   headers=headers)
 
     def _read_body(self) -> Optional[dict]:
         try:
@@ -333,7 +372,15 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes --------------------------------------------------------
     def do_GET(self):
         if self.path == "/health":
-            self._json(200, {"status": "ok"})
+            # Load-balancer truth: a parked stepper or a draining gateway
+            # must read unhealthy so traffic routes elsewhere — 200 here
+            # while submits 503 kept corpses in rotation.
+            if self.async_engine.dead:
+                self._json(503, {"status": "dead"})
+            elif self.gateway is not None and self.gateway.draining:
+                self._json(503, {"status": "draining"})
+            else:
+                self._json(200, {"status": "ok"})
         elif self.path == "/stats":
             # Raw engine counters/gauges + request-latency histogram
             # summaries (count/sum/mean/p50/p90/p99), all served from the
@@ -420,11 +467,37 @@ class _Handler(BaseHTTPRequestHandler):
                      "top_k=1) would return n identical choices; relax the "
                      "sampling or drop n")
 
+        # Admission metadata (gateway only): tenant from headers, priority
+        # class + queued-deadline from the body. Validated before submit so
+        # a bad value 400s this request, same contract as sampling params.
+        tenant = priority = None
+        deadline_s = 0.0
+        if self.gateway is not None:
+            tenant = tenant_from_headers(
+                self.headers, self.gateway.cfg.default_tenant)
+            priority = str(body.get("priority")
+                           or self.headers.get("X-Priority")
+                           or "interactive")
+            if priority not in PRIORITIES:
+                return self._error(
+                    400, f"priority must be one of {PRIORITIES}")
+            try:
+                deadline_s = float(body.get("deadline_s", 0) or 0)
+            except (TypeError, ValueError):
+                return self._error(400, "deadline_s must be a number")
+
+        def _submit(p_ids, p, rid_):
+            if self.gateway is not None:
+                return self.gateway.submit(
+                    p_ids, p, rid_, tenant=tenant, priority=priority,
+                    deadline_s=deadline_s)
+            return self.async_engine.submit(p_ids, p, rid_)
+
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
         try:
             if n == 1:
-                req, q = self.async_engine.submit(prompt_ids, params, rid)
+                req, q = _submit(prompt_ids, params, rid)
             else:
                 # n choices = n engine requests decoding CONCURRENTLY in
                 # the continuous batch (they share prefill via the prefix
@@ -435,8 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
                     for i in range(n):
                         p_i = params if params.seed is None else \
                             dataclasses.replace(params, seed=params.seed + i)
-                        subs.append(self.async_engine.submit(
-                            prompt_ids, p_i, f"{rid}-{i}"))
+                        subs.append(_submit(prompt_ids, p_i, f"{rid}-{i}"))
                 except Exception:
                     # A submit failed mid-loop (e.g. the stepper parked
                     # between choices): early-cancel every choice already
@@ -446,6 +518,8 @@ class _Handler(BaseHTTPRequestHandler):
                     for other, _ in subs:
                         other.cancel_requested = True
                     raise
+        except AdmissionError as e:  # gateway refusal: 429/503 + Retry-After
+            return self._error(e.status, e.message, retry_after=e.retry_after)
         except ValueError as e:
             return self._error(400, str(e))
         except RuntimeError as e:  # engine parked after unrecoverable fault
@@ -458,12 +532,19 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._multi_response(subs, rid, chat, created, stops)
 
-    def _collect(self, q: queue.Queue):
-        """Yield events until done/error/timeout."""
+    def _collect(self, q: queue.Queue, req: Optional[Request] = None):
+        """Yield events until done/error/reject/timeout.
+
+        On timeout the request is early-cancelled first (same contract as
+        the disconnect/stop cancels): without it a timed-out request kept
+        decoding to max_tokens into a queue nobody reads, burning a slot
+        live requests were waiting for."""
         deadline = time.monotonic() + self.cfg.request_timeout_s
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                if req is not None:
+                    req.cancel_requested = True
                 yield ("error", "request timed out")
                 return
             try:
@@ -471,26 +552,26 @@ class _Handler(BaseHTTPRequestHandler):
             except queue.Empty:
                 continue
             yield ev
-            if ev[0] in ("done", "error"):
+            if ev[0] in ("done", "error", "reject"):
                 return
 
     def _collect_choice(self, req: Request, q: queue.Queue,
                         stops: tuple) -> tuple:
         """Drain one non-streaming request to completion: returns
-        ((token_ids, logprobs, text, finish), error_message) with exactly
-        one of the pair set. THE one collect/stop-scan/truncate
-        implementation for the n==1 and n>1 paths, so they cannot
-        diverge. Stop STRINGS (OpenAI `stop`; token-boundary-agnostic, so
-        matched on detokenized text here, not in the engine) request
-        early cancel and keep draining until the engine's done event so
-        the slot release is observed; the scan is windowed past
-        already-scanned text."""
+        ((token_ids, logprobs, text, finish), (status, error_message))
+        with exactly one of the pair set. THE one
+        collect/stop-scan/truncate implementation for the n==1 and n>1
+        paths, so they cannot diverge. Stop STRINGS (OpenAI `stop`;
+        token-boundary-agnostic, so matched on detokenized text here, not
+        in the engine) request early cancel and keep draining until the
+        engine's done event so the slot release is observed; the scan is
+        windowed past already-scanned text."""
         token_ids: List[int] = []
         logprobs: List[float] = []
         finish = "stop"
         cut = None
         matcher = self._StopMatcher(stops)
-        for ev in self._collect(q):
+        for ev in self._collect(q, req):
             if ev[0] == "token":
                 token_ids.append(ev[1])
                 logprobs.append(ev[2])
@@ -500,8 +581,10 @@ class _Handler(BaseHTTPRequestHandler):
                         req.cancel_requested = True
             elif ev[0] == "done":
                 finish = ev[1]
+            elif ev[0] == "reject":  # gateway shed (e.g. queued deadline)
+                return None, (ev[1], ev[2])
             else:
-                return None, ev[1]
+                return None, (500, ev[1])
         text = self.tokenizer.decode(token_ids)
         if cut is not None:
             text, finish = text[:cut], "stop"
@@ -511,7 +594,7 @@ class _Handler(BaseHTTPRequestHandler):
                        created: int, stops: tuple = ()) -> None:
         got, err = self._collect_choice(req, q, stops)
         if err is not None:
-            return self._error(500, err)
+            return self._error(*err)
         token_ids, logprobs, text, finish = got
         usage = {
             "prompt_tokens": len(req.prompt_token_ids),
@@ -551,7 +634,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # to prevent).
                 for other, _ in subs:
                     other.cancel_requested = True
-                return self._error(500, err)
+                return self._error(*err)
             token_ids, logprobs, text, finish = got
             total_completion += len(token_ids)
             if chat:
@@ -602,7 +685,7 @@ class _Handler(BaseHTTPRequestHandler):
                                  "finish_reason": None}]}))
             cancelled = False
             matcher = self._StopMatcher(stops)
-            for ev in self._collect(q):
+            for ev in self._collect(q, req):
                 if ev[0] == "token":
                     if cancelled:
                         # Stop already matched: drain (the engine finishes
@@ -662,7 +745,10 @@ class _Handler(BaseHTTPRequestHandler):
                                 "choices": [{"index": 0, key: val,
                                              "finish_reason": None}]}))
                 else:
-                    chunk(json.dumps({"error": {"message": ev[1]}}))
+                    # ("error", msg) or a gateway ("reject", status, msg):
+                    # headers are already on the wire, so the refusal
+                    # arrives as a terminal SSE error frame.
+                    chunk(json.dumps({"error": {"message": ev[-1]}}))
                     break
             if finish is not None:
                 key = "delta" if chat else "text"
@@ -697,17 +783,27 @@ class _Handler(BaseHTTPRequestHandler):
 def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
                 cfg: Optional[ServerConfig] = None,
                 ) -> Tuple[ThreadingHTTPServer, AsyncEngine]:
-    """Build (but don't start) the HTTP server; caller runs serve_forever()."""
+    """Build (but don't start) the HTTP server; caller runs serve_forever().
+
+    When ``cfg.gateway`` is set and enabled, an
+    :class:`~dlti_tpu.serving.gateway.AdmissionGateway` is built between
+    the handlers and the engine (reachable as ``httpd.gateway``); left
+    unset, admission is the legacy direct path.
+    """
     cfg = cfg or ServerConfig()
     async_engine = AsyncEngine(engine)
     registry = build_registry(async_engine)
+    gateway = None
+    if cfg.gateway is not None and cfg.gateway.enabled:
+        gateway = AdmissionGateway(async_engine, cfg.gateway, registry)
 
     handler = type("BoundHandler", (_Handler,), {
         "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
-        "registry": registry,
+        "registry": registry, "gateway": gateway,
     })
     httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
     httpd.daemon_threads = True
+    httpd.gateway = gateway
     return httpd, async_engine
 
 
@@ -716,16 +812,26 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
     """Blocking entry point (used by ``scripts/serve.py``)."""
     cfg = cfg or ServerConfig()
     httpd, async_engine = make_server(engine, tokenizer, cfg)
+    gateway = httpd.gateway
     get_logger().info("serving on http://%s:%d (model=%s)",
                       cfg.host, cfg.port, cfg.model_name)
     # SIGTERM (k8s eviction, orchestrator `kill`) gets the same clean
     # path as Ctrl-C: unblock serve_forever so the finally drains the
-    # stepper and closes the socket instead of dying mid-decode.
+    # stepper and closes the socket instead of dying mid-decode. With a
+    # gateway the path is a GRACEFUL DRAIN: new admissions 503, /health
+    # flips to "draining" (the LB stops routing), queued + in-flight
+    # requests finish (bounded by drain_grace_s), then the server exits.
     # httpd.shutdown() must run OFF the serving thread (it joins it).
     import signal as _signal
 
+    def _graceful_stop():
+        if gateway is not None:
+            gateway.drain()
+            gateway.wait_idle(gateway.cfg.drain_grace_s)
+        httpd.shutdown()
+
     def _on_term(signum, frame):
-        threading.Thread(target=httpd.shutdown, daemon=True).start()
+        threading.Thread(target=_graceful_stop, daemon=True).start()
 
     prev_handler = None
     installed = False
@@ -745,5 +851,7 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
             # SIGTERM for the process lifetime.
             _signal.signal(_signal.SIGTERM,
                            prev_handler or _signal.SIG_DFL)
+        if gateway is not None:
+            gateway.shutdown()
         async_engine.shutdown()
         httpd.server_close()

@@ -12,6 +12,12 @@ each :class:`Request` into Chrome-trace spans:
                                                       arrival)
     first tok → finished        ``request/decode``   (TPOT histogram)
 
+When the admission gateway fronts the engine, its own phase precedes
+these on the same timeline under the ``gateway`` category:
+``gateway/enqueued`` (accepted), ``gateway/queued`` (admission wait,
+complete-span), and the ``gateway/rejected`` / ``gateway/shed`` instants
+for refusals and queued-deadline sheds (``serving.gateway``).
+
 Spans are emitted *after the fact* from recorded timestamps
 (:meth:`SpanTracer.complete`), so the engine's hot path only ever touches
 monotonic-clock floats it already records. Each request's spans share a

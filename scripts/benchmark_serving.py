@@ -47,6 +47,21 @@ def main() -> None:
                         "this pool (seeded), exercising varied prefill "
                         "lengths instead of one fixed prompt")
     p.add_argument("--chat", action="store_true", help="use /v1/chat/completions")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="spread requests over N synthetic tenants via the "
+                        "X-Tenant header (exercises the admission "
+                        "gateway's per-tenant limits and fair dequeue)")
+    p.add_argument("--priority-mix", default="",
+                   help="priority class mix, e.g. 'interactive:0.8,"
+                        "batch:0.2'; the report then includes per-class "
+                        "TTFT/TPOT percentiles and shed counts")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="per-request queued-deadline seconds (body "
+                        "deadline_s; a gateway sheds expired queued "
+                        "requests with 503)")
+    p.add_argument("--scrape-server-metrics", action="store_true",
+                   help="attach the server's on-engine histogram "
+                        "summaries (/metrics) to the report")
     p.add_argument("--no-stream", action="store_true",
                    help="non-streaming (usage-accurate token counts, no TTFT)")
     p.add_argument("--timeout", type=float, default=300.0)
@@ -64,6 +79,9 @@ def main() -> None:
         max_tokens=args.max_tokens, temperature=args.temperature,
         prompt=args.prompt, prompts=prompts, chat=args.chat,
         timeout_s=args.timeout, seed=args.seed,
+        tenants=args.tenants, priority_mix=args.priority_mix,
+        deadline_s=args.deadline,
+        scrape_server_metrics=args.scrape_server_metrics,
     )
     report = run_load_test(cfg)
     d = report.to_dict()

@@ -54,6 +54,41 @@ def parse_args():
     p.add_argument("--tensor", type=int, default=1,
                    help="tensor-parallel extent: shard weights + KV pools "
                         "over this many chips (ICI collectives via GSPMD)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="data-parallel engine replicas (each tensor-wide); "
+                        "a replica whose step faults is excluded and its "
+                        "requests fail over to survivors")
+    # -- admission gateway (dlti_tpu.serving.gateway) -------------------
+    p.add_argument("--gateway", action="store_true",
+                   help="enable the admission gateway: bounded queue with "
+                        "429 overflow, per-tenant rate limits, "
+                        "interactive>batch priority, deadline shed, "
+                        "graceful SIGTERM drain")
+    p.add_argument("--max-queued-requests", type=int, default=256,
+                   help="gateway queue bound (requests); overflow -> 429 "
+                        "+ Retry-After")
+    p.add_argument("--max-queued-tokens", type=int, default=0,
+                   help="gateway queue bound (total queued prompt tokens); "
+                        "0 = request bound only")
+    p.add_argument("--rate-limit-rps", type=float, default=0.0,
+                   help="per-tenant sustained admission rate (req/s); "
+                        "0 = off")
+    p.add_argument("--rate-limit-burst", type=float, default=0.0,
+                   help="per-tenant token-bucket burst capacity; 0 derives "
+                        "max(1, 2*rps)")
+    p.add_argument("--tenant-weights", default="",
+                   help="weighted fair dequeue, e.g. 'teamA:4,teamB:1' "
+                        "(unlisted tenants weigh 1)")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="seconds SIGTERM waits for in-flight requests "
+                        "before exiting anyway")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="failover resubmissions per request after a "
+                        "replica step fault")
+    p.add_argument("--fault-inject-step", default="",
+                   help="chaos hook 'REPLICA:STEP': kill that replica on "
+                        "its STEP-th step (also env "
+                        "DLTI_GATEWAY_FAULT_INJECT)")
     p.add_argument("--steps-per-sync", type=int, default=1,
                    help="decode iterations per compiled program (multi-step "
                         "scheduling; amortizes host round-trips)")
@@ -156,20 +191,44 @@ def main() -> None:
         spec_cooldown=args.spec_cooldown,
         max_prefill_tokens_per_step=args.max_prefill_tokens,
     )
-    mesh = None
-    if args.tensor > 1:
-        from dlti_tpu.config import ParallelConfig
-        from dlti_tpu.parallel import build_mesh
+    if args.replicas > 1:
+        from dlti_tpu.serving import ReplicatedEngine
 
-        mesh = build_mesh(ParallelConfig(tensor=args.tensor))
-    engine = InferenceEngine(model_cfg, params, ec, lora_cfg, mesh=mesh,
-                             donate_params=True)
+        engine = ReplicatedEngine(
+            model_cfg, params, ec, lora_cfg,
+            replicas=args.replicas, tensor=args.tensor,
+            max_retries=args.max_retries,
+            fault_inject_step=args.fault_inject_step)
+    else:
+        mesh = None
+        if args.tensor > 1:
+            from dlti_tpu.config import ParallelConfig
+            from dlti_tpu.parallel import build_mesh
+
+            mesh = build_mesh(ParallelConfig(tensor=args.tensor))
+        engine = InferenceEngine(model_cfg, params, ec, lora_cfg, mesh=mesh,
+                                 donate_params=True)
     # The engine owns (a possibly quantized copy of) the weights now; this
     # frame's reference would otherwise pin the original tree in HBM for
     # the server's lifetime — 13.5 GB of dead bf16 under --quantization.
     del params
+    gw_cfg = None
+    if args.gateway:
+        from dlti_tpu.config import GatewayConfig
+
+        gw_cfg = GatewayConfig(
+            enabled=True,
+            max_queued_requests=args.max_queued_requests,
+            max_queued_tokens=args.max_queued_tokens,
+            rate_limit_rps=args.rate_limit_rps,
+            rate_limit_burst=args.rate_limit_burst,
+            tenant_weights=args.tenant_weights,
+            drain_grace_s=args.drain_grace,
+            max_retries=args.max_retries,
+            fault_inject_step=args.fault_inject_step)
     sc = ServerConfig(host=args.host, port=args.port,
-                      default_params=SamplingParams(max_tokens=args.max_tokens_default))
+                      default_params=SamplingParams(max_tokens=args.max_tokens_default),
+                      gateway=gw_cfg)
     print("pre-compiling decode programs (single-step + multi-step ladder)...")
     t0 = time.time()
     engine.warmup_decode_ladder()

@@ -79,3 +79,62 @@ def test_watchdog_deadline_emits_json():
     assert proc.returncode in (4, 5), (proc.returncode, proc.stderr[-500:])
     out = json.loads(lines[-1])
     assert "error" in out
+
+
+def test_gateway_metric_names_are_schema_stable():
+    """The dlti_gateway_* exposition names are a scrape contract like the
+    legacy dlti_<stat> names: renaming one silently breaks external
+    dashboards, so the full set is pinned here."""
+    from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
+
+    assert GATEWAY_METRIC_NAMES == (
+        "dlti_gateway_queue_depth",
+        "dlti_gateway_queued_tokens",
+        "dlti_gateway_inflight",
+        "dlti_gateway_replicas_alive",
+        "dlti_gateway_admitted_total",
+        "dlti_gateway_rejected_total",
+        "dlti_gateway_shed_total",
+        "dlti_gateway_retries_total",
+        "dlti_gateway_replica_faults_total",
+    )
+
+
+def test_load_report_schema_includes_gateway_fields():
+    """scripts/benchmark_serving.py consumers parse the report JSON by
+    key; the multi-tenant/priority additions are part of that schema."""
+    import dataclasses
+
+    from dlti_tpu.benchmarks.loadgen import LoadReport
+
+    fields = {f.name for f in dataclasses.fields(LoadReport)}
+    required = {
+        # Legacy report contract.
+        "num_requests", "num_ok", "duration_s", "requests_per_s",
+        "output_tokens_per_s", "latency_p50_s", "latency_p90_s",
+        "latency_p99_s", "ttft_p50_s", "ttft_p90_s", "ttft_p99_s",
+        "tpot_mean_ms", "errors", "server_histograms",
+        # Gateway-era additions: shed accounting + per-class breakdown.
+        "num_shed", "shed_rate", "per_class",
+    }
+    missing = required - fields
+    assert not missing, f"LoadReport lost contract fields: {missing}"
+
+
+def test_per_class_summary_keys():
+    """Per-priority-class breakdown keys (consumed by report tooling)."""
+    from dlti_tpu.benchmarks.loadgen import RequestRecord, _class_summary
+
+    rec = RequestRecord(start=0.0, end=1.0, first_token=0.25,
+                        output_tokens=8, ok=True, status=200,
+                        priority="interactive")
+    shed = RequestRecord(start=0.0, end=0.1, ok=False, status=429,
+                         priority="interactive", error="HTTP 429")
+    summary = _class_summary([rec, shed])
+    assert set(summary) == {
+        "count", "ok", "shed", "latency_p50_s", "latency_p99_s",
+        "ttft_p50_s", "ttft_p90_s", "ttft_p99_s", "tpot_mean_ms",
+    }
+    assert summary["count"] == 2 and summary["ok"] == 1
+    assert summary["shed"] == 1
+    assert summary["ttft_p50_s"] == 0.25
