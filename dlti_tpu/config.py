@@ -223,6 +223,13 @@ class DataConfig:
     pack_sequences: bool = False  # reference does not pack; packing is a perf option
     num_samples: Optional[int] = None
     shuffle_seed: int = 0
+    # Background batch prefetch depth (dlti_tpu.data.prefetch): the
+    # Trainer runs batch gather/pack and the ahead-of-need device_put on a
+    # worker thread, double-buffered this many batches deep, so the device
+    # never waits on host batch prep. Batch order (and so the loss
+    # trajectory) is bit-identical to the synchronous path. 0 = off
+    # (legacy inline fetch).
+    prefetch_depth: int = 2
 
 
 @dataclass(frozen=True)

@@ -15,3 +15,7 @@ from dlti_tpu.data.streaming import (  # noqa: F401
     StreamingTokenDataset,
     write_token_store,
 )
+from dlti_tpu.data.prefetch import (  # noqa: F401
+    HostPrefetcher,
+    PREFETCH_METRIC_NAMES,
+)

@@ -239,7 +239,25 @@ class HostShardedSchedule:
 
     def steps_per_epoch(self) -> int:
         lo, hi = self._row_range
-        return (hi - lo) // self._host_samples_per_step
+        chunk = self._host_samples_per_step
+        if getattr(self, "drop_remainder", True):
+            return (hi - lo) // chunk
+        # Final partial chunk is padded up to a full step (every host's
+        # shard is the same size, so all hosts agree on the extra step).
+        return -(-(hi - lo) // chunk)
+
+    def _pad_step(self, fields: dict, chunk: int) -> dict:
+        """Pad a partial final step to the static step shape: pad rows are
+        all ``pad_id`` tokens with an all-zero loss mask (and zero
+        segment ids / positions), so they contribute nothing to the loss
+        or gradients while keeping every compiled shape identical."""
+        out = {}
+        for k, v in fields.items():
+            pad_rows = chunk - v.shape[0]
+            fill = self.pad_id if k == "input_ids" else 0
+            pad = np.full((pad_rows,) + v.shape[1:], fill, v.dtype)
+            out[k] = np.concatenate([v, pad], axis=0)
+        return out
 
     def epoch(self, epoch_idx: int = 0, skip_steps: int = 0) -> Iterator[dict]:
         lo, hi = self._row_range
@@ -251,10 +269,16 @@ class HostShardedSchedule:
         chunk = self._host_samples_per_step
         bs_local = self.micro_batch_size // self._procs
         shape = (self.grad_accum_steps, bs_local, self.seq_len)
-        for step_i, start in enumerate(range(0, len(order) - chunk + 1, chunk)):
+        drop = getattr(self, "drop_remainder", True)
+        for step_i, start in enumerate(range(0, len(order), chunk)):
+            rows = order[start : start + chunk]
+            if len(rows) < chunk and drop:
+                break  # legacy behavior: the ragged tail is dropped
             if step_i < skip_steps:
                 continue
-            fields = self._gather(order[start : start + chunk])
+            fields = self._gather(rows)
+            if len(rows) < chunk:
+                fields = self._pad_step(fields, chunk)
             yield {k: v.reshape(shape) for k, v in fields.items()}
 
 
@@ -268,6 +292,11 @@ class TokenBatchDataset(HostShardedSchedule):
 
     ``micro_batch_size`` is the *global* (all-hosts, all-devices) microbatch;
     each host materializes 1/process_count of it when ``shard_by_host``.
+
+    ``drop_remainder=False`` keeps the final partial step of each epoch by
+    padding it to the full static step shape with all-pad rows (loss mask
+    zero — no loss/grad contribution); the default drops it, matching the
+    reference's drop_last semantics.
     """
 
     sequences: List[List[int]]

@@ -135,6 +135,9 @@ class StreamingTokenDataset(HostShardedSchedule):
     shuffle_seed: Optional[int] = 0
     shard_by_host: bool = True
     expect_tokenizer: Optional[str] = None
+    # Same contract as TokenBatchDataset: False pads the final partial
+    # step (all-pad rows, zero loss mask) instead of dropping it.
+    drop_remainder: bool = True
 
     def __post_init__(self) -> None:
         with open(os.path.join(self.directory, "meta.json")) as f:

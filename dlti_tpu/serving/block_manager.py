@@ -33,10 +33,19 @@ class BlockManager:
         self._native = load_native_runtime()
         if self._native is not None:
             self._handle = self._native.dlti_allocator_create(num_blocks)
+            # Older prebuilt libraries predate the checked-free ABI; they
+            # keep the legacy (unguarded) free path.
+            self._checked_free = hasattr(self._native,
+                                         "dlti_allocator_free_checked")
         else:
             self._handle = None
             # Block 0 reserved; LIFO free list for cache locality.
             self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+            # O(1) double-free guard: the set of live (handed-out) blocks.
+            # A double free would silently put one block on the free list
+            # twice — two sequences then share a "private" block and decode
+            # state corrupts with no error anywhere near the cause.
+            self._allocated: set = set()
 
     def __del__(self):
         if getattr(self, "_native", None) is not None and self._handle:
@@ -70,18 +79,42 @@ class BlockManager:
         if len(self._free) < n:
             return None
         blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
         return blocks
 
     def free(self, blocks: List[int]) -> None:
+        """Return ``blocks`` to the pool. Raises on an invalid id or a
+        double free (all-or-nothing: a rejected call frees none), instead
+        of silently corrupting the pool into handing one block to two
+        sequences."""
         if not blocks:
             return
         if self._native is not None:
             import ctypes
 
             arr = (ctypes.c_int32 * len(blocks))(*blocks)
-            self._native.dlti_allocator_free(self._handle, len(blocks), arr)
+            if self._checked_free:
+                ok = self._native.dlti_allocator_free_checked(
+                    self._handle, len(blocks), arr)
+                if not ok:
+                    raise ValueError(
+                        f"invalid or double free in {blocks} (native "
+                        "allocator rejected the batch; no block was freed)")
+            else:
+                self._native.dlti_allocator_free(self._handle, len(blocks), arr)
             return
+        # Validate the whole batch first (including intra-batch
+        # duplicates) so a raise frees nothing.
+        seen: set = set()
         for b in blocks:
             if b == self.TRASH_BLOCK or b <= 0 or b >= self.num_blocks:
                 raise ValueError(f"freeing invalid block {b}")
+            if b not in self._allocated or b in seen:
+                raise ValueError(
+                    f"double free of block {b} (not currently allocated); "
+                    "freeing it again would hand the same block to two "
+                    "sequences and silently corrupt their KV")
+            seen.add(b)
+        for b in blocks:
+            self._allocated.discard(b)
             self._free.append(b)

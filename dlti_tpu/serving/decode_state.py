@@ -1,0 +1,157 @@
+"""Device-resident decode-state cache — the serving half of the
+host-latency-hiding layer.
+
+The engine used to re-upload its *entire* per-slot decode state — block
+tables (rebuilt as a fresh numpy array in ``_decode_block_tables``), slot
+keys, gen counts, temperature/top-k/top-p — via ``jnp.asarray`` on every
+decode dispatch, even though a typical step dirties only a handful of slots
+(an admission, a retirement, a block-table row growing by one). This class
+keeps those six arrays as persistent device arrays and maintains them
+*incrementally*, vLLM-style (Kwon et al., SOSP 2023: incremental scheduler
+state is what keeps decode host overhead flat as batch size grows):
+
+* The engine marks a slot dirty at admission, release (retire / preempt /
+  abort), block-table growth, and prefill completion. :meth:`sync` then
+  scatters just the dirty rows into the device arrays (one fused jitted
+  update, row count padded to a power of two so the compile surface stays
+  O(log max_seqs)) — ``_decode_block_tables``'s full rebuild becomes an
+  in-place row update.
+* A **clean step uploads nothing**: every decode dispatch between
+  scheduling events reuses the resident arrays as-is (asserted in tier-1:
+  ``tests/test_host_overlap.py``).
+* Gen counts advance **on device**: after a K-step window the cache bumps
+  the resident counts by K (matching the host mirror's per-token append
+  for every slot that survived the window; a slot that finished mid-window
+  was released, which marks it dirty). No host→device traffic for the one
+  mirror that changes every single step.
+* Prefilling slots' block-table rows are masked to the trash block at
+  upload time (same invariant as the legacy rebuild): a decode program can
+  never scribble on KV a partially-prefilled slot has written.
+
+The speculative path keeps the legacy re-upload (it ships the full token
+history anyway); a spec round calls :meth:`mark_all_dirty` so the next
+plain dispatch resynchronizes. Outputs are byte-identical to the re-upload
+path — for every *active* slot the resident rows equal the host mirrors at
+each dispatch (equivalence-tested, including across preemption and
+re-admission).
+
+Updates deliberately do **not** donate the old arrays: they are KB-scale,
+and the previous window's program may still hold them as in-flight
+(non-donated) operands.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Mirror names in the decode programs' argument order (after ids/positions).
+_FIELDS = ("block_tables", "slot_keys", "gen_counts",
+           "temperature", "top_k", "top_p")
+
+
+class DecodeStateCache:
+    """Persistent device twins of the engine's per-slot host mirrors."""
+
+    def __init__(self, num_slots: int, device=None, mesh=None,
+                 stats: Optional[dict] = None):
+        self._num_slots = num_slots
+        self._device = device
+        self._mesh = mesh
+        self._dev: Optional[Tuple[jax.Array, ...]] = None
+        self._dirty: set = set()
+        self._all_dirty = True
+        # Counters surfaced through the engine's stats dict (and so the
+        # /metrics scalar source): upload syncs, rows shipped, clean syncs.
+        self.stats = stats if stats is not None else {}
+        for k in ("decode_state_uploads", "decode_state_rows",
+                  "decode_state_clean_syncs"):
+            self.stats.setdefault(k, 0)
+        # One jitted updater; XLA specializes per padded row count.
+        self._update = jax.jit(self._apply_rows)
+        self._bump = jax.jit(lambda cnt, k: cnt + k)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _apply_rows(dev, idx, rows):
+        return tuple(a.at[idx].set(r) for a, r in zip(dev, rows))
+
+    def _place(self, x: np.ndarray) -> jax.Array:
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(x, NamedSharding(self._mesh, P()))
+        if self._device is not None:
+            return jax.device_put(x, self._device)
+        return jnp.asarray(x)
+
+    # -- dirty tracking (engine-side scheduling events) -----------------
+    def mark_dirty(self, slot_id: int) -> None:
+        self._dirty.add(slot_id)
+
+    def mark_all_dirty(self) -> None:
+        """Resident state is stale wholesale (a spec round ran, or the
+        legacy path was used); re-upload everything at the next sync."""
+        self._all_dirty = True
+
+    # ------------------------------------------------------------------
+    def sync(self, mirrors: Dict[str, np.ndarray],
+             masked_rows: Sequence[int] = ()) -> Tuple[jax.Array, ...]:
+        """Bring the device arrays up to date with the host ``mirrors``
+        and return them in decode-program argument order.
+
+        ``masked_rows``: slot ids whose block-table row must read as the
+        trash block (partially-prefilled slots).
+        """
+        masked = set(masked_rows)
+        if self._dev is None or self._all_dirty:
+            host = [np.asarray(mirrors[f]) for f in _FIELDS]
+            if masked:
+                bt = host[0].copy()
+                bt[sorted(masked)] = 0
+                host[0] = bt
+            self._dev = tuple(self._place(h) for h in host)
+            self.stats["decode_state_uploads"] += 1
+            self.stats["decode_state_rows"] += self._num_slots
+            self._all_dirty = False
+            self._dirty.clear()
+        elif self._dirty:
+            idx = sorted(self._dirty)
+            n = len(idx)
+            npad = 1
+            while npad < n:
+                npad *= 2
+            npad = min(npad, self._num_slots)
+            # Pad with a repeat of the first dirty row: duplicate scatter
+            # indices carry identical values, so the .set is well-defined.
+            idx_arr = np.full((npad,), idx[0], np.int32)
+            idx_arr[:n] = idx
+            rows: List[np.ndarray] = []
+            for f in _FIELDS:
+                r = np.ascontiguousarray(np.asarray(mirrors[f])[idx_arr])
+                if f == "block_tables" and masked:
+                    for j, sid in enumerate(idx_arr):
+                        if int(sid) in masked:
+                            r[j] = 0
+                rows.append(r)
+            self._dev = self._update(self._dev, jnp.asarray(idx_arr),
+                                     tuple(jnp.asarray(r) for r in rows))
+            self.stats["decode_state_uploads"] += 1
+            self.stats["decode_state_rows"] += n
+            self._dirty.clear()
+        else:
+            self.stats["decode_state_clean_syncs"] += 1
+        return self._dev
+
+    def bump_gen_counts(self, k: int) -> None:
+        """Advance the resident gen counts by ``k`` decode steps — on
+        device, mirroring the host appends for every slot that survives
+        the window (finished slots were released → marked dirty)."""
+        if self._dev is None or k <= 0:
+            return
+        dev = list(self._dev)
+        dev[2] = self._bump(dev[2], np.int32(k))
+        self._dev = tuple(dev)

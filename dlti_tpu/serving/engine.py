@@ -110,6 +110,13 @@ class EngineConfig:
     spec_min_acceptance: float = 0.25
     spec_probe_window: int = 64
     spec_cooldown: int = 32
+    # Device-resident decode state (dlti_tpu.serving.decode_state): block
+    # tables, slot keys, gen counts, and sampling params live as
+    # persistent device arrays maintained incrementally with per-slot
+    # dirty tracking — a clean decode step uploads nothing. False falls
+    # back to the legacy full re-upload (jnp.asarray of every mirror,
+    # every step); outputs are byte-identical either way.
+    decode_state_cache: bool = True
     # Chunked prefill (the vLLM latency lever the throughput headline
     # lacks): cap prompt tokens prefilled per engine step, so admission
     # never stalls running decodes for a whole prompt length — partially
@@ -404,7 +411,25 @@ class InferenceEngine:
                       "decode_slot_steps": 0,
                       "prefix_cached_tokens": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_paused_rounds": 0}
+                      "spec_paused_rounds": 0,
+                      # Decode-state cache accounting (decode_state.py):
+                      # upload syncs / rows shipped / clean (zero-upload)
+                      # syncs. Present (at 0) even with the cache disabled
+                      # so the /metrics exposition schema is stable.
+                      "decode_state_uploads": 0, "decode_state_rows": 0,
+                      "decode_state_clean_syncs": 0}
+
+        # Device-resident twins of the per-slot mirrors, maintained
+        # incrementally (per-slot dirty tracking; clean steps upload
+        # nothing). All cache interaction happens on the stepper thread —
+        # same thread-safety contract as the mirrors themselves.
+        self._state_cache = None
+        if ec.decode_state_cache:
+            from dlti_tpu.serving.decode_state import DecodeStateCache
+
+            self._state_cache = DecodeStateCache(
+                ec.max_seqs, device=self._device, mesh=mesh,
+                stats=self.stats)
 
     # ------------------------------------------------------------------
     def _shard_for_tp(self, mesh) -> None:
@@ -591,15 +616,26 @@ class InferenceEngine:
 
         S = self.cfg.max_seqs
         i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
-        args = (avals(self.params), avals(self.cache),
-                jax.ShapeDtypeStruct((S, 1), i32),
-                jax.ShapeDtypeStruct((S, 1), i32),
+        if self._state_cache is not None:
+            # The decode-state cache feeds COMMITTED device arrays into
+            # the compiled programs; lower with their actual shardings so
+            # the AOT executables accept them (same reason params/cache
+            # carry theirs). Syncing here is correct at any time — it just
+            # brings the resident copies up to date with the mirrors.
+            state_avals = avals(self._state_cache.sync(
+                self._state_mirrors(), self._masked_rows()))
+        else:
+            state_avals = (
                 jax.ShapeDtypeStruct(self._block_tables.shape, i32),
                 jax.ShapeDtypeStruct((S, 2), u32),
                 jax.ShapeDtypeStruct((S,), i32),
                 jax.ShapeDtypeStruct((S,), f32),
                 jax.ShapeDtypeStruct((S,), i32),
                 jax.ShapeDtypeStruct((S,), f32))
+        args = (avals(self.params), avals(self.cache),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                *state_avals)
         # Idempotent: a re-warm unwraps back to the raw jit fn (the
         # _aot_or_jit wrapper has no .lower) and rebuilds the executable.
         raw = getattr(self._decode_fn, "_jit_fn", self._decode_fn)
@@ -983,6 +1019,7 @@ class InferenceEngine:
         # Count of tokens generated so far (nonzero on re-admission after
         # preemption, so the seeded draw stream continues where it left off).
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
+        self._mark_state_dirty(slot.slot_id)
         if self._spec_hist is not None:
             ctx = req.prompt_token_ids + req.output_token_ids
             self._spec_hist[slot.slot_id, :len(ctx)] = ctx
@@ -1071,6 +1108,28 @@ class InferenceEngine:
         for r, (slot, tokens, start, is_last) in enumerate(chunks):
             if is_last:
                 self._append_token(slot, int(toks[r]), float(lps[r]))
+                # Prefill completion: the first sampled token bumped the
+                # slot's gen count, and a chunked-mode slot's block-table
+                # row sheds its trash-block masking — either way the row
+                # must re-upload before the slot joins the decode batch.
+                self._mark_state_dirty(slot.slot_id)
+
+    def _mark_state_dirty(self, slot_id: int) -> None:
+        """A scheduling event changed ``slot_id``'s per-slot state mirrors
+        (admission, release, block growth, prefill completion): the next
+        decode dispatch must re-upload that row."""
+        if self._state_cache is not None:
+            self._state_cache.mark_dirty(slot_id)
+
+    def _state_mirrors(self) -> dict:
+        return {"block_tables": self._block_tables,
+                "slot_keys": self._slot_keys,
+                "gen_counts": self._gen_counts,
+                "temperature": self._temperature,
+                "top_k": self._top_k, "top_p": self._top_p}
+
+    def _masked_rows(self) -> list:
+        return [s.slot_id for s in self.slots if s.prefilling]
 
     def _decode_block_tables(self) -> np.ndarray:
         """Block tables as the decode-side programs may see them: rows of
@@ -1148,6 +1207,7 @@ class InferenceEngine:
                     continue
                 slot.blocks.extend(got)
                 self._block_tables[slot.slot_id, len(slot.blocks) - 1] = got[0]
+                self._mark_state_dirty(slot.slot_id)
 
         active = [s for s in self.slots
                   if not s.free and not s.prefilling]
@@ -1156,19 +1216,31 @@ class InferenceEngine:
         if use_spec:
             return self._spec_dispatch(active)
 
+        t_prep = time.perf_counter()
         ids = np.zeros((ec.max_seqs, 1), np.int32)
         pos = np.zeros((ec.max_seqs, 1), np.int32)  # inactive -> trash block
         for s in active:
             ids[s.slot_id, 0] = s.last_token
             pos[s.slot_id, 0] = s.seq_len  # position of the new token
-        args = (
-            self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(self._decode_block_tables()),
-            jnp.asarray(self._slot_keys),
-            jnp.asarray(self._gen_counts),
-            jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-        )
+        if self._state_cache is not None:
+            # Device-resident per-slot state: only rows dirtied since the
+            # last dispatch are shipped; a clean step uploads nothing and
+            # _decode_block_tables' full rebuild becomes a row update.
+            state_args = self._state_cache.sync(
+                self._state_mirrors(), self._masked_rows())
+        else:
+            state_args = (
+                jnp.asarray(self._decode_block_tables()),
+                jnp.asarray(self._slot_keys),
+                jnp.asarray(self._gen_counts),
+                jnp.asarray(self._temperature), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+            )
+        args = (self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
+                *state_args)
+        # Host prep cost of this dispatch (batch assembly + state sync) —
+        # the term dirty tracking is meant to hold flat as max_seqs grows.
+        self.telemetry.host_prep.observe(time.perf_counter() - t_prep)
         if k_steps > 1:
             fn = self._multi_decode_fns.get(k_steps)
             if fn is None:
@@ -1179,6 +1251,12 @@ class InferenceEngine:
             self.cache, tokens, logprobs = self._decode_fn(*args)
             tokens = tokens[:, None]
             logprobs = logprobs[:, None]
+        if self._state_cache is not None:
+            # The window advances every surviving slot's gen count by
+            # exactly k_steps (a slot finishing mid-window is released,
+            # which marks it dirty) — advance the resident counts on
+            # device instead of re-uploading the one every-step mirror.
+            self._state_cache.bump_gen_counts(k_steps)
         return ("plain", active, k_steps, tokens, logprobs)
 
     def _decode_complete(self, pending) -> List[Request]:
@@ -1235,6 +1313,12 @@ class InferenceEngine:
     def _spec_dispatch(self, active: List[_Slot]):
         """Dispatch the fused propose→verify→accept program (no sync)."""
         ec = self.cfg
+        if self._state_cache is not None:
+            # The spec path ships the mirrors directly (it uploads the
+            # full token history anyway) and emits a variable number of
+            # tokens per slot — the resident copies are stale wholesale
+            # after this round.
+            self._state_cache.mark_all_dirty()
         k, R = ec.num_draft_tokens, self._spec_rounds
         t_in = np.zeros((ec.max_seqs,), np.int32)
         seq_len = np.zeros((ec.max_seqs,), np.int32)
@@ -1369,6 +1453,7 @@ class InferenceEngine:
         self._top_p[slot.slot_id] = 1.0
         self._slot_keys[slot.slot_id] = 0
         self._gen_counts[slot.slot_id] = 0
+        self._mark_state_dirty(slot.slot_id)
 
     def abort_all(self, reason: str = "abort") -> List[Request]:
         """Fail every in-flight and queued request and free their slots.

@@ -31,7 +31,7 @@ import zlib
 from typing import Optional
 
 from dlti_tpu.telemetry.registry import (
-    Histogram, LATENCY_BUCKETS, TPOT_BUCKETS,
+    Histogram, HOST_PREP_BUCKETS, LATENCY_BUCKETS, TPOT_BUCKETS,
 )
 from dlti_tpu.telemetry.tracer import SpanTracer, get_tracer
 
@@ -59,9 +59,17 @@ class RequestTelemetry:
             "dlti_request_queue_time_seconds", LATENCY_BUCKETS,
             help="time from request arrival to slot admission",
             stats_key="request_queue_time_seconds")
+        # Host-side prep per decode dispatch (batch assembly + state
+        # sync): the term the device-resident decode-state cache holds
+        # flat as max_seqs grows (serving.decode_state).
+        self.host_prep = Histogram(
+            "dlti_decode_host_prep_seconds", HOST_PREP_BUCKETS,
+            help="host-side prep time per decode dispatch "
+                 "(batch assembly + decode-state sync)",
+            stats_key="decode_host_prep_seconds")
 
     def histograms(self):
-        return (self.ttft, self.tpot, self.queue_time)
+        return (self.ttft, self.tpot, self.queue_time, self.host_prep)
 
     # -- lifecycle hooks (called by the engine) -------------------------
     def on_submitted(self, req) -> None:

@@ -37,6 +37,10 @@ LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # over a relay link.
 TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 0.5, 1.0, 2.5, 5.0)
+# Host-side prep work per dispatch (batch assembly, decode-state sync):
+# tens of microseconds when clean, low milliseconds when rebuilding.
+HOST_PREP_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001,
+                     0.0025, 0.005, 0.01, 0.025, 0.05, 0.1)
 
 
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:

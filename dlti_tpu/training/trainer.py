@@ -456,6 +456,62 @@ class Trainer:
                 return dataset.epoch(epoch, skip_steps=skip_steps if epoch == start_epoch else 0)
             return batches_per_epoch
 
+        # -- background batch prefetch (dlti_tpu.data.prefetch) ---------
+        # Gather/pack runs on a worker thread, double-buffered
+        # cfg.data.prefetch_depth deep; where the step's input sharding is
+        # known host-side the worker also issues the device_put ahead of
+        # need (an async dispatch — the transfer overlaps the in-flight
+        # step). Batch ORDER is untouched (one worker, FIFO queue), so the
+        # loss trajectory is bit-identical to the inline path.
+        # Only dataset-driven epochs prefetch: a custom batches_per_epoch
+        # iterable may be a side-effecting generator whose *laziness* is
+        # load-bearing (e.g. requesting a stop at yield time), and eager
+        # consumption would reorder those effects against the step loop.
+        prefetch_depth = (max(0, int(cfg.data.prefetch_depth))
+                          if dataset is not None else 0)
+        prefetch_place = None
+        if prefetch_depth > 0 and multi_fn is None:
+            if self.mesh is None:
+                # Single-device jit: plain default-device placement.
+                prefetch_place = jax.device_put
+            elif (jax.process_count() == 1 and cfg.parallel.pipe == 1
+                  and not (cfg.parallel.offload_optimizer
+                           or cfg.parallel.offload_params)):
+                # Flat sharded path: place with the step's own batch
+                # sharding (make_sharded_train_step's in_shardings), so
+                # dispatch finds the operands already resident. Pipe and
+                # offload steps keep host batches (their wrappers reshape
+                # or move operands themselves); multi-host keeps
+                # make_global_batch on the step thread.
+                from jax.sharding import NamedSharding
+
+                from dlti_tpu.parallel.sharding import batch_pspec
+
+                _b_sh = NamedSharding(self.mesh, batch_pspec(cfg))
+                prefetch_place = lambda b: {  # noqa: E731
+                    k: jax.device_put(v, _b_sh) for k, v in b.items()}
+        # steps_per_sync windows stack HOST batches (exec_window), so the
+        # worker prefetches the gather only — placement would be a wasted
+        # second transfer. Window mode still benefits: the gather/pack for
+        # batch N+1 overlaps the scanned window N.
+        self._prefetcher = None
+
+        def make_batch_iter(epoch):
+            src = epoch_batches(epoch)
+            if prefetch_depth > 0:
+                from dlti_tpu.data.prefetch import HostPrefetcher
+
+                self._prefetcher = HostPrefetcher(
+                    src, depth=prefetch_depth, place_fn=prefetch_place,
+                    tracer=tracer)
+                return iter(self._prefetcher)
+            return iter(src)
+
+        def close_prefetcher():
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
+
         eval_fn = None
         if eval_dataset is not None and cfg.train.eval_steps:
             if cfg.parallel.pipe > 1:
@@ -632,11 +688,14 @@ class Trainer:
         _EPOCH_END = object()  # sentinel: a batch is never this object
         try:
             for epoch in range(start_epoch, cfg.train.num_epochs):
-                batch_iter = iter(epoch_batches(epoch))
+                batch_iter = make_batch_iter(epoch)
                 while True:
                     # Manual iteration so the data-pipeline wait is its
                     # own trace span (the phase MegaScale singles out:
                     # input stalls masquerade as slow steps otherwise).
+                    # Under prefetch this span measures the *stall* only —
+                    # the gather itself runs in the worker's
+                    # train/prefetch spans.
                     with tracer.span("train/batch_fetch", cat="train"):
                         batch = next(batch_iter, _EPOCH_END)
                     if batch is _EPOCH_END:
@@ -659,12 +718,20 @@ class Trainer:
                             profile_state = "done"
                             self.logger.info("profiler trace -> %s",
                                              cfg.train.profile_dir)
-                    host_batch = batch
+                    if self._prefetcher is not None:
+                        # (host numpy batch, worker-placed batch); placed
+                        # is the host batch itself when placement stayed
+                        # on the step thread (windows, multi-host, pipe).
+                        host_batch, batch = batch
+                    else:
+                        host_batch = batch
                     if self.mesh is not None:
                         from dlti_tpu.parallel.sharding import make_global_batch
 
                         with tracer.span("train/host_to_device",
                                          cat="train"):
+                            # Single-process: pass-through (worker-placed
+                            # batches arrive here already device-resident).
                             batch = make_global_batch(batch, cfg, self.mesh)
                     rng, step_rng = jax.random.split(rng)
                     if multi_fn is None:
@@ -702,6 +769,10 @@ class Trainer:
                     bookkeep(state, executed)
                     if self._stop_requested:
                         break
+                # Epoch over (or preempted / max_steps): stop the worker
+                # and drop its buffered batches — they were never counted,
+                # so resume replays them.
+                close_prefetcher()
                 if window and not self._stop_requested:
                     # Epoch tail shorter than the window. On preemption the
                     # pending window is dropped instead — those steps never
@@ -731,6 +802,7 @@ class Trainer:
                     self.logger.info(
                         "preemption checkpoint written at step %d", global_step)
         finally:
+            close_prefetcher()  # a mid-epoch exception must not leak the worker
             if sigterm_installed:
                 # signal.signal reports a non-Python-installed previous
                 # handler as None; SIG_DFL is the closest restorable state.

@@ -52,6 +52,12 @@ def load_native_runtime() -> Optional[ctypes.CDLL]:
     lib.dlti_allocator_allocate.restype = ctypes.c_int32
     lib.dlti_allocator_free.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    # Guarded free (absent in older builds): 1 = freed, 0 = rejected
+    # batch (out-of-range / double free); rejection frees nothing.
+    if hasattr(lib, "dlti_allocator_free_checked"):
+        lib.dlti_allocator_free_checked.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.dlti_allocator_free_checked.restype = ctypes.c_int32
     # Packer ABI (absent in older builds of the library).
     if hasattr(lib, "dlti_pack_assign"):
         lib.dlti_pack_assign.argtypes = [

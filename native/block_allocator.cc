@@ -20,6 +20,7 @@ namespace {
 struct Allocator {
   std::mutex mu;
   std::vector<int32_t> free_list;  // LIFO for cache locality
+  std::vector<uint8_t> live;       // live[b]: handed out, not yet freed
   int32_t num_blocks;
 };
 
@@ -31,6 +32,7 @@ void* dlti_allocator_create(int32_t num_blocks) {
   if (num_blocks < 2) return nullptr;
   auto* a = new Allocator();
   a->num_blocks = num_blocks;
+  a->live.assign(num_blocks, 0);
   a->free_list.reserve(num_blocks - 1);
   // Matches the Python fallback: pop() yields ascending block ids first.
   for (int32_t b = num_blocks - 1; b >= 1; --b) a->free_list.push_back(b);
@@ -55,6 +57,7 @@ int32_t dlti_allocator_allocate(void* handle, int32_t n, int32_t* out) {
   for (int32_t i = 0; i < n; ++i) {
     out[i] = a->free_list.back();
     a->free_list.pop_back();
+    a->live[out[i]] = 1;
   }
   return 1;
 }
@@ -64,8 +67,32 @@ void dlti_allocator_free(void* handle, int32_t n, const int32_t* blocks) {
   std::lock_guard<std::mutex> lock(a->mu);
   for (int32_t i = 0; i < n; ++i) {
     int32_t b = blocks[i];
-    if (b >= 1 && b < a->num_blocks) a->free_list.push_back(b);
+    if (b >= 1 && b < a->num_blocks) {
+      a->free_list.push_back(b);
+      a->live[b] = 0;
+    }
   }
+}
+
+// Guarded free: O(1) live-flag check per block. Returns 1 and frees the
+// whole batch, or returns 0 and frees NOTHING if any id is out of range,
+// not currently allocated (double free), or duplicated within the batch —
+// mirroring the Python free-list guard: a silent double free would hand
+// one block to two sequences and corrupt their KV far from the cause.
+int32_t dlti_allocator_free_checked(void* handle, int32_t n,
+                                    const int32_t* blocks) {
+  auto* a = static_cast<Allocator*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t b = blocks[i];
+    if (b < 1 || b >= a->num_blocks || !a->live[b]) {
+      for (int32_t j = 0; j < i; ++j) a->live[blocks[j]] = 1;  // roll back
+      return 0;
+    }
+    a->live[b] = 0;  // also catches duplicates within this batch
+  }
+  for (int32_t i = 0; i < n; ++i) a->free_list.push_back(blocks[i]);
+  return 1;
 }
 
 }  // extern "C"

@@ -100,6 +100,12 @@ def parse_args():
     p.add_argument("--quantization", default="none", choices=["none", "int8"],
                    help="weight-only quantization (int8 + per-channel scales; "
                         "~halves weight HBM)")
+    p.add_argument("--no-decode-state-cache", action="store_true",
+                   help="disable the device-resident decode-state cache "
+                        "(per-slot dirty tracking; clean decode steps "
+                        "upload no host state) and re-upload every mirror "
+                        "each step — debugging/A-B only, outputs are "
+                        "byte-identical either way")
     p.add_argument("--speculative", default="none", choices=["none", "ngram"],
                    help="n-gram prompt-lookup speculative decoding (exact "
                         "greedy outputs, multiple tokens per model call)")
@@ -190,6 +196,7 @@ def main() -> None:
         spec_probe_window=args.spec_probe_window,
         spec_cooldown=args.spec_cooldown,
         max_prefill_tokens_per_step=args.max_prefill_tokens,
+        decode_state_cache=not args.no_decode_state_cache,
     )
     if args.replicas > 1:
         from dlti_tpu.serving import ReplicatedEngine

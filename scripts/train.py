@@ -71,6 +71,11 @@ def parse_args():
     p.add_argument("--max-seq-len", type=int, default=512)
     p.add_argument("--pack", action="store_true",
                    help="pack sequences to fill seq_len (perf option; reference pads)")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="background batch-prefetch depth: gather/pack and "
+                        "the host→device transfer run off the step thread, "
+                        "double-buffered this deep (bit-identical loss "
+                        "trajectory; 0 = legacy inline fetch)")
     # Mesh axes (the torchrun/deepspeed --num_gpus analog).
     p.add_argument("--num-devices", type=int, default=0,
                    help="DP/FSDP extent; 0 = all visible devices / "
@@ -296,7 +301,8 @@ def build_config(args):
         optimizer=OptimizerConfig(learning_rate=args.learning_rate,
                                   warmup_steps=args.warmup_steps),
         data=DataConfig(dataset_path=args.dataset_path, tokenizer=args.tokenizer,
-                        max_seq_len=args.max_seq_len, pack_sequences=args.pack),
+                        max_seq_len=args.max_seq_len, pack_sequences=args.pack,
+                        prefetch_depth=args.prefetch_depth),
         checkpoint=CheckpointConfig(output_dir=args.output_dir,
                                     save_strategy=args.save_strategy,
                                     save_steps=args.save_steps,

@@ -100,6 +100,38 @@ def test_gateway_metric_names_are_schema_stable():
     )
 
 
+def test_host_overlap_metric_names_are_schema_stable():
+    """Host-latency-hiding telemetry names are a scrape contract like the
+    gateway set: the training prefetcher's gauge/histogram, the engine's
+    decode host-prep histogram, and the decode-state upload counters
+    (exposed via the engine stats scalar source as dlti_<key>)."""
+    from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
+
+    assert PREFETCH_METRIC_NAMES == (
+        "dlti_train_prefetch_queue_depth",
+        "dlti_train_prefetch_stall_seconds",
+    )
+
+    from dlti_tpu.telemetry import RequestTelemetry
+
+    tel = RequestTelemetry()
+    assert [h.name for h in tel.histograms()] == [
+        "dlti_request_ttft_seconds",
+        "dlti_request_tpot_seconds",
+        "dlti_request_queue_time_seconds",
+        "dlti_decode_host_prep_seconds",
+    ]
+
+    # Engine stats keys ride the /metrics scalar source (dlti_ prefix):
+    # dlti_decode_state_uploads / _rows / _clean_syncs.
+    from dlti_tpu.serving.decode_state import DecodeStateCache
+
+    stats: dict = {}
+    DecodeStateCache(2, stats=stats)
+    assert set(stats) == {"decode_state_uploads", "decode_state_rows",
+                          "decode_state_clean_syncs"}
+
+
 def test_load_report_schema_includes_gateway_fields():
     """scripts/benchmark_serving.py consumers parse the report JSON by
     key; the multi-tenant/priority additions are part of that schema."""
