@@ -1,18 +1,33 @@
 """Checkpoint / resume / export (SURVEY.md §5.4).
 
-Orbax-backed async sharded checkpointing with rotation (the reference's
-``save_steps=100, save_total_limit=3`` contract,
+Crash-consistent in-tree checkpoint store (``checkpoint.store``): atomic
+finalize (per-array SHA-256 manifest + commit marker written last, then a
+directory rename), async saves with bounded retry/backoff, digest-verified
+resume with quarantine-and-fall-back for incomplete or corrupt
+checkpoints, and a sidecar carrying the data-pipeline cursor + rng
+schedule so a resumed run replays a bit-identical loss trajectory.
+Rotation (``save_steps=100, save_total_limit=3`` parity,
 ``train_deepspeed_zero1.py:243-245``), scan-latest resume
-(``train_deepspeed_zero1.py:267-279``), and consolidated merged-LoRA export
-(the ``stage3_gather_16bit_weights_on_model_save`` + PEFT-merge capability,
-``configs/ds_config_zero3.json:36``).
+(``train_deepspeed_zero1.py:267-279``), and consolidated merged-LoRA
+export (the ``stage3_gather_16bit_weights_on_model_save`` + PEFT-merge
+capability, ``configs/ds_config_zero3.json:36``) carry over from the
+earlier Orbax backend, which this store replaced (its tensorstore restore
+corrupts the heap under the persistent XLA compilation cache, and its
+OCDBT format is opaque to content verification).
 """
 
-from dlti_tpu.checkpoint.orbax_io import (  # noqa: F401
+from dlti_tpu.checkpoint.store import (  # noqa: F401
+    CKPT_METRIC_NAMES,
+    CheckpointCorruptError,
     latest_step,
+    latest_verified_step,
     list_checkpoint_steps,
+    load_train_meta,
+    quarantine_step,
+    restore_latest_verified,
     restore_train_state,
     save_train_state,
+    verify_checkpoint,
     wait_for_saves,
 )
 from dlti_tpu.checkpoint.export import (  # noqa: F401

@@ -1,0 +1,128 @@
+"""Checkpoint corruption helpers for chaos tests.
+
+Deterministic ways to damage an on-disk checkpoint the way real failures
+do — a kill mid-save (stale staging dir), a truncated write, a bit flip
+from a bad disk/NIC — so tier-1 tests can prove the verified-resume path
+quarantines the damage and falls back instead of crashing. Used by
+``tests/test_crash_consistency.py``; importable by operators for fire
+drills.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Optional
+
+from dlti_tpu.checkpoint.store import (
+    _ARRAY_DIR,
+    _COMMIT,
+    _MANIFEST,
+    _TMP_PREFIX,
+)
+
+CORRUPT_MODES = (
+    "bitflip-array",      # flip one bit in the middle of an array file
+    "truncate-array",     # cut an array file to half its size
+    "truncate-manifest",  # cut MANIFEST.json short (unparseable)
+    "drop-commit",        # delete the COMMIT marker (looks mid-finalize)
+    "stale-tmp",          # demote the committed dir to a .tmp-* staging
+                          # dir — byte-for-byte what a kill mid-async-save
+                          # leaves behind
+)
+
+
+def bit_flip_file(path: str, offset: Optional[int] = None,
+                  bit: int = 0) -> None:
+    """Flip one bit in ``path`` (default: the middle byte) in place."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot bit-flip empty file {path}")
+    pos = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ (1 << bit)]))
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
+    """Truncate ``path`` to ``keep_bytes`` (default: half)."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else keep_bytes
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def _largest_array_file(step_dir: str) -> str:
+    adir = os.path.join(step_dir, _ARRAY_DIR)
+    files = [os.path.join(adir, n) for n in sorted(os.listdir(adir))]
+    files = [f for f in files if os.path.getsize(f) > 0]
+    if not files:
+        raise FileNotFoundError(f"no non-empty array files under {adir}")
+    return max(files, key=os.path.getsize)
+
+
+def corrupt_checkpoint(directory: str, step: int, mode: str) -> str:
+    """Damage the committed checkpoint ``directory/step`` per ``mode``
+    (one of :data:`CORRUPT_MODES`). Returns the path that was damaged."""
+    step_dir = os.path.join(os.path.abspath(directory), str(step))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"no committed checkpoint at {step_dir}")
+    if mode == "bitflip-array":
+        path = _largest_array_file(step_dir)
+        bit_flip_file(path)
+        return path
+    if mode == "truncate-array":
+        path = _largest_array_file(step_dir)
+        truncate_file(path)
+        return path
+    if mode == "truncate-manifest":
+        path = os.path.join(step_dir, _MANIFEST)
+        truncate_file(path)
+        return path
+    if mode == "drop-commit":
+        path = os.path.join(step_dir, _COMMIT)
+        os.remove(path)
+        return path
+    if mode == "stale-tmp":
+        dst = os.path.join(os.path.dirname(step_dir),
+                           f"{_TMP_PREFIX}{step}-chaos")
+        os.rename(step_dir, dst)
+        # A real mid-save kill also never wrote the commit marker.
+        commit = os.path.join(dst, _COMMIT)
+        if os.path.exists(commit):
+            os.remove(commit)
+        return dst
+    raise ValueError(f"unknown corruption mode {mode!r}; "
+                     f"expected one of {CORRUPT_MODES}")
+
+
+def make_torn_save(directory: str, step: int,
+                   source_step: Optional[int] = None) -> str:
+    """Fabricate the wreckage of a save killed mid-write: a ``.tmp-*``
+    staging dir holding a partial copy (arrays but no manifest/commit).
+    ``source_step`` supplies the bytes (default: any committed step)."""
+    directory = os.path.abspath(directory)
+    if source_step is None:
+        from dlti_tpu.checkpoint.store import list_checkpoint_steps
+
+        steps = list_checkpoint_steps(directory)
+        if not steps:
+            raise FileNotFoundError(f"no committed checkpoint in {directory}")
+        source_step = steps[-1]
+    src = os.path.join(directory, str(source_step))
+    dst = os.path.join(directory, f"{_TMP_PREFIX}{step}-torn")
+    shutil.copytree(src, dst)
+    for name in (_MANIFEST, _COMMIT):
+        path = os.path.join(dst, name)
+        if os.path.exists(path):
+            os.remove(path)
+    return dst
+
+
+def read_manifest(directory: str, step: int) -> dict:
+    with open(os.path.join(os.path.abspath(directory), str(step),
+                           _MANIFEST)) as f:
+        return json.load(f)

@@ -5,27 +5,28 @@ gather sharded weights on save (``stage3_gather_16bit_weights_on_model_save``,
 ``configs/ds_config_zero3.json:36``) then merge LoRA into the base model for
 serving (vLLM leg, ``README.md:10``). Here: fold LoRA factors into base
 kernels (:func:`~dlti_tpu.models.lora.merge_lora_params`), gather to host,
-and write a single Orbax checkpoint + config JSON that the serving engine
-loads directly.
+and write a single manifest-verified pytree artifact
+(:func:`~dlti_tpu.checkpoint.store.save_pytree`) + config JSON that the
+serving engine loads directly.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
-import numpy as np
-import orbax.checkpoint as ocp
 
-from dlti_tpu.config import Config, ModelConfig
+from dlti_tpu.checkpoint.store import load_pytree, save_pytree
+from dlti_tpu.config import Config
 from dlti_tpu.models.lora import merge_lora_params
 
 
 def export_merged_model(directory: str, params, cfg: Config,
                         merge_lora: bool = True) -> str:
-    """Write ``directory/model`` (orbax pytree) + ``directory/config.json``.
+    """Write ``directory/model`` (manifest-verified pytree) +
+    ``directory/config.json``.
 
     ``params`` may be sharded; leaves are gathered to host first (the
     16-bit-gather-on-save analog). Returns the export directory.
@@ -36,10 +37,7 @@ def export_merged_model(directory: str, params, cfg: Config,
     if merge_lora and cfg.lora.enabled:
         host_params = merge_lora_params(host_params, alpha=cfg.lora.alpha)
 
-    ckptr = ocp.StandardCheckpointer()
-    model_dir = os.path.join(directory, "model")
-    ckptr.save(model_dir, host_params, force=True)
-    ckptr.wait_until_finished()
+    save_pytree(os.path.join(directory, "model"), host_params)
 
     meta = cfg.to_dict()
     meta["lora"]["enabled"] = False if merge_lora else meta["lora"]["enabled"]
@@ -53,6 +51,11 @@ def load_exported_model(directory: str) -> Tuple[dict, Config]:
     directory = os.path.abspath(directory)
     with open(os.path.join(directory, "config.json")) as f:
         cfg = Config.from_dict(json.load(f))
-    ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(directory, "model"))
+    model_dir = os.path.join(directory, "model")
+    if not os.path.isfile(os.path.join(model_dir, "MANIFEST.json")):
+        # Legacy export written by the old Orbax backend.
+        import orbax.checkpoint as ocp
+
+        return ocp.StandardCheckpointer().restore(model_dir), cfg
+    params = load_pytree(model_dir)
     return params, cfg

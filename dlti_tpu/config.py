@@ -245,8 +245,16 @@ class CheckpointConfig:
     save_strategy: str = "steps"  # "steps" | "epoch" | "no"
     save_steps: int = 100
     save_total_limit: int = 3
-    resume: bool = True  # scan-latest-and-resume (train_deepspeed_zero1.py:267-279)
+    # Scan-latest-and-resume (train_deepspeed_zero1.py:267-279) — since the
+    # crash-consistency pass, "latest" means latest *verified*: checkpoints
+    # failing digest verification are quarantined and resume falls back to
+    # the newest good one (dlti_tpu.checkpoint.store).
+    resume: bool = True
     async_save: bool = True
+    # Bounded retry/backoff for transient checkpoint-write failures (a
+    # failed save is logged loudly but never kills the training run).
+    save_retries: int = 3
+    save_retry_backoff_s: float = 0.2
 
 
 @dataclass(frozen=True)
@@ -321,6 +329,13 @@ class TrainConfig:
     record_replay_dir: str = ""
     record_replay_every: int = 100
     record_replay_keep: int = 8
+    # Deterministic trainer-side chaos hook ("STEP[:MODE]", MODE in raise |
+    # kill | save-raise | save-kill — dlti_tpu.training.chaos), mirroring
+    # the gateway's DLTI_GATEWAY_FAULT_INJECT. Also settable via env
+    # DLTI_TRAIN_FAULT_INJECT. Chaos tests and fire drills use it to kill
+    # the trainer at an exact step (or mid-async-save) and prove the
+    # verified-resume path recovers. "" = off.
+    fault_inject_step: str = ""
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,94 @@
+"""Deterministic trainer-side fault injection.
+
+The training analog of the gateway's ``DLTI_GATEWAY_FAULT_INJECT`` chaos
+hook: kill or crash the trainer at an exact, reproducible point so chaos
+tests (and operators running fire drills) can prove the
+checkpoint/resume path recovers — without waiting for a real preemption.
+
+Spec format (``--fault-inject-step`` / ``DLTI_TRAIN_FAULT_INJECT``)::
+
+    STEP[:MODE]
+
+where MODE is one of
+
+* ``raise``     — raise :class:`TrainFault` after optimizer step STEP
+                  completes (and its save, if due, has been issued).
+                  Default.
+* ``kill``      — ``SIGKILL`` the process at the same point: no finally
+                  blocks, no atexit, no flushed saves — the honest
+                  preemption/OOM-killer simulation.
+* ``save-raise``— raise *inside* the save path at the first save with
+                  step >= STEP, right after the async write is enqueued.
+* ``save-kill`` — ``SIGKILL`` at that same point; with async saves the
+                  writer thread dies mid-write, leaving the torn
+                  ``.tmp-*`` staging dir the verified-resume scan must
+                  quarantine.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+
+class TrainFault(RuntimeError):
+    """Raised by the fault injector (``raise`` / ``save-raise`` modes)."""
+
+
+_MODES = ("raise", "kill", "save-raise", "save-kill")
+
+
+class TrainFaultInjector:
+    """Parsed ``STEP[:MODE]`` spec; fires at most once."""
+
+    def __init__(self, step: int, mode: str):
+        if step < 1:
+            raise ValueError(f"fault-inject step must be >= 1, got {step}")
+        if mode not in _MODES:
+            raise ValueError(
+                f"unknown fault-inject mode {mode!r}; expected one of "
+                f"{_MODES}")
+        self.step = step
+        self.mode = mode
+        self.fired = False
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> Optional["TrainFaultInjector"]:
+        """Parse a spec string; empty/None falls back to the
+        ``DLTI_TRAIN_FAULT_INJECT`` env var, then to no injector."""
+        spec = (spec or "").strip() or os.environ.get(
+            "DLTI_TRAIN_FAULT_INJECT", "").strip()
+        if not spec:
+            return None
+        step_s, _, mode = spec.partition(":")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"bad fault-inject spec {spec!r}; expected 'STEP[:MODE]' "
+                f"with MODE in {_MODES}") from None
+        return cls(step, mode or "raise")
+
+    # ------------------------------------------------------------------
+    def _fire(self, where: str, step: int) -> None:
+        self.fired = True
+        if self.mode.endswith("kill"):
+            # No Python teardown at all — the process vanishes like a
+            # preempted node. stdio is not flushed on purpose.
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise TrainFault(
+            f"injected fault ({self.mode}) {where} at step {step}")
+
+    def maybe_fire_step(self, step: int) -> None:
+        """Call at the end of each optimizer-step boundary."""
+        if (not self.fired and self.mode in ("raise", "kill")
+                and step >= self.step):
+            self._fire("at step boundary", step)
+
+    def maybe_fire_save(self, step: int) -> None:
+        """Call right after a checkpoint save has been issued (async
+        writes still in flight — that is the point)."""
+        if (not self.fired and self.mode in ("save-raise", "save-kill")
+                and step >= self.step):
+            self._fire("mid-save", step)

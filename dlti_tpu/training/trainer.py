@@ -183,6 +183,8 @@ class Trainer:
         # Preemption flag: set by SIGTERM (cluster eviction) or
         # request_stop(); honored at the next step boundary.
         self._stop_requested = False
+        # Chaos injector (dlti_tpu.training.chaos); (re)parsed per train().
+        self._fault = None
         self._last_eval_loss = float("nan")
         # Host-side span tracer (telemetry.tracer): per-step phase spans
         # (batch fetch, host→device, dispatch, device sync, eval, save).
@@ -371,15 +373,34 @@ class Trainer:
         except ValueError:
             pass  # not the main thread (e.g. embedded in a server)
 
-        start_step = 0
-        if resume and cfg.checkpoint.save_strategy != "no":
-            from dlti_tpu.checkpoint import latest_step, restore_train_state
+        # Deterministic chaos hook (dlti_tpu.training.chaos): fresh per
+        # train() call so a resumed run re-reads the spec/env.
+        from dlti_tpu.training.chaos import TrainFaultInjector
 
-            step = latest_step(cfg.checkpoint.output_dir)
-            if step is not None:
-                state = restore_train_state(cfg.checkpoint.output_dir, step, state)
+        self._fault = TrainFaultInjector.from_spec(cfg.train.fault_inject_step)
+
+        start_step = 0
+        resume_meta = None
+        if resume and cfg.checkpoint.save_strategy != "no":
+            from dlti_tpu.checkpoint import restore_latest_verified
+
+            # Verified resume: digest-checks newest-first, quarantining
+            # incomplete/corrupt checkpoints (kill mid-save, bit rot) and
+            # falling back to the newest good one instead of crashing.
+            restored = restore_latest_verified(cfg.checkpoint.output_dir,
+                                               state)
+            if restored is not None:
+                state, step, resume_meta = restored
                 start_step = int(step)
-                self.logger.info("resumed from checkpoint step %d", start_step)
+                self.logger.info(
+                    "resumed from verified checkpoint step %d", start_step)
+                if resume_meta and resume_meta.get("seed", cfg.train.seed) \
+                        != cfg.train.seed:
+                    self.logger.warning(
+                        "checkpoint was saved with train.seed=%s but this "
+                        "run uses %s — the resumed loss trajectory will "
+                        "not match the original run's",
+                        resume_meta.get("seed"), cfg.train.seed)
 
         step_fn = self._build_step(state)
         sync_k = max(1, int(cfg.train.steps_per_sync))
@@ -399,7 +420,13 @@ class Trainer:
             from dlti_tpu.training.step import make_multi_step
 
             multi_fn = make_multi_step(step_fn)
-        rng = jax.random.PRNGKey(cfg.train.seed + 1)
+        # Per-step rng keys are folded from a fixed base by *global step
+        # index* (not a split chain): step N uses fold_in(base, N) whether
+        # the run reached N directly or resumed into it, which is what
+        # makes a mid-epoch resume's loss trajectory bit-identical to the
+        # uninterrupted run's — a split chain would desynchronize on
+        # resume (and on preemption-dropped window batches).
+        rng_base = jax.random.PRNGKey(cfg.train.seed + 1)
         timer = StepTimer(warmup_steps=2)
 
         trainable, total = count_params(state.params)
@@ -450,6 +477,24 @@ class Trainer:
             if spe > 0:
                 start_epoch = min(start_step // spe, cfg.train.num_epochs)
                 skip_steps = start_step % spe
+            if resume_meta and resume_meta.get("dataset"):
+                # The sidecar records the data cursor the checkpoint was
+                # saved at; a mismatch means the resumed run is feeding a
+                # different schedule than the original (exact replay off).
+                saved = resume_meta["dataset"]
+                if saved.get("steps_per_epoch") not in (None, 0, spe):
+                    self.logger.warning(
+                        "checkpoint sidecar recorded steps_per_epoch=%s "
+                        "but this dataset yields %s — mid-epoch resume "
+                        "will replay a different batch schedule",
+                        saved.get("steps_per_epoch"), spe)
+                cur_shuffle = getattr(dataset, "shuffle_seed", None)
+                if saved.get("shuffle_seed", cur_shuffle) != cur_shuffle:
+                    self.logger.warning(
+                        "checkpoint sidecar recorded shuffle_seed=%s but "
+                        "this dataset uses %s — batch order will differ "
+                        "from the original run",
+                        saved.get("shuffle_seed"), cur_shuffle)
 
         def epoch_batches(epoch):
             if dataset is not None:
@@ -621,6 +666,33 @@ class Trainer:
                 return state, []
             return exec_steps(state, items)
 
+        def sidecar_meta():
+            """Full-state sidecar saved next to the arrays: the data
+            cursor + rng schedule that make a resumed run replay the
+            exact batch/rng sequence (prefetched-but-unexecuted batches
+            are dropped on every exit path, so the cursor IS the step)."""
+            spe = dataset.steps_per_epoch() if dataset is not None else 0
+            return {
+                "format": 1,
+                "step": global_step,
+                "epoch": (global_step // spe) if spe else 0,
+                "step_in_epoch": (global_step % spe) if spe else 0,
+                "samples_seen": samples_seen,
+                "seed": cfg.train.seed,
+                "rng_schedule": "fold_in_v1",
+                "dataset": {
+                    "kind": type(dataset).__name__ if dataset is not None
+                    else None,
+                    "steps_per_epoch": spe,
+                    "shuffle_seed": getattr(dataset, "shuffle_seed", None),
+                    "packed": bool(getattr(dataset, "pack",
+                                           getattr(dataset, "packed",
+                                                   False))),
+                },
+                "prefetch_depth": prefetch_depth,
+                "fp16": bool(cfg.train.fp16),
+            }
+
         def bookkeep(state, executed):
             """Per-step records for a batch of executed steps, then
             window-boundary eval/save (cadence-crossing aware, so
@@ -683,7 +755,12 @@ class Trainer:
                          > step_before // cfg.train.eval_steps)):
                 self._run_eval(eval_fn, state, eval_dataset, global_step)
             self._maybe_save(state, global_step, epoch_end=False,
-                             crossed_from=step_before)
+                             crossed_from=step_before, meta=sidecar_meta())
+            if self._fault is not None:
+                # Step-boundary chaos: fires after the step booked (and
+                # its save, if due, was issued) — the crash point real
+                # preemptions hit.
+                self._fault.maybe_fire_step(global_step)
 
         _EPOCH_END = object()  # sentinel: a batch is never this object
         try:
@@ -733,7 +810,12 @@ class Trainer:
                             # Single-process: pass-through (worker-placed
                             # batches arrive here already device-resident).
                             batch = make_global_batch(batch, cfg, self.mesh)
-                    rng, step_rng = jax.random.split(rng)
+                    # This batch executes as optimizer step global_step +
+                    # len(window) + 1 (window always empty on the plain
+                    # path); folding by that index keeps the schedule
+                    # stateless — resumable and drop-safe.
+                    step_rng = jax.random.fold_in(
+                        rng_base, global_step + len(window) + 1)
                     if multi_fn is None:
                         state, executed = exec_steps(
                             state, [(host_batch, batch, step_rng)])
@@ -780,7 +862,8 @@ class Trainer:
                     state, executed = drain_window(state)
                     if executed:
                         bookkeep(state, executed)
-                self._maybe_save(state, global_step, epoch_end=True)
+                self._maybe_save(state, global_step, epoch_end=True,
+                                 meta=sidecar_meta())
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
                 if self._stop_requested:
@@ -791,14 +874,17 @@ class Trainer:
 
                 # _maybe_save may have just written this very step (e.g. the
                 # stop landed on a save_steps boundary or at epoch end);
-                # Orbax raises StepAlreadyExistsError on a duplicate save.
-                # Settle any in-flight async save before checking.
+                # settle any in-flight async save before checking (the
+                # store makes duplicate saves idempotent, but a redundant
+                # synchronous write is still wasted I/O).
                 wait_for_saves(cfg.checkpoint.output_dir)
                 if latest_step(cfg.checkpoint.output_dir) != global_step:
                     save_train_state(
                         cfg.checkpoint.output_dir, global_step, state,
                         keep=cfg.checkpoint.save_total_limit,
-                        async_save=False)
+                        async_save=False, train_meta=sidecar_meta(),
+                        retries=cfg.checkpoint.save_retries,
+                        retry_backoff_s=cfg.checkpoint.save_retry_backoff_s)
                     self.logger.info(
                         "preemption checkpoint written at step %d", global_step)
         finally:
@@ -811,10 +897,19 @@ class Trainer:
                                else _signal.SIG_DFL)
             if profile_state == "active":  # run ended inside the trace window
                 jax.profiler.stop_trace()
-        if cfg.checkpoint.save_strategy != "no":
-            from dlti_tpu.checkpoint import wait_for_saves
+            if cfg.checkpoint.save_strategy != "no":
+                # Settle in-flight async saves on EVERY exit path —
+                # exception and normal return alike — so a training crash
+                # cannot strand a half-written "latest" checkpoint (write
+                # failures are logged by the store, never raised here,
+                # which keeps an original exception unmasked).
+                from dlti_tpu.checkpoint import wait_for_saves
 
-            wait_for_saves(cfg.checkpoint.output_dir)  # async saves must land
+                try:
+                    wait_for_saves(cfg.checkpoint.output_dir)
+                except Exception:
+                    self.logger.exception(
+                        "settling in-flight checkpoint saves failed")
 
         wall = time.time() - t_start
         record = self._final_metrics(
@@ -868,7 +963,8 @@ class Trainer:
         return eval_loss
 
     def _maybe_save(self, state: TrainState, step: int, epoch_end: bool,
-                    crossed_from: Optional[int] = None) -> None:
+                    crossed_from: Optional[int] = None,
+                    meta: Optional[dict] = None) -> None:
         cfg = self.cfg.checkpoint
         if cfg.save_strategy == "no":
             return
@@ -893,7 +989,13 @@ class Trainer:
             save_train_state(
                 cfg.output_dir, step, state,
                 keep=cfg.save_total_limit, async_save=cfg.async_save,
+                train_meta=meta, retries=cfg.save_retries,
+                retry_backoff_s=cfg.save_retry_backoff_s,
             )
+        if self._fault is not None:
+            # Mid-save chaos: with async_save the write is in flight right
+            # now — a save-kill here is the honest torn-checkpoint case.
+            self._fault.maybe_fire_save(step)
 
     def _strategy(self) -> str:
         """Strategy label for the reference CSV / telemetry stream."""

@@ -57,7 +57,7 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
 
     from dlti_tpu.checkpoint import (
-        export_merged_model, latest_step, restore_train_state,
+        export_merged_model, latest_verified_step, restore_train_state,
     )
     from dlti_tpu.config import Config, LoRAConfig, OptimizerConfig, preset
     from dlti_tpu.models import LlamaForCausalLM
@@ -82,16 +82,18 @@ def main() -> None:
                 params=quantize_params_int8(state.params))
         return state
 
-    # eval_shape materializes nothing; orbax needs each abstract leaf to
-    # carry a concrete sharding, so pin them all to host CPU.
+    # eval_shape materializes nothing; the store places each restored
+    # leaf on the template's sharding, so pin them all to host CPU.
     host = jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0])
     template = jax.tree_util.tree_map(
         lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=host)
         if hasattr(s, "shape") else s,
         jax.eval_shape(make_state))
-    step = args.step or latest_step(args.checkpoint_dir)
+    # latest *verified*: a corrupt/incomplete newest checkpoint is
+    # quarantined and the export falls back to the newest good one.
+    step = args.step or latest_verified_step(args.checkpoint_dir)
     if step is None:
-        raise SystemExit(f"no checkpoints under {args.checkpoint_dir}")
+        raise SystemExit(f"no verified checkpoints under {args.checkpoint_dir}")
     print(f"restoring step {step} from {args.checkpoint_dir} (host-side)")
     state = restore_train_state(args.checkpoint_dir, step, template)
     out = export_merged_model(args.out, state.params, cfg,

@@ -133,7 +133,14 @@ def parse_args():
     p.add_argument("--save-steps", type=int, default=100)
     p.add_argument("--save-total-limit", type=int, default=3)
     p.add_argument("--no-resume", action="store_true",
-                   help="skip the scan-latest-and-resume pass")
+                   help="skip the verified scan-latest-and-resume pass")
+    p.add_argument("--fault-inject-step", default="",
+                   help="deterministic trainer chaos hook 'STEP[:MODE]' "
+                        "(MODE: raise | kill | save-raise | save-kill) — "
+                        "crash or SIGKILL the trainer at that optimizer "
+                        "step (or mid-async-save) to drill the verified "
+                        "checkpoint/resume path; also via env "
+                        "DLTI_TRAIN_FAULT_INJECT")
     p.add_argument("--export-dir", default=None,
                    help="write a consolidated merged-LoRA export here after training")
     p.add_argument("--init-from-hf", default=None, metavar="DIR",
@@ -317,6 +324,7 @@ def build_config(args):
                           quantize_frozen_base=args.quantize_base,
                           loss_chunk=args.loss_chunk,
                           steps_per_sync=args.steps_per_sync,
+                          fault_inject_step=args.fault_inject_step,
                           eval_steps=args.eval_steps,
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,

@@ -132,6 +132,28 @@ def test_host_overlap_metric_names_are_schema_stable():
                           "decode_state_clean_syncs"}
 
 
+def test_ckpt_metric_names_are_schema_stable():
+    """Checkpoint-robustness telemetry names are a scrape contract like
+    the gateway and prefetch sets: save/restore duration histograms, the
+    corrupt-quarantine and save-retry counters, and the
+    last-verified-step gauge."""
+    from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
+    from dlti_tpu.checkpoint import store
+
+    assert CKPT_METRIC_NAMES == (
+        "dlti_ckpt_save_seconds",
+        "dlti_ckpt_restore_seconds",
+        "dlti_ckpt_corrupt_skipped",
+        "dlti_ckpt_save_retries",
+        "dlti_ckpt_last_verified_step",
+    )
+    assert store.save_seconds.name == CKPT_METRIC_NAMES[0]
+    assert store.restore_seconds.name == CKPT_METRIC_NAMES[1]
+    assert store.corrupt_skipped.name == CKPT_METRIC_NAMES[2]
+    assert store.save_retries.name == CKPT_METRIC_NAMES[3]
+    assert store.last_verified_step.name == CKPT_METRIC_NAMES[4]
+
+
 def test_load_report_schema_includes_gateway_fields():
     """scripts/benchmark_serving.py consumers parse the report JSON by
     key; the multi-tenant/priority additions are part of that schema."""

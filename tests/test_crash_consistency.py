@@ -1,0 +1,403 @@
+"""Crash-consistent checkpointing: atomic commit, digest verification,
+quarantine + fallback resume, exact-state resume, fault injection.
+
+The tier-1 half of the chaos story (the subprocess SIGKILL drills live in
+``tests/test_crash_smoke.py``, slow tier): every on-disk failure mode a
+kill or bad disk can produce — torn staging dirs, truncated files, bit
+flips, missing commit markers — is fabricated deterministically via
+``dlti_tpu.checkpoint.chaos`` and must be quarantined (renamed, counted,
+logged) with resume falling back to the newest checkpoint that proves
+out; and a mid-epoch resume must replay a **bit-identical** loss
+trajectory versus the uninterrupted run (weights + data cursor + rng
+schedule all restored).
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.checkpoint import (
+    CheckpointCorruptError,
+    latest_step,
+    latest_verified_step,
+    list_checkpoint_steps,
+    load_train_meta,
+    restore_latest_verified,
+    restore_train_state,
+    save_train_state,
+    verify_checkpoint,
+    wait_for_saves,
+)
+from dlti_tpu.checkpoint.chaos import (
+    CORRUPT_MODES,
+    corrupt_checkpoint,
+    make_torn_save,
+)
+from dlti_tpu.checkpoint.store import corrupt_skipped, save_retries
+from dlti_tpu.config import (
+    CheckpointConfig, Config, DataConfig, LoRAConfig, MODEL_PRESETS,
+    OptimizerConfig, ParallelConfig, TelemetryConfig, TrainConfig,
+)
+from dlti_tpu.data import TokenBatchDataset
+from dlti_tpu.training.chaos import TrainFault, TrainFaultInjector
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+# ----------------------------------------------------------------------
+# Store unit contracts (no Trainer, no jit-heavy work)
+# ----------------------------------------------------------------------
+
+def _tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "w": jax.random.normal(k, (4, 3), jnp.float32),
+        "b": {"scale": jnp.arange(3, dtype=jnp.bfloat16),
+              "count": jnp.array(7 + seed, jnp.int32)},
+    }
+
+
+def test_save_restore_roundtrip_and_sidecar(tmp_path):
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), keep=3, async_save=True,
+                     train_meta={"step": 2, "epoch": 0})
+    save_train_state(d, 5, _tree(1), keep=3, async_save=True,
+                     train_meta={"step": 5, "epoch": 1})
+    wait_for_saves(d)
+    assert list_checkpoint_steps(d) == [2, 5]
+    assert latest_step(d) == 5
+    assert latest_verified_step(d) == 5
+    assert verify_checkpoint(d, 5) == (True, "ok")
+    target = jax.tree_util.tree_map(jnp.zeros_like, _tree(0))
+    out = restore_train_state(d, 5, target)
+    want = _tree(1)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(want["w"]))
+    assert out["b"]["scale"].dtype == jnp.bfloat16
+    assert int(out["b"]["count"]) == 8
+    assert load_train_meta(d, 5) == {"step": 5, "epoch": 1}
+    # Committed layout: commit marker present, no staging dirs left.
+    assert os.path.isfile(tmp_path / "5" / "COMMIT")
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]
+
+
+def test_duplicate_save_is_idempotent(tmp_path):
+    d = str(tmp_path)
+    save_train_state(d, 3, _tree(0), async_save=False)
+    save_train_state(d, 3, _tree(1), async_save=False)  # resumed re-save
+    out = restore_train_state(d, 3, _tree(0))
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(_tree(0)["w"]))
+
+
+def test_rotation_keeps_newest(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        save_train_state(d, step, _tree(step), keep=2, async_save=False)
+    assert list_checkpoint_steps(d) == [3, 4]
+
+
+@pytest.mark.parametrize("mode", CORRUPT_MODES)
+def test_corruption_quarantined_with_fallback(tmp_path, mode):
+    """Every damage mode on the newest checkpoint: the resume scan must
+    quarantine it (renamed + counted) and fall back to the older good
+    one — never crash, never trust the bad bytes."""
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), async_save=False,
+                     train_meta={"step": 2})
+    save_train_state(d, 4, _tree(1), async_save=False,
+                     train_meta={"step": 4})
+    corrupt_checkpoint(d, 4, mode)
+    before = corrupt_skipped.value
+    target = jax.tree_util.tree_map(jnp.zeros_like, _tree(0))
+    got = restore_latest_verified(d, target)
+    assert got is not None
+    state, step, meta = got
+    assert step == 2 and meta == {"step": 2}
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(_tree(0)["w"]))
+    assert corrupt_skipped.value > before
+    assert os.listdir(tmp_path / "_quarantine")
+    # The damaged step no longer shows up as committed.
+    assert list_checkpoint_steps(d) == [2]
+
+
+def test_torn_async_save_is_quarantined(tmp_path):
+    """The wreckage of a kill mid-async-save (a ``.tmp-*`` staging dir,
+    no manifest/commit) must be swept into quarantine by the scan."""
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), async_save=False)
+    make_torn_save(d, 4)
+    assert [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    assert latest_verified_step(d) == 2
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    assert os.listdir(tmp_path / "_quarantine")
+
+
+def test_all_checkpoints_corrupt_returns_none(tmp_path):
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), async_save=False)
+    corrupt_checkpoint(d, 2, "bitflip-array")
+    target = jax.tree_util.tree_map(jnp.zeros_like, _tree(0))
+    assert restore_latest_verified(d, target) is None
+
+
+def test_save_retries_transient_failure(tmp_path, monkeypatch):
+    """A transient commit failure retries with backoff (counted) and the
+    checkpoint still lands."""
+    import dlti_tpu.checkpoint.store as store
+
+    real_rename = os.rename
+    fails = {"left": 2}
+
+    def flaky_rename(src, dst):
+        if fails["left"] > 0 and os.path.basename(src).startswith(".tmp-"):
+            fails["left"] -= 1
+            raise OSError("injected transient rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(store.os, "rename", flaky_rename)
+    before = save_retries.value
+    save_train_state(str(tmp_path), 2, _tree(0), async_save=False,
+                     retries=3, retry_backoff_s=0.01)
+    assert save_retries.value == before + 2
+    assert verify_checkpoint(str(tmp_path), 2) == (True, "ok")
+
+
+def test_save_failure_is_bounded_and_never_raises_on_wait(tmp_path,
+                                                          monkeypatch):
+    """Retries exhausted: the async writer logs the error; wait_for_saves
+    returns (training must outlive a dead checkpoint disk)."""
+    import dlti_tpu.checkpoint.store as store
+
+    def always_fail(tmp, p):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(store, "_write_staging", always_fail)
+    save_train_state(str(tmp_path), 2, _tree(0), async_save=True,
+                     retries=1, retry_backoff_s=0.01)
+    wait_for_saves(str(tmp_path))  # must not raise
+    assert list_checkpoint_steps(str(tmp_path)) == []
+
+
+def test_restore_structure_mismatch_raises_value_error(tmp_path):
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), async_save=False)
+    with pytest.raises(ValueError, match="leaves|structure"):
+        restore_train_state(d, 2, {"only": jnp.zeros((2,))})
+    bad_shape = jax.tree_util.tree_map(jnp.zeros_like, _tree(0))
+    bad_shape["w"] = jnp.zeros((5, 5), jnp.float32)
+    with pytest.raises(ValueError, match="expects"):
+        restore_train_state(d, 2, bad_shape)
+
+
+def test_truncated_array_raises_corrupt_not_garbage(tmp_path):
+    from dlti_tpu.checkpoint.chaos import truncate_file
+
+    d = str(tmp_path)
+    save_train_state(d, 2, _tree(0), async_save=False)
+    truncate_file(os.path.join(d, "2", "train_state", "l00000.bin"))
+    with pytest.raises(CheckpointCorruptError):
+        restore_train_state(d, 2, _tree(0))
+
+
+def test_export_pytree_verify_detects_corruption(tmp_path):
+    from dlti_tpu.checkpoint.chaos import bit_flip_file
+    from dlti_tpu.checkpoint.store import load_pytree, save_pytree
+
+    p = {"m": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    d = save_pytree(str(tmp_path / "model"), p)
+    back = load_pytree(d, verify=True)
+    np.testing.assert_array_equal(back["m"]["w"], p["m"]["w"])
+    bit_flip_file(os.path.join(d, "train_state", "l00000.bin"))
+    with pytest.raises(CheckpointCorruptError):
+        load_pytree(d, verify=True)
+
+
+def test_fault_injector_spec_parsing(monkeypatch):
+    assert TrainFaultInjector.from_spec("") is None
+    fi = TrainFaultInjector.from_spec("7")
+    assert (fi.step, fi.mode) == (7, "raise")
+    fi = TrainFaultInjector.from_spec("3:save-kill")
+    assert (fi.step, fi.mode) == (3, "save-kill")
+    monkeypatch.setenv("DLTI_TRAIN_FAULT_INJECT", "5:kill")
+    fi = TrainFaultInjector.from_spec(None)
+    assert (fi.step, fi.mode) == (5, "kill")
+    with pytest.raises(ValueError, match="mode"):
+        TrainFaultInjector.from_spec("5:explode")
+    with pytest.raises(ValueError, match="spec"):
+        TrainFaultInjector.from_spec("soon")
+    fi = TrainFaultInjector.from_spec("2:raise")
+    with pytest.raises(TrainFault):
+        fi.maybe_fire_step(2)
+    fi.maybe_fire_step(3)  # fires at most once
+
+
+# ----------------------------------------------------------------------
+# Trainer-level: exact-state resume + recovery end to end
+# ----------------------------------------------------------------------
+
+def _dataset(pack=False, n=96, seq_len=16):
+    rng = np.random.default_rng(11)
+    seqs = [list(map(int, rng.integers(1, 500,
+                                       size=int(rng.integers(6, 12)))))
+            for _ in range(n)]
+    return TokenBatchDataset(sequences=seqs, seq_len=seq_len, pad_id=0,
+                             micro_batch_size=2, grad_accum_steps=1,
+                             shard_by_host=False, pack=pack)
+
+
+def _cfg(tmp_path, tag, max_steps, save_steps=1000, save_strategy="steps",
+         async_save=True, fault=""):
+    return Config(
+        model=CFG, lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=2),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16, prefetch_depth=2),
+        train=TrainConfig(num_epochs=1, max_steps=max_steps,
+                          micro_batch_size=2, grad_accum_steps=1,
+                          logging_steps=1000, fault_inject_step=fault,
+                          metrics_csv=str(tmp_path / f"{tag}.csv")),
+        checkpoint=CheckpointConfig(output_dir=str(tmp_path / "ckpt"),
+                                    save_strategy=save_strategy,
+                                    save_steps=save_steps,
+                                    save_total_limit=3,
+                                    async_save=async_save),
+        telemetry=TelemetryConfig(
+            step_log_path=str(tmp_path / f"{tag}.jsonl")),
+    )
+
+
+def _losses(tmp_path, tag):
+    rows = [json.loads(line) for line in open(tmp_path / f"{tag}.jsonl")]
+    return {r["step"]: r["loss"] for r in rows if r.get("type") == "step"}
+
+
+@pytest.mark.parametrize("pack", [False, True])
+def test_midepoch_resume_bit_identical_losses(tmp_path, pack):
+    """The acceptance bar: weights + data cursor + rng schedule all
+    restore, so steps replayed after a mid-epoch resume produce the exact
+    float losses of the uninterrupted run — equality, not closeness."""
+    from dlti_tpu.training.trainer import Trainer
+
+    sub = tmp_path / f"pack{pack}"
+    sub.mkdir()
+    ref_cfg = _cfg(sub, "ref", max_steps=6, save_strategy="no")
+    Trainer(ref_cfg).train(dataset=_dataset(pack))
+    ref = _losses(sub, "ref")
+    assert len(ref) == 6
+
+    half_cfg = _cfg(sub, "half", max_steps=3, save_steps=3)
+    Trainer(half_cfg).train(dataset=_dataset(pack))
+    assert latest_verified_step(str(sub / "ckpt")) == 3
+    # The sidecar carries the data cursor + rng schedule.
+    meta = load_train_meta(str(sub / "ckpt"), 3)
+    assert meta["step"] == 3 and meta["rng_schedule"] == "fold_in_v1"
+    assert meta["dataset"]["steps_per_epoch"] > 0
+    assert meta["dataset"]["packed"] == pack
+
+    rest_cfg = _cfg(sub, "rest", max_steps=6, save_steps=1000)
+    state, _ = Trainer(rest_cfg).train(dataset=_dataset(pack))
+    assert int(jax.device_get(state.step)) == 6
+    got = _losses(sub, "rest")
+    assert set(got) == {4, 5, 6}
+    for s in (4, 5, 6):
+        assert got[s] == ref[s], (s, got[s], ref[s])
+
+
+def test_streaming_dataset_resume_bit_identical(tmp_path):
+    """Same exactness bar against the disk-backed token store."""
+    from dlti_tpu.data.streaming import StreamingTokenDataset, \
+        write_token_store
+    from dlti_tpu.training.trainer import Trainer
+
+    rng = np.random.default_rng(13)
+    docs = [list(map(int, rng.integers(1, 400,
+                                       size=int(rng.integers(5, 10)))))
+            for _ in range(48)]
+    store_dir = str(tmp_path / "store")
+    write_token_store(iter(docs), store_dir, seq_len=16, pad_id=0)
+
+    def ds():
+        return StreamingTokenDataset(store_dir, micro_batch_size=2,
+                                     grad_accum_steps=1,
+                                     shard_by_host=False)
+
+    ref_cfg = _cfg(tmp_path, "sref", max_steps=6, save_strategy="no")
+    Trainer(ref_cfg).train(dataset=ds())
+    ref = _losses(tmp_path, "sref")
+
+    half_cfg = _cfg(tmp_path, "shalf", max_steps=3, save_steps=3)
+    Trainer(half_cfg).train(dataset=ds())
+    rest_cfg = _cfg(tmp_path, "srest", max_steps=6)
+    Trainer(rest_cfg).train(dataset=ds())
+    got = _losses(tmp_path, "srest")
+    for s in (4, 5, 6):
+        assert got[s] == ref[s]
+
+
+def test_kill_mid_async_save_falls_back_bit_identical(tmp_path):
+    """A run killed mid-async-save leaves a torn staging dir; resume must
+    quarantine it, restore the newest *verified* step, and replay to a
+    bit-identical trajectory (the PR's acceptance criterion, in-process;
+    the real-SIGKILL version runs in the slow smoke tier)."""
+    from dlti_tpu.training.trainer import Trainer
+
+    ref_cfg = _cfg(tmp_path, "kref", max_steps=6, save_strategy="no")
+    Trainer(ref_cfg).train(dataset=_dataset(False))
+    ref = _losses(tmp_path, "kref")
+
+    half_cfg = _cfg(tmp_path, "khalf", max_steps=4, save_steps=2)
+    Trainer(half_cfg).train(dataset=_dataset(False))
+    ckpt = str(tmp_path / "ckpt")
+    assert latest_step(ckpt) == 4
+    # Simulate the kill landing while step 4's async save was mid-write:
+    # demote the committed dir to the torn staging dir a SIGKILL leaves.
+    corrupt_checkpoint(ckpt, 4, "stale-tmp")
+    before = corrupt_skipped.value
+
+    rest_cfg = _cfg(tmp_path, "krest", max_steps=6)
+    state, _ = Trainer(rest_cfg).train(dataset=_dataset(False))
+    assert int(jax.device_get(state.step)) == 6
+    got = _losses(tmp_path, "krest")
+    # Resumed from step 2 (newest verified), replayed 3..6 exactly.
+    assert set(got) == {3, 4, 5, 6}
+    for s in (3, 4, 5, 6):
+        assert got[s] == ref[s]
+    assert corrupt_skipped.value > before
+
+
+def test_trainer_crash_cleans_up_and_resumes(tmp_path):
+    """Fault injection 'raise' mode: the exception propagates, the
+    prefetch worker is shut down (no leaked thread), in-flight async
+    saves are settled by the finally (no stranded staging dir), and a
+    fresh Trainer resumes and finishes with the uninterrupted losses."""
+    from dlti_tpu.training.trainer import Trainer
+
+    ref_cfg = _cfg(tmp_path, "cref", max_steps=6, save_strategy="no")
+    Trainer(ref_cfg).train(dataset=_dataset(False))
+    ref = _losses(tmp_path, "cref")
+
+    crash_cfg = _cfg(tmp_path, "crash", max_steps=6, save_steps=2,
+                     fault="3:raise")
+    with pytest.raises(TrainFault):
+        Trainer(crash_cfg).train(dataset=_dataset(False))
+    # Prefetch worker joined on the exception path.
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("dlti-prefetch")]
+    ckpt = str(tmp_path / "ckpt")
+    # The finally settled the async save of step 2 — committed, not torn.
+    assert [n for n in os.listdir(ckpt) if n.startswith(".tmp-")] == []
+    assert latest_verified_step(ckpt) == 2
+
+    rest_cfg = _cfg(tmp_path, "crest", max_steps=6)
+    state, _ = Trainer(rest_cfg).train(dataset=_dataset(False))
+    assert int(jax.device_get(state.step)) == 6
+    got = _losses(tmp_path, "crest")
+    for s in (3, 4, 5, 6):
+        assert got[s] == ref[s]

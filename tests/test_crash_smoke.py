@@ -1,0 +1,91 @@
+"""Real-SIGKILL chaos drill against ``scripts/train.py`` (slow tier).
+
+The honest version of what ``tests/test_crash_consistency.py`` simulates
+in-process: the CLI trainer is launched as a subprocess with
+``--fault-inject-step``, SIGKILLs *itself* at an exact step (or
+mid-async-save), is re-run with resume, and must finish with the exact
+per-step losses of an uninterrupted run — weights, data cursor, and rng
+schedule all recovered through a process boundary with no Python
+teardown whatsoever.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "scripts", "train.py")
+
+
+def _write_corpus(path, n=160):
+    rng = np.random.default_rng(5)
+    with open(path, "w") as f:
+        for i in range(n):
+            words = " ".join(f"w{int(w)}" for w in rng.integers(0, 50, 6))
+            f.write(f"sample {i}: {words}\n")
+
+
+def _run(tmp_path, tag, out_dir, extra, timeout=420):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Tiny programs compile in well under the entry points' 5 s persistent
+    # cache threshold; opt level 0 keeps each cold subprocess quick.
+    env["XLA_FLAGS"] = "--xla_backend_optimization_level=0"
+    cmd = [
+        sys.executable, TRAIN,
+        "--preset", "baseline", "--model", "llama_tiny",
+        "--tokenizer", "byte",
+        "--dataset-path", str(tmp_path / "corpus.txt"),
+        "--output-dir", str(out_dir),
+        "--max-seq-len", "32", "--per-device-batch-size", "2",
+        "--gradient-accumulation-steps", "1", "--lora-r", "2",
+        "--warmup-steps", "2", "--max-steps", "6", "--save-steps", "2",
+        "--logging-steps", "1000",
+        "--metrics-csv", str(tmp_path / f"{tag}.csv"),
+        "--step-log", str(tmp_path / f"{tag}.jsonl"),
+    ] + extra
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def _losses(tmp_path, tag):
+    rows = [json.loads(line) for line in open(tmp_path / f"{tag}.jsonl")]
+    return {r["step"]: r["loss"] for r in rows if r.get("type") == "step"}
+
+
+@pytest.mark.parametrize("fault", ["3:kill", "4:save-kill"])
+def test_sigkill_resume_matches_uninterrupted_run(tmp_path, fault):
+    _write_corpus(tmp_path / "corpus.txt")
+
+    ref = _run(tmp_path, "ref", tmp_path / "ckpt_ref", [])
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = _losses(tmp_path, "ref")
+    assert set(ref_losses) == {1, 2, 3, 4, 5, 6}
+
+    out = tmp_path / f"ckpt_{fault.replace(':', '_')}"
+    killed = _run(tmp_path, "killed", out, ["--fault-inject-step", fault])
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stderr[-2000:])
+
+    resumed = _run(tmp_path, "resumed", out, [])
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    got = _losses(tmp_path, "resumed")
+    # The resumed run replays from the newest VERIFIED checkpoint (a
+    # save-kill may leave step 4 torn — quarantined, fall back to 2);
+    # every step it executes must match the uninterrupted run exactly.
+    assert got, "resumed run executed no steps"
+    assert max(got) == 6
+    for step, loss in got.items():
+        assert loss == ref_losses[step], (step, loss, ref_losses[step])
+    # And the final verified checkpoint is the run's last step.
+    from dlti_tpu.checkpoint import latest_verified_step
+
+    assert latest_verified_step(str(out)) == 6
