@@ -282,6 +282,32 @@ V100_BASELINE_TOK_S = 2.93 * 512  # ~1500 tok/s (BASELINE.md)
 SEQ = int(os.environ.get("BENCH_SEQ", 512))
 STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
+# In-process anomaly watchdog over the measured loop (telemetry.watchdog):
+# each timed step feeds notify_step, so a wedged relay/compile mid-candidate
+# trips the hung-step rule and the final JSON carries `watchdog_alerts` —
+# chaos/regression consumers fail loudly instead of trusting a clean-looking
+# number. The deadline floor is generous (BENCH_HUNG_STEP_S, default 600 s)
+# so 7B cold compiles never false-positive.
+_WATCHDOG = None
+
+
+def _start_watchdog():
+    global _WATCHDOG
+    try:
+        from dlti_tpu.config import WatchdogConfig
+        from dlti_tpu.telemetry import AnomalyWatchdog, TimeSeriesSampler
+
+        _WATCHDOG = AnomalyWatchdog(
+            WatchdogConfig(
+                enabled=True,
+                hung_step_min_s=float(os.environ.get("BENCH_HUNG_STEP_S",
+                                                     600))),
+            TimeSeriesSampler(interval_s=5.0))
+        _WATCHDOG.start()
+    except Exception as e:  # the bench must run even if telemetry breaks
+        print(f"# bench: watchdog unavailable: {e}", file=sys.stderr,
+              flush=True)
+
 
 def _try_run(model_name: str, micro_bs: int, quant: str = "",
              remat_policy: str = "", remat_stride: int = 0,
@@ -359,6 +385,8 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
     t0 = time.perf_counter()
     for i in range(STEPS):
         state, loss_val = run(state, i)
+        if _WATCHDOG is not None:
+            _WATCHDOG.notify_step(i)
     dt = (time.perf_counter() - t0) / (STEPS * sync)
     tok_s = micro_bs * SEQ / dt
     return tok_s, dt, trainable, total, loss_val
@@ -366,6 +394,8 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
 
 def main() -> None:
     from dlti_tpu.utils.metrics import compute_mfu, detect_chip_peak_flops
+
+    _start_watchdog()
 
     if "BENCH_MODEL" in os.environ:
         quant = os.environ.get("BENCH_QUANT", "")
@@ -484,6 +514,12 @@ def main() -> None:
         "remat_policy": c.get("remat_policy", ""),
         "remat_stride": c.get("remat_stride", 0),
         "steps_per_sync": c.get("sync", 1),
+        # Watchdog verdict: nonzero means the measured loop misbehaved
+        # (hung step etc.) — regression tooling should distrust `value`.
+        "watchdog_alerts": (sum(_WATCHDOG.alert_counts().values())
+                            if _WATCHDOG is not None else 0),
+        "watchdog_alert_rules": (_WATCHDOG.alert_counts()
+                                 if _WATCHDOG is not None else {}),
     }
     # Stash for the watchdog (it emits best-so-far if we stall after this
     # point), then print the one official line (_emit is emit-once).

@@ -48,6 +48,12 @@ class LoadGenConfig:
     # before admission) is visible in one report. Off by default: the
     # target may not expose dlti_* metrics.
     scrape_server_metrics: bool = False
+    # After the run, scrape the server's /debug/vars time-series ring and
+    # record the watchdog alert counters + the PEAK gateway queue depth
+    # over the run (the ring sees the peak; a point-in-time scrape at run
+    # end would not) — so chaos/regression runs fail loudly when the
+    # server's own watchdog fired. Best-effort like the /metrics scrape.
+    scrape_debug_vars: bool = True
     # Multi-tenant workload: > 0 spreads requests round-robin over
     # synthetic tenants "tenant-0".."tenant-N-1" via the X-Tenant header
     # (the admission gateway's per-tenant rate limits and fair dequeue
@@ -116,6 +122,12 @@ class LoadReport:
     # Server-side histogram summaries ({metric: {count, sum, mean}}) when
     # cfg.scrape_server_metrics is set; empty otherwise.
     server_histograms: dict = field(default_factory=dict)
+    # Server watchdog verdict from the end-of-run /debug/vars scrape:
+    # {rule: count} of alerts the SERVER's anomaly watchdog fired, and the
+    # peak gateway queue depth its time-series ring observed. Empty/0 when
+    # the scrape is off, the route is absent, or nothing fired.
+    watchdog_alerts: dict = field(default_factory=dict)
+    peak_queue_depth: float = 0.0
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -313,6 +325,57 @@ async def _scrape_histograms(host: str, port: int,
     return hists
 
 
+async def _http_get_json(host: str, port: int, path: str,
+                         timeout_s: float = 10.0) -> Optional[dict]:
+    """GET a JSON route over raw asyncio streams; None on any failure
+    (scrapes must never fail a load test)."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout_s)
+        req = (f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+               f"Connection: close\r\n\r\n").encode()
+        writer.write(req)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), timeout_s)
+        if b" 200 " not in status_line and \
+                not status_line.endswith(b" 200\r\n"):
+            return None
+        headers: dict = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), timeout_s)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = b"".join([c async for c in _iter_body(reader, headers,
+                                                    timeout_s)])
+        writer.close()
+        return json.loads(raw)
+    except Exception:
+        return None
+
+
+def _watchdog_report(debug_vars: Optional[dict]) -> Tuple[dict, float]:
+    """-> ({rule: alert_count}, peak gateway queue depth) from a
+    /debug/vars snapshot (the ring holds the run's history, so the peak
+    is the true peak, not the end-of-run value)."""
+    if not debug_vars:
+        return {}, 0.0
+    alerts: dict = {}
+    prefix = "dlti_watchdog_alerts_total"
+    for k, v in (debug_vars.get("latest") or {}).items():
+        if not k.startswith(prefix) or not v:
+            continue
+        label = k[len(prefix):].strip("{}")  # e.g. rule="hung_step"
+        rule = label.partition("=")[2].strip('"') or label or "total"
+        alerts[rule] = alerts.get(rule, 0) + int(v)
+    peak = 0.0
+    for s in debug_vars.get("samples") or []:
+        peak = max(peak, float(s.get("values", {})
+                               .get("gateway_queue_depth", 0.0)))
+    return alerts, peak
+
+
 def parse_priority_mix(spec: str) -> List[Tuple[str, float]]:
     """"interactive:0.8,batch:0.2" -> [("interactive", 0.8), ...]."""
     out: List[Tuple[str, float]] = []
@@ -412,6 +475,9 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     duration = time.monotonic() - t0
     server_hists = (await _scrape_histograms(cfg.host, cfg.port)
                     if cfg.scrape_server_metrics else {})
+    watchdog_alerts, peak_queue = _watchdog_report(
+        await _http_get_json(cfg.host, cfg.port, "/debug/vars")
+        if cfg.scrape_debug_vars else None)
 
     ok = [r for r in records if r.ok]
     shed = [r for r in records if r.shed]
@@ -448,6 +514,8 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         # read as a broken server.
         errors=[r.error for r in records if r.error and not r.shed][:10],
         server_histograms=server_hists,
+        watchdog_alerts=watchdog_alerts,
+        peak_queue_depth=peak_queue,
     )
 
 

@@ -339,6 +339,64 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class WatchdogConfig:
+    """Anomaly watchdog (``dlti_tpu.telemetry.watchdog``): a rule engine
+    over the in-process time-series ring. Disabled by default; alerts are
+    structured events (JSONL log + ``dlti_watchdog_alerts_total{rule=}``
+    counter + tracer instants) with a configurable escalation."""
+
+    enabled: bool = False
+    # Seconds between rule evaluations (also the time-series sampling
+    # cadence the entry points use when the watchdog is on).
+    interval_s: float = 1.0
+    # Escalation on alert: "log" (record only), "dump" (also write a
+    # flight record), "abort" (dump, SIGTERM self for the preemption
+    # checkpoint, then hard-exit 86 — for CI chaos runs).
+    action: str = "log"
+    # JSONL alert event log ("" = alerts go to the logger/counter only).
+    alert_log_path: str = ""
+    # hung_step: no step completion within max(hung_step_min_s,
+    # hung_step_factor x rolling-median step time) of the previous one.
+    hung_step_factor: float = 10.0
+    hung_step_min_s: float = 30.0
+    # throughput_collapse: latest reading below floor_frac x rolling
+    # median over at least min_samples ring samples. throughput_series
+    # overrides the auto-watched set (train tok/s gauge + serving
+    # generated_tokens rate).
+    throughput_floor_frac: float = 0.25
+    throughput_min_samples: int = 6
+    throughput_series: str = ""
+    # queue_buildup: gateway queue depth at/above this for 3 consecutive
+    # samples (0 = rule off).
+    queue_depth_limit: int = 0
+    # shed_buildup: gateway sheds+rejections per second over the recent
+    # window (0 = rule off).
+    shed_rate_limit: float = 0.0
+    # heartbeat_stale: a process heartbeat older than this (0 = rule off).
+    heartbeat_stale_s: float = 0.0
+    # ckpt_retry_storm: save retries accrued across the ring window.
+    ckpt_retry_limit: int = 3
+
+
+@dataclass(frozen=True)
+class FlightRecorderConfig:
+    """Flight recorder (``dlti_tpu.telemetry.flightrecorder``): on fatal
+    exception, SIGTERM, replica death, chaos fault, or watchdog
+    escalation, dump a ``flight-*/`` black box (span tail, metrics
+    snapshot, time-series tail, live context, config fingerprint) that
+    ``scripts/postmortem.py`` renders. Enabled by setting ``dir``."""
+
+    dir: str = ""  # "" = recorder off
+    max_spans: int = 4096       # tracer events kept in spans.json
+    timeseries_tail: int = 240  # ring samples kept in timeseries.json
+    keep: int = 8               # dump dirs retained (oldest deleted)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+
+@dataclass(frozen=True)
 class TelemetryConfig:
     """Unified telemetry layer (``dlti_tpu.telemetry``): span tracing,
     per-step JSONL stream, multi-host heartbeat. All off by default — the
@@ -359,6 +417,11 @@ class TelemetryConfig:
     # process reports its step (collective on multi-host meshes) and rank
     # 0 logs straggler lag.
     heartbeat_interval_steps: int = 0
+    # Self-monitoring: anomaly watchdog rules + flight-recorder black box
+    # (see the blocks' own docstrings). Both off by default.
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    flight_recorder: FlightRecorderConfig = field(
+        default_factory=FlightRecorderConfig)
 
 
 @dataclass(frozen=True)
@@ -455,6 +518,7 @@ class Config:
                 if dataclasses.is_dataclass(f.type) or f.name in (
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
+                    "watchdog", "flight_recorder",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -462,6 +526,8 @@ class Config:
                         "data": DataConfig, "checkpoint": CheckpointConfig,
                         "train": TrainConfig, "telemetry": TelemetryConfig,
                         "serving": ServingConfig, "gateway": GatewayConfig,
+                        "watchdog": WatchdogConfig,
+                        "flight_recorder": FlightRecorderConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -204,6 +204,17 @@ class ReplicatedEngine:
         self._dead.add(idx)
         self.failover["replica_faults"] += 1
         eng = self.engines[idx]
+        from dlti_tpu.telemetry import get_recorder
+
+        rec = get_recorder()
+        if rec is not None:
+            # Black box before failover rewrites the dead replica's
+            # bookkeeping: which replica died, with what, holding what.
+            rec.dump(reason="replica_fault", exc=exc, force=True,
+                     extra={"replica": idx,
+                            "in_flight": eng.num_active,
+                            "queued": len(eng.waiting),
+                            "survivors": self.num_live})
         self.logger.error(
             "replica %d step failed (%s: %s); failing over %d in-flight + "
             "%d queued request(s) to %d survivor(s)", idx, type(exc).__name__,

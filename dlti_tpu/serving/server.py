@@ -20,6 +20,7 @@ on per-request token queues. No aiohttp/FastAPI dependency.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -29,14 +30,17 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from dlti_tpu.config import GatewayConfig
+from dlti_tpu.config import GatewayConfig, TelemetryConfig
 from dlti_tpu.data.tokenizer import Tokenizer
 from dlti_tpu.serving.engine import InferenceEngine, Request
 from dlti_tpu.serving.gateway import (
     AdmissionError, AdmissionGateway, PRIORITIES, tenant_from_headers,
 )
 from dlti_tpu.serving.sampling import SamplingParams
-from dlti_tpu.telemetry import MetricsRegistry, get_tracer
+from dlti_tpu.telemetry import (
+    AnomalyWatchdog, FlightRecorder, MetricsRegistry, TimeSeriesSampler,
+    get_recorder, get_tracer, install_recorder, render_dashboard_html,
+)
 from dlti_tpu.utils.logging import get_logger
 
 # /stats keys exposed as Prometheus gauges (point-in-time values); every
@@ -67,6 +71,17 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
                                prefix="dlti_")
     for hist in async_engine.engine.telemetry.histograms():
         registry.register(hist)
+    # Self-monitoring series: the span ring's eviction counter (truncated
+    # forensics must be self-announcing) plus the module-level watchdog /
+    # flight-recorder counters (shared with any trainer in-process).
+    registry.add_scalar_source(
+        lambda: {"trace_dropped_events": get_tracer().dropped_events},
+        prefix="dlti_")
+    from dlti_tpu.telemetry.flightrecorder import dumps_total
+    from dlti_tpu.telemetry.watchdog import alerts_total
+
+    registry.register(alerts_total)
+    registry.register(dumps_total)
     return registry
 
 
@@ -178,6 +193,12 @@ class AsyncEngine:
                 self.engine.step()
             except Exception as e:  # surface engine faults to the waiters
                 self.logger.exception("engine step failed")
+                rec = get_recorder()
+                if rec is not None:
+                    # Black box first, cleanup second: abort_all below
+                    # rewrites the very state (slots, waiting, stats) the
+                    # forensics need.
+                    rec.dump(reason="engine_step_fault", exc=e, force=True)
                 with self._work:
                     # Fail fast: abort every request the engine holds
                     # (slots + waiting; KV is NOT prefix-cache-registered
@@ -245,6 +266,11 @@ class ServerConfig:
     # Admission gateway (dlti_tpu.serving.gateway): None or disabled keeps
     # the legacy direct-admission path byte-for-byte.
     gateway: Optional["GatewayConfig"] = None
+    # Self-monitoring (dlti_tpu.telemetry): trace_dir feeds the on-demand
+    # POST /debug/profile capture; the watchdog / flight_recorder blocks
+    # enable the anomaly rules and the black-box dumps. None keeps only
+    # the always-on /debug/vars sampler + /dashboard.
+    telemetry: Optional["TelemetryConfig"] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -259,6 +285,8 @@ class _Handler(BaseHTTPRequestHandler):
     cfg: ServerConfig
     registry: "MetricsRegistry"
     gateway = None  # AdmissionGateway when ServerConfig.gateway enables it
+    sampler = None  # TimeSeriesSampler behind /debug/vars + /dashboard
+    profile_lock = None  # threading.Lock guarding POST /debug/profile
 
     def log_message(self, fmt, *args):  # route through our logger
         get_logger().debug("http: " + fmt, *args)
@@ -371,6 +399,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------
     def do_GET(self):
+        path, _, query = self.path.partition("?")
+        if path == "/debug/vars":
+            # Time-series ring snapshot (JSON): every registry scalar +
+            # histogram summary, sampled on a cadence — what the
+            # /dashboard page and the loadgen's end-of-run scrape read.
+            if self.sampler is None:
+                return self._error(404, "no time-series sampler")
+            tail = None
+            if query.startswith("tail="):
+                try:
+                    tail = max(1, int(query[5:]))
+                except ValueError:
+                    return self._error(400, "tail must be an integer")
+            return self._json(200, self.sampler.snapshot(tail))
+        if path == "/dashboard":
+            # Self-contained live dashboard: inline CSS/JS polling
+            # /debug/vars — watching a run needs a browser, not a
+            # Prometheus deployment.
+            body = render_dashboard_html().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/health":
             # Load-balancer truth: a parked stepper or a draining gateway
             # must read unhealthy so traffic routes elsewhere — 200 here
@@ -420,8 +473,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._completions(chat=False)
         elif self.path == "/v1/chat/completions":
             self._completions(chat=True)
+        elif self.path == "/debug/profile":
+            self._profile()
         else:
             self._error(404, f"no route {self.path}")
+
+    def _profile(self) -> None:
+        """On-demand ``jax.profiler`` capture around the live engine:
+        ``POST /debug/profile {"seconds": s}`` writes a device trace into
+        the configured ``--trace-dir`` (the trainer has its profile
+        window flags; this is serving's equivalent, without a restart).
+        One capture at a time — concurrent requests get 409."""
+        body = self._read_body()
+        if body is None:
+            return
+        trace_dir = (self.cfg.telemetry.trace_dir
+                     if self.cfg.telemetry is not None else "")
+        if not trace_dir:
+            return self._error(
+                400, "profiling needs a trace dir: start the server with "
+                     "--trace-dir")
+        try:
+            seconds = float(body.get("seconds", 3.0))
+        except (TypeError, ValueError):
+            return self._error(400, "seconds must be a number")
+        if not 0.0 < seconds <= 120.0:
+            return self._error(400, "seconds must be in (0, 120]")
+        if self.profile_lock is None or not self.profile_lock.acquire(
+                blocking=False):
+            # jax.profiler is process-global: a second start_trace would
+            # raise (or corrupt the first capture), so refuse loudly.
+            return self._error(409, "a profile capture is already running")
+        try:
+            import jax
+
+            out_dir = os.path.join(trace_dir, "serve_profile")
+            t0 = time.monotonic()
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            self._json(200, {"status": "ok", "trace_dir": out_dir,
+                             "seconds": round(time.monotonic() - t0, 3)})
+        except Exception as e:  # profiler backends vary; fail this request
+            self._error(500, f"profiler: {type(e).__name__}: {e}")
+        finally:
+            self.profile_lock.release()
 
     # -- completion core ----------------------------------------------
     def _completions(self, chat: bool) -> None:
@@ -797,13 +895,53 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     if cfg.gateway is not None and cfg.gateway.enabled:
         gateway = AdmissionGateway(async_engine, cfg.gateway, registry)
 
+    # Self-monitoring layer (dlti_tpu.telemetry): the time-series ring is
+    # always on (it is what /debug/vars and /dashboard serve — one
+    # registry read per interval); watchdog and flight recorder follow
+    # cfg.telemetry.
+    tcfg = cfg.telemetry
+    wcfg = tcfg.watchdog if tcfg is not None else None
+    sampler = TimeSeriesSampler(
+        interval_s=wcfg.interval_s if wcfg is not None else 1.0,
+        registry=registry)
+    sampler.start()
+    recorder = None
+    if tcfg is not None and tcfg.flight_recorder.enabled:
+        import dataclasses as _dc
+
+        fcfg = tcfg.flight_recorder
+        if not get_tracer().enabled:
+            # The black box needs a span tail even when no --trace-dir
+            # export was requested (same rationale as the trainer's).
+            from dlti_tpu.telemetry import configure_tracer
+
+            configure_tracer(enabled=True, capacity=tcfg.trace_capacity)
+        recorder = FlightRecorder(
+            fcfg.dir, sampler=sampler, config=_dc.asdict(cfg),
+            max_spans=fcfg.max_spans, timeseries_tail=fcfg.timeseries_tail,
+            keep=fcfg.keep)
+        recorder.add_metrics_source(registry.stats_dict)
+        recorder.note(role="serving", model=cfg.model_name)
+        install_recorder(recorder)
+    watchdog = None
+    if wcfg is not None and wcfg.enabled:
+        watchdog = AnomalyWatchdog(wcfg, sampler)
+        if recorder is not None:
+            recorder.add_context_source(
+                lambda: {"watchdog_alerts": list(watchdog.alerts)})
+        watchdog.start()
+
     handler = type("BoundHandler", (_Handler,), {
         "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
-        "registry": registry, "gateway": gateway,
+        "registry": registry, "gateway": gateway, "sampler": sampler,
+        "profile_lock": threading.Lock(),
     })
     httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
     httpd.daemon_threads = True
     httpd.gateway = gateway
+    httpd.sampler = sampler
+    httpd.watchdog = watchdog
+    httpd.flight_recorder = recorder
     return httpd, async_engine
 
 
@@ -825,6 +963,10 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
     import signal as _signal
 
     def _graceful_stop():
+        if httpd.flight_recorder is not None:
+            # SIGTERM is a trigger too: the black box records what was
+            # in flight when the orchestrator pulled the plug.
+            httpd.flight_recorder.dump(reason="sigterm_drain", force=True)
         if gateway is not None:
             gateway.drain()
             gateway.wait_idle(gateway.cfg.drain_grace_s)
@@ -853,5 +995,11 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
                            prev_handler or _signal.SIG_DFL)
         if gateway is not None:
             gateway.shutdown()
+        if httpd.watchdog is not None:
+            httpd.watchdog.stop()
+        httpd.sampler.stop()
+        if httpd.flight_recorder is not None and \
+                get_recorder() is httpd.flight_recorder:
+            install_recorder(None)
         async_engine.shutdown()
         httpd.server_close()

@@ -17,6 +17,14 @@ reference-parity CSV in ``utils/metrics.py``, ``StepTimer`` in
   (superset of the reference CSV schema).
 * :mod:`~dlti_tpu.telemetry.heartbeat` — multi-host per-process
   last-seen-step gauge (straggler visibility).
+* :mod:`~dlti_tpu.telemetry.timeseries` — bounded in-process time-series
+  ring behind ``GET /debug/vars`` and the self-contained ``/dashboard``.
+* :mod:`~dlti_tpu.telemetry.watchdog` — anomaly rule engine (hung step,
+  throughput collapse, queue buildup, heartbeat staleness, checkpoint
+  retry storms) with log/dump/abort escalation.
+* :mod:`~dlti_tpu.telemetry.flightrecorder` — black-box ``flight-*/``
+  dumps (span tail + metrics + time-series tail + live context) on
+  faults, rendered by ``scripts/postmortem.py``.
 """
 
 from dlti_tpu.telemetry.registry import (  # noqa: F401
@@ -40,3 +48,17 @@ from dlti_tpu.telemetry.steplog import (  # noqa: F401
     schedule_lr,
 )
 from dlti_tpu.telemetry.heartbeat import Heartbeat  # noqa: F401
+from dlti_tpu.telemetry.timeseries import (  # noqa: F401
+    TimeSeriesSampler,
+    render_dashboard_html,
+)
+from dlti_tpu.telemetry.watchdog import (  # noqa: F401
+    AnomalyWatchdog,
+    WATCHDOG_METRIC_NAMES,
+)
+from dlti_tpu.telemetry.flightrecorder import (  # noqa: F401
+    FLIGHT_METRIC_NAMES,
+    FlightRecorder,
+    get_recorder,
+    install as install_recorder,
+)

@@ -1,0 +1,308 @@
+"""Flight recorder: the black box dumped when something dies.
+
+When a step hangs, a replica faults, or the process takes a fatal
+exception, the evidence (recent spans, counters, the time-series tail,
+what the run was *doing*) normally evaporates with the process. The
+flight recorder makes that evidence survive: :meth:`FlightRecorder.dump`
+atomically materializes a ``flight-<step|ts>/`` directory:
+
+* ``context.json``    — why (reason, exception traceback, signal), when,
+  and what was in flight: the live context dict components keep updated
+  via :meth:`note` (current phase, last completed step, active request
+  count, ...), plus the config fingerprint and recent watchdog alerts.
+* ``spans.json``      — the last-N tracer events (Chrome-trace format,
+  Perfetto-loadable as-is) with the ring's ``droppedEvents`` count, so a
+  truncated window is self-announcing.
+* ``metrics.json``    — a full snapshot of every registered metrics
+  source at death.
+* ``timeseries.json`` — the sampler ring tail (the minutes *leading up
+  to* the event — the part a point-in-time snapshot can never give you).
+* ``config.json``     — the full run config.
+* ``MANIFEST.json``   — per-file sizes + SHA-256, written last; the dump
+  stages into a ``.tmp-`` dir and renames, so a dump directory that
+  exists is complete (same discipline as the checkpoint store).
+
+``scripts/postmortem.py`` renders a dump into a human-readable incident
+summary. Wiring: the trainer's ``finally`` path, the serving stepper's
+fault handler, :class:`~dlti_tpu.serving.replicas.ReplicatedEngine`
+failover, the watchdog's ``dump``/``abort`` escalations, and the chaos
+injectors' pre-fire hook (so even a ``--fault-inject-step N:kill``
+SIGKILL leaves the black box behind).
+
+A process-global recorder (:func:`install` / :func:`get_recorder`)
+mirrors the tracer's pattern so far-apart components (engine fault path,
+replica failover) can reach it without plumbing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+from dlti_tpu.telemetry.registry import Counter
+from dlti_tpu.telemetry.tracer import SpanTracer, get_tracer
+from dlti_tpu.utils.logging import get_logger
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+FLIGHT_METRIC_NAMES = ("dlti_flight_dumps_total",)
+
+dumps_total = Counter(
+    FLIGHT_METRIC_NAMES[0],
+    help="flight-record dumps written, labeled by reason")
+
+_PREFIX = "flight-"
+_TMP = ".tmp-"
+MANIFEST = "MANIFEST.json"
+DUMP_FILES = ("context.json", "spans.json", "metrics.json",
+              "timeseries.json", "config.json")
+
+
+def config_fingerprint(config) -> Optional[str]:
+    """Stable digest of the run config (sorted-key JSON), so two dumps
+    from 'the same' job are provably same-config or provably not."""
+    if config is None:
+        return None
+    try:
+        payload = config.to_json() if hasattr(config, "to_json") \
+            else json.dumps(config, sort_keys=True, default=str)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+    except Exception:
+        return None
+
+
+class FlightRecorder:
+    """Collects context continuously; writes the black box on demand."""
+
+    def __init__(self, directory: str, *,
+                 tracer: Optional[SpanTracer] = None,
+                 sampler=None, config=None,
+                 max_spans: int = 4096, timeseries_tail: int = 240,
+                 keep: int = 8, min_interval_s: float = 5.0):
+        self.directory = os.path.abspath(directory)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.sampler = sampler
+        self.config = config
+        self.max_spans = max_spans
+        self.timeseries_tail = timeseries_tail
+        self.keep = keep
+        self.min_interval_s = min_interval_s
+        self.logger = get_logger()
+        self._lock = threading.Lock()
+        self._context: dict = {}
+        self._metrics_sources: List[Callable[[], dict]] = []
+        self._context_sources: List[Callable[[], dict]] = []
+        self._last_dump_t = 0.0
+        self.last_dump_path: Optional[str] = None
+
+    # -- live context ---------------------------------------------------
+    def note(self, **kw) -> None:
+        """Cheap context update (a dict merge under a lock): components
+        call this as their state changes — ``note(phase="decode",
+        step=123)`` — so a dump can say what was happening *at death*."""
+        with self._lock:
+            self._context.update(kw)
+
+    def add_metrics_source(self, fn: Callable[[], dict]) -> None:
+        """A callable snapshotted into ``metrics.json`` at dump time
+        (e.g. ``registry.stats_dict`` or the trainer's live scalars)."""
+        self._metrics_sources.append(fn)
+
+    def add_context_source(self, fn: Callable[[], dict]) -> None:
+        """A callable merged into ``context.json`` at dump time (e.g. the
+        watchdog's recent-alerts tail)."""
+        self._context_sources.append(fn)
+
+    # -- the dump -------------------------------------------------------
+    def dump(self, reason: str, exc: Optional[BaseException] = None,
+             extra: Optional[dict] = None,
+             force: bool = False) -> Optional[str]:
+        """Write a complete ``flight-*/`` directory; returns its path.
+
+        Never raises (a forensics failure must not mask the original
+        fault) and throttles repeat dumps within ``min_interval_s``
+        unless ``force`` — terminal paths (fatal exception, pre-kill
+        chaos hook) pass ``force=True``.
+        """
+        try:
+            now = time.monotonic()
+            with self._lock:
+                if not force and now - self._last_dump_t < self.min_interval_s:
+                    return None
+                self._last_dump_t = now
+                context = dict(self._context)
+            return self._write(reason, exc, extra, context)
+        except Exception:
+            self.logger.exception("flight-record dump failed (reason=%s)",
+                                  reason)
+            return None
+
+    def _write(self, reason, exc, extra, context) -> str:
+        for fn in self._context_sources:
+            try:
+                context.update(fn())
+            except Exception:
+                context.setdefault("context_source_errors", 0)
+                context["context_source_errors"] += 1
+        metrics: dict = {}
+        for fn in self._metrics_sources:
+            try:
+                metrics.update(fn())
+            except Exception:
+                metrics.setdefault("metrics_source_errors", 0)
+                metrics["metrics_source_errors"] += 1
+
+        label = (f"step{int(context['step']):08d}" if "step" in context
+                 else time.strftime("%Y%m%dT%H%M%S"))
+        os.makedirs(self.directory, exist_ok=True)
+        final = self._unique_dir(f"{_PREFIX}{label}")
+        tmp = os.path.join(self.directory,
+                           f"{_TMP}{os.path.basename(final)}-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+
+        events = self.tracer.events()[-self.max_spans:]
+        payloads = {
+            "context.json": {
+                "reason": reason,
+                "wall_time": time.time(),
+                "iso_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                "pid": os.getpid(),
+                "config_fingerprint": config_fingerprint(self.config),
+                "exception": ("".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__)).rstrip()
+                    if exc is not None else None),
+                "context": context,
+                **(extra or {}),
+            },
+            "spans.json": {
+                "traceEvents": events,
+                "displayTimeUnit": "ms",
+                "droppedEvents": self.tracer.dropped_events,
+                "tracerEnabled": self.tracer.enabled,
+            },
+            "metrics.json": metrics,
+            "timeseries.json": {
+                "samples": (self.sampler.tail(self.timeseries_tail)
+                            if self.sampler is not None else []),
+            },
+            "config.json": (self.config.to_dict()
+                            if hasattr(self.config, "to_dict")
+                            else (self.config or {})),
+        }
+        manifest: dict = {"format": 1, "reason": reason,
+                          "created": time.time(), "files": {}}
+        for name, obj in payloads.items():
+            path = os.path.join(tmp, name)
+            data = json.dumps(obj, indent=1, default=str).encode()
+            with open(path, "wb") as f:
+                f.write(data)
+            manifest["files"][name] = {
+                "bytes": len(data),
+                "sha256": hashlib.sha256(data).hexdigest(),
+            }
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, final)  # atomic: a visible flight-* dir is complete
+        dumps_total.labels(reason=reason.split(":")[0]).inc()
+        self.last_dump_path = final
+        self.logger.warning("flight record (%s) -> %s", reason, final)
+        self._rotate()
+        return final
+
+    def _unique_dir(self, base: str) -> str:
+        path = os.path.join(self.directory, base)
+        n = 1
+        while os.path.exists(path):
+            path = os.path.join(self.directory, f"{base}-{n}")
+            n += 1
+        return path
+
+    def _rotate(self) -> None:
+        if self.keep <= 0:
+            return
+        import shutil
+
+        dumps = sorted(
+            (d for d in os.listdir(self.directory)
+             if d.startswith(_PREFIX)
+             and os.path.isdir(os.path.join(self.directory, d))),
+            key=lambda d: os.path.getmtime(os.path.join(self.directory, d)))
+        for d in dumps[:-self.keep]:
+            shutil.rmtree(os.path.join(self.directory, d),
+                          ignore_errors=True)
+
+
+# ----------------------------------------------------------------------
+# Verification / loading (postmortem CLI + tests)
+# ----------------------------------------------------------------------
+
+def verify_dump(path: str) -> List[str]:
+    """Digest-check a dump against its manifest; returns problems
+    (empty = complete and intact)."""
+    problems: List[str] = []
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"manifest unreadable: {e}"]
+    for name, meta in manifest.get("files", {}).items():
+        fpath = os.path.join(path, name)
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError:
+            problems.append(f"missing file: {name}")
+            continue
+        if len(data) != meta["bytes"]:
+            problems.append(f"size mismatch: {name}")
+        elif hashlib.sha256(data).hexdigest() != meta["sha256"]:
+            problems.append(f"digest mismatch: {name}")
+    for name in DUMP_FILES:
+        if name not in manifest.get("files", {}):
+            problems.append(f"manifest missing entry: {name}")
+    return problems
+
+
+def load_dump(path: str) -> dict:
+    """{filename: parsed JSON} for a dump directory."""
+    out = {}
+    for name in DUMP_FILES + (MANIFEST,):
+        fpath = os.path.join(path, name)
+        if os.path.exists(fpath):
+            with open(fpath) as f:
+                out[name] = json.load(f)
+    return out
+
+
+def list_dumps(directory: str) -> List[str]:
+    """Committed flight dirs under ``directory``, oldest first."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    dumps = [os.path.join(directory, d) for d in os.listdir(directory)
+             if d.startswith(_PREFIX)
+             and os.path.isdir(os.path.join(directory, d))]
+    return sorted(dumps, key=os.path.getmtime)
+
+
+# ----------------------------------------------------------------------
+# Process-global recorder (the tracer's pattern): far-apart components —
+# engine fault path, replica failover, chaos hooks — reach the black box
+# without explicit plumbing. None when no entry point installed one.
+# ----------------------------------------------------------------------
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def install(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    global _RECORDER
+    _RECORDER = recorder
+    return recorder
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER

@@ -289,6 +289,16 @@ class MetricsRegistry:
         :meth:`stats_dict`)."""
         self._sources.append(_ScalarSource(fn, gauge_keys, prefix))
 
+    def metric_names(self) -> List[str]:
+        """Every exposition name this registry serves: registered metric
+        objects plus the (prefixed) scalar-source keys. The naming-
+        convention guard (``tests/test_metric_naming.py``) walks this."""
+        with self._lock:
+            names = set(self._metrics)
+        for name, _, _ in self._scalar_samples():
+            names.add(name)
+        return sorted(names)
+
     # -- collection -----------------------------------------------------
     def _scalar_samples(self) -> List[Tuple[str, str, float]]:
         """[(exposition_name, kind, value)] from every scalar source."""

@@ -77,6 +77,12 @@ class SpanTracer:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        # Events silently evicted by the ring since process start. The ring
+        # overwriting oldest-first is the design — but forensics consumers
+        # (flight-record dumps, /debug/trace) must be able to tell "this is
+        # the whole story" from "this is the most recent window of a longer
+        # one", so truncation is counted, never silent.
+        self._dropped = 0
 
     # -- recording ------------------------------------------------------
     def span(self, name: str, cat: str = "host", **args):
@@ -107,8 +113,7 @@ class SpanTracer:
               & 0x7FFFFFFF}
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
 
     def _complete_event(self, name, start_s, end_s, cat, tid, args) -> None:
         ev = {"ph": "X", "name": name, "cat": cat,
@@ -116,10 +121,23 @@ class SpanTracer:
               "pid": self._pid, "tid": tid & 0x7FFFFFFF}
         if args:
             ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict) -> None:
         with self._lock:
+            if (self._events.maxlen is not None
+                    and len(self._events) == self._events.maxlen):
+                self._dropped += 1
             self._events.append(ev)
 
     # -- inspection / export --------------------------------------------
+    @property
+    def dropped_events(self) -> int:
+        """Events evicted by the ring since process start (monotonic —
+        ``clear()`` does not reset it; it feeds a /metrics counter)."""
+        with self._lock:
+            return self._dropped
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._events)
@@ -133,7 +151,11 @@ class SpanTracer:
             self._events.clear()
 
     def to_dict(self) -> dict:
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+        # droppedEvents is an extra top-level key: Perfetto/chrome://tracing
+        # ignore unknown keys, while forensics consumers (flight records,
+        # /debug/trace readers) use it to see whether the window truncated.
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms",
+                "droppedEvents": self.dropped_events}
 
     def export(self, path: str) -> str:
         """Write the ring snapshot as Chrome-trace JSON; returns ``path``.
@@ -167,6 +189,7 @@ def configure_tracer(enabled: Optional[bool] = None,
     if capacity is not None and capacity != t.capacity:
         with t._lock:
             t.capacity = capacity
+            t._dropped += max(0, len(t._events) - capacity)
             t._events = deque(t._events, maxlen=capacity)
     if enabled is not None:
         t.enabled = enabled

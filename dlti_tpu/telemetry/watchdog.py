@@ -1,0 +1,364 @@
+"""Anomaly watchdog: a rule engine over the time-series ring.
+
+The system noticing its own anomalies (the ROADMAP's "heavy traffic from
+millions of users" has no human watching a dashboard): a small set of
+rules runs over the :class:`~dlti_tpu.telemetry.timeseries.TimeSeriesSampler`
+ring plus two push-style signals (step completions from the trainer,
+heartbeats from multi-host runs), and every firing becomes a structured
+alert — JSONL event log, ``dlti_watchdog_alerts_total{rule=...}`` counter,
+a ``watchdog/alert`` tracer instant — with a configurable escalation:
+
+* ``log``   — the alert record is the whole response (default);
+* ``dump``  — additionally trigger a flight-record dump
+  (:mod:`~dlti_tpu.telemetry.flightrecorder`), throttled;
+* ``abort`` — dump, then hard-exit the process with
+  :data:`ABORT_EXIT_CODE` — for CI chaos runs where a hung step must fail
+  the job rather than burn the runner's budget.
+
+Rules (all edge-triggered — an alert fires on the condition's rising edge
+and re-arms only when the condition clears, so a sustained anomaly is one
+alert, not one per check interval):
+
+* ``hung_step``           — no step completion within
+  ``max(hung_step_min_s, hung_step_factor x rolling-median step time)``
+  of the last one (MegaScale's straggler/hang localizer, in-framework).
+* ``throughput_collapse`` — the latest throughput reading fell below
+  ``throughput_floor_frac`` x the rolling median (training tok/s gauge
+  and the serving ``generated_tokens`` counter rate are both watched).
+* ``queue_buildup``       — gateway queue depth at/above
+  ``queue_depth_limit`` for 3 consecutive samples.
+* ``shed_buildup``        — gateway sheds+rejections accruing faster than
+  ``shed_rate_limit`` per second over the recent window.
+* ``heartbeat_stale``     — a process's heartbeat older than
+  ``heartbeat_stale_s`` (multi-host straggler death).
+* ``ckpt_retry_storm``    — ``ckpt_save_retries`` grew by at least
+  ``ckpt_retry_limit`` across the ring window (storage going bad under
+  the async writer's backoff).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as _signal
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from dlti_tpu.telemetry.registry import Counter
+from dlti_tpu.telemetry.timeseries import TimeSeriesSampler
+from dlti_tpu.telemetry.tracer import get_tracer
+from dlti_tpu.utils.logging import get_logger
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+WATCHDOG_METRIC_NAMES = ("dlti_watchdog_alerts_total",)
+
+# Module-level counter, same pattern as the checkpoint store's metrics:
+# trainer-side and server-side watchdogs share it; the server registry
+# registers it for /metrics exposition.
+alerts_total = Counter(
+    WATCHDOG_METRIC_NAMES[0],
+    help="watchdog alerts fired, labeled by rule")
+
+RULES = ("hung_step", "throughput_collapse", "queue_buildup",
+         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm")
+
+ACTIONS = ("log", "dump", "abort")
+
+# Exit code of the `abort` escalation (CI chaos runs assert on it; chosen
+# clear of shell/signal codes).
+ABORT_EXIT_CODE = 86
+
+# Throughput series the collapse rule auto-watches: (name, is_counter).
+_THROUGHPUT_SERIES = (
+    ("train_tokens_per_s", False),
+    ("generated_tokens", True),
+)
+
+# Counter names the shed-buildup rule sums (registry stats_dict keys; the
+# reject counter carries per-reason labels, hence the prefix match).
+_SHED_KEY_PREFIXES = ("dlti_gateway_shed_total", "dlti_gateway_rejected_total")
+
+_CKPT_RETRY_KEYS = ("ckpt_save_retries", "dlti_ckpt_save_retries")
+
+
+class AnomalyWatchdog:
+    """Rule engine over a sampler ring; see module docstring."""
+
+    def __init__(self, cfg, sampler: TimeSeriesSampler, *,
+                 heartbeat=None, tracer=None,
+                 on_dump: Optional[Callable[[dict], Optional[str]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if cfg.action not in ACTIONS:
+            raise ValueError(f"watchdog action must be one of {ACTIONS}, "
+                             f"got {cfg.action!r}")
+        self.cfg = cfg
+        self.sampler = sampler
+        self.heartbeat = heartbeat
+        self.logger = get_logger()
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._on_dump = on_dump
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Step-completion signal (trainer pushes; serving runs without it).
+        self._last_step: Optional[int] = None
+        self._last_step_t: Optional[float] = None
+        self._step_durations: deque = deque(maxlen=32)
+        # Edge-trigger state: condition keys currently firing.
+        self._active: set = set()
+        self.alerts: deque = deque(maxlen=256)  # recent alerts (forensics)
+        self._last_dump_t = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- push signals ---------------------------------------------------
+    def notify_step(self, step: int) -> None:
+        """Step-completion heartbeat from the training loop (call once per
+        optimizer step, AFTER it completed)."""
+        now = self._clock()
+        with self._lock:
+            if self._last_step_t is not None:
+                self._step_durations.append(max(1e-6, now - self._last_step_t))
+            self._last_step = int(step)
+            self._last_step_t = now
+            self._active.discard("hung_step")  # progress re-arms the rule
+
+    # -- rule evaluation ------------------------------------------------
+    def hung_step_deadline_s(self) -> float:
+        """The current hang deadline: ``k x rolling-median step time``,
+        floored at ``hung_step_min_s`` so cold-start compiles and the
+        first few (unmeasured) steps never false-positive."""
+        with self._lock:
+            durs = list(self._step_durations)
+        med = statistics.median(durs) if durs else 0.0
+        return max(self.cfg.hung_step_min_s, self.cfg.hung_step_factor * med)
+
+    def check_now(self, now: Optional[float] = None) -> List[dict]:
+        """Run every rule once; returns the alerts fired by this check
+        (already emitted/escalated). The background thread calls this at
+        ``interval_s``; tests call it directly."""
+        now = self._clock() if now is None else now
+        fired: List[dict] = []
+
+        # hung_step ----------------------------------------------------
+        with self._lock:
+            last_t, last_step = self._last_step_t, self._last_step
+        if last_t is not None:
+            deadline = self.hung_step_deadline_s()
+            stalled = now - last_t
+            if stalled > deadline:
+                a = self._fire("hung_step", "hung_step",
+                               f"no step completed for {stalled:.1f}s "
+                               f"(deadline {deadline:.1f}s, last step "
+                               f"{last_step})",
+                               last_step=last_step,
+                               stalled_s=round(stalled, 3),
+                               deadline_s=round(deadline, 3))
+                if a:
+                    fired.append(a)
+            # (re-arming happens in notify_step, not on condition clear:
+            # only real progress should silence a hang alert.)
+
+        # throughput_collapse ------------------------------------------
+        for name, is_counter in self._throughput_series():
+            vals = self._throughput_points(name, is_counter)
+            key = f"throughput_collapse:{name}"
+            if len(vals) >= self.cfg.throughput_min_samples:
+                med = statistics.median(vals[:-1])
+                latest = vals[-1]
+                floor = self.cfg.throughput_floor_frac * med
+                if med > 0 and latest < floor:
+                    a = self._fire("throughput_collapse", key,
+                                   f"{name} collapsed to {latest:.2f} "
+                                   f"(rolling median {med:.2f}, floor "
+                                   f"{floor:.2f})",
+                                   series=name, latest=round(latest, 4),
+                                   median=round(med, 4))
+                    if a:
+                        fired.append(a)
+                else:
+                    self._active.discard(key)
+
+        # queue_buildup ------------------------------------------------
+        if self.cfg.queue_depth_limit > 0:
+            pts = [v for _, v in
+                   self.sampler.series("gateway_queue_depth")][-3:]
+            if len(pts) == 3 and min(pts) >= self.cfg.queue_depth_limit:
+                a = self._fire("queue_buildup", "queue_buildup",
+                               f"gateway queue depth >= "
+                               f"{self.cfg.queue_depth_limit} for 3 "
+                               f"samples (latest {pts[-1]:.0f})",
+                               depth=pts[-1])
+                if a:
+                    fired.append(a)
+            elif pts and pts[-1] < self.cfg.queue_depth_limit:
+                self._active.discard("queue_buildup")
+
+        # shed_buildup -------------------------------------------------
+        if self.cfg.shed_rate_limit > 0:
+            latest = self.sampler.latest()
+            keys = [k for k in (latest or {}).get("values", {})
+                    if k.startswith(_SHED_KEY_PREFIXES)]
+            rate = sum(self.sampler.rate(k, window_s=30.0) or 0.0
+                       for k in keys)
+            if rate > self.cfg.shed_rate_limit:
+                a = self._fire("shed_buildup", "shed_buildup",
+                               f"gateway shedding {rate:.2f} req/s "
+                               f"(limit {self.cfg.shed_rate_limit:g})",
+                               shed_per_s=round(rate, 3))
+                if a:
+                    fired.append(a)
+            else:
+                self._active.discard("shed_buildup")
+
+        # heartbeat_stale ----------------------------------------------
+        if self.cfg.heartbeat_stale_s > 0 and self.heartbeat is not None:
+            wall = time.time()
+            stale = {p: wall - t for p, (_, t)
+                     in self.heartbeat.last_seen.items()
+                     if wall - t > self.cfg.heartbeat_stale_s}
+            if stale:
+                a = self._fire("heartbeat_stale", "heartbeat_stale",
+                               f"process(es) silent past "
+                               f"{self.cfg.heartbeat_stale_s:g}s: " +
+                               ", ".join(f"proc {p}: {s:.0f}s"
+                                         for p, s in sorted(stale.items())),
+                               stale={str(p): round(s, 1)
+                                      for p, s in stale.items()})
+                if a:
+                    fired.append(a)
+            else:
+                self._active.discard("heartbeat_stale")
+
+        # ckpt_retry_storm ---------------------------------------------
+        if self.cfg.ckpt_retry_limit > 0:
+            for key in _CKPT_RETRY_KEYS:
+                pts = [v for _, v in self.sampler.series(key)]
+                if len(pts) < 2:
+                    continue
+                grew = pts[-1] - pts[0]
+                if grew >= self.cfg.ckpt_retry_limit:
+                    a = self._fire("ckpt_retry_storm", "ckpt_retry_storm",
+                                   f"checkpoint save retried {grew:.0f}x "
+                                   f"within the ring window",
+                                   retries=grew)
+                    if a:
+                        fired.append(a)
+                else:
+                    self._active.discard("ckpt_retry_storm")
+                break
+        return fired
+
+    def _throughput_series(self):
+        if self.cfg.throughput_series:
+            # Explicit override: treated as a gauge series.
+            return ((self.cfg.throughput_series, False),)
+        return _THROUGHPUT_SERIES
+
+    def _throughput_points(self, name: str, is_counter: bool) -> List[float]:
+        pts = self.sampler.series(name)
+        if not is_counter:
+            return [v for _, v in pts]
+        # Counter -> per-interval rates (consecutive deltas), clamped at 0.
+        rates = []
+        for (t0, v0), (t1, v1) in zip(pts, pts[1:]):
+            if t1 > t0:
+                rates.append(max(0.0, (v1 - v0) / (t1 - t0)))
+        return rates
+
+    # -- emission / escalation ------------------------------------------
+    def _fire(self, rule: str, key: str, message: str,
+              **data) -> Optional[dict]:
+        """Emit iff ``key``'s condition is newly true (edge trigger)."""
+        with self._lock:
+            if key in self._active:
+                return None
+            self._active.add(key)
+        alert = {"wall": time.time(), "rule": rule, "message": message,
+                 "action": self.cfg.action, **data}
+        self.alerts.append(alert)
+        alerts_total.labels(rule=rule).inc()
+        self._tracer.instant("watchdog/alert", cat="watchdog", rule=rule,
+                             message=message)
+        self.logger.warning("watchdog alert [%s]: %s", rule, message)
+        if self.cfg.alert_log_path:
+            try:
+                d = os.path.dirname(self.cfg.alert_log_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                with open(self.cfg.alert_log_path, "a") as f:
+                    f.write(json.dumps(alert) + "\n")
+            except OSError:
+                self.logger.exception("watchdog alert log write failed")
+        self._escalate(alert)
+        return alert
+
+    def _dump(self, alert: dict) -> None:
+        now = self._clock()
+        if now - self._last_dump_t < 30.0:  # dump-storm throttle
+            return
+        self._last_dump_t = now
+        try:
+            if self._on_dump is not None:
+                self._on_dump(alert)
+            else:
+                from dlti_tpu.telemetry.flightrecorder import get_recorder
+
+                rec = get_recorder()
+                if rec is not None:
+                    rec.dump(reason=f"watchdog:{alert['rule']}",
+                             extra={"alert": alert})
+        except Exception:
+            self.logger.exception("watchdog flight-record dump failed")
+
+    def _escalate(self, alert: dict) -> None:
+        if self.cfg.action == "log":
+            return
+        self._dump(alert)
+        if self.cfg.action == "abort":
+            # CI chaos runs: fail the job NOW rather than hang to the
+            # harness timeout. SIGTERM first gives the trainer its
+            # preemption-checkpoint path; the hard exit backstops a
+            # process too wedged to honor it.
+            self.logger.error(
+                "watchdog abort escalation [%s]; sending SIGTERM then "
+                "exiting %d", alert["rule"], ABORT_EXIT_CODE)
+            try:
+                os.kill(os.getpid(), _signal.SIGTERM)
+                time.sleep(min(10.0, 2 * self.cfg.hung_step_min_s))
+            finally:
+                os._exit(ABORT_EXIT_CODE)
+
+    # -- counters for reports -------------------------------------------
+    def alert_counts(self) -> dict:
+        """{rule: count} over this watchdog's lifetime (for bench/loadgen
+        result JSON)."""
+        out: dict = {}
+        for a in self.alerts:
+            out[a["rule"]] = out.get(a["rule"], 0) + 1
+        return out
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dlti-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.cfg.interval_s + 1)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.cfg.interval_s):
+            try:
+                self.check_now()
+            except Exception:
+                # The watchdog must never kill the thing it watches.
+                self.logger.exception("watchdog check failed")

@@ -52,6 +52,14 @@ class TrainFaultInjector:
         self.step = step
         self.mode = mode
         self.fired = False
+        # Forensics hook, called (mode, where, step) right before the
+        # fault fires — even in the ``kill`` modes, where it is the ONLY
+        # code that runs before SIGKILL. The trainer wires the flight
+        # recorder here so a chaos kill leaves its black box behind (a
+        # real external SIGKILL still leaves nothing; the *injected* one
+        # is a drill, and drills should produce the evidence the
+        # postmortem tooling is drilled on).
+        self.pre_fire = None
 
     @classmethod
     def from_spec(cls, spec: Optional[str]) -> Optional["TrainFaultInjector"]:
@@ -73,6 +81,11 @@ class TrainFaultInjector:
     # ------------------------------------------------------------------
     def _fire(self, where: str, step: int) -> None:
         self.fired = True
+        if self.pre_fire is not None:
+            try:
+                self.pre_fire(self.mode, where, step)
+            except Exception:
+                pass  # forensics must never save the process from chaos
         if self.mode.endswith("kill"):
             # No Python teardown at all — the process vanishes like a
             # preempted node. stdio is not flushed on purpose.

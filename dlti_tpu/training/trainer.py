@@ -16,6 +16,7 @@ One class drives what the reference spreads across four scripts
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Iterable, Optional
 
@@ -30,7 +31,9 @@ from dlti_tpu.models import LlamaForCausalLM, count_params
 from dlti_tpu.parallel.mesh import build_mesh
 from dlti_tpu.parallel.sharding import make_sharded_train_step, shard_train_state
 from dlti_tpu.telemetry import (
-    Heartbeat, StepLogWriter, configure_tracer, get_tracer, schedule_lr,
+    AnomalyWatchdog, FlightRecorder, Heartbeat, StepLogWriter,
+    TimeSeriesSampler, configure_tracer, get_recorder, get_tracer,
+    install_recorder, schedule_lr,
 )
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
@@ -191,6 +194,10 @@ class Trainer:
         # Disabled by default; cfg.telemetry.trace_dir enables it in
         # train() — span sites cost one attribute read while disabled.
         self._tracer = get_tracer()
+        # Flight-recorder context hook (telemetry.flightrecorder): a
+        # dict-merge no-op until train() installs a recorder; methods
+        # outside the loop (_run_eval, _maybe_save) call it too.
+        self._fnote = lambda **kw: None
 
     # ------------------------------------------------------------------
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -457,6 +464,72 @@ class Trainer:
         heartbeat = None
         if tcfg.heartbeat_interval_steps > 0:
             heartbeat = Heartbeat()
+
+        # -- self-monitoring: time-series ring + watchdog + black box ---
+        # (telemetry.timeseries / .watchdog / .flightrecorder): the ring
+        # samples the live training scalars below; the watchdog's
+        # hung-step rule is fed by notify_step in bookkeep; the flight
+        # recorder dumps on fatal exceptions, preemption stops, watchdog
+        # escalation, and the chaos injector's pre-fire hook.
+        wcfg, fcfg = tcfg.watchdog, tcfg.flight_recorder
+        sampler = None
+        watchdog = None
+        flight = None
+        self._live = {"train_step": start_step}
+
+        def _train_scalars():
+            from dlti_tpu.checkpoint.store import (
+                corrupt_skipped, last_verified_step, save_retries,
+            )
+
+            d = dict(self._live)
+            d["ckpt_save_retries"] = save_retries.value
+            d["ckpt_corrupt_skipped"] = corrupt_skipped.value
+            d["ckpt_last_verified_step"] = last_verified_step.value
+            d["trace_dropped_events"] = tracer.dropped_events
+            return d
+
+        if wcfg.enabled or fcfg.enabled:
+            sampler = TimeSeriesSampler(interval_s=wcfg.interval_s)
+            sampler.add_source(_train_scalars)
+        if fcfg.enabled and is_main_process():
+            if not tracer.enabled:
+                # The black box needs a span tail even without a
+                # --trace-dir export: recording is cheap (ring appends),
+                # missing evidence is not.
+                self._tracer = tracer = configure_tracer(
+                    enabled=True, capacity=tcfg.trace_capacity)
+            flight = FlightRecorder(
+                fcfg.dir, tracer=tracer, sampler=sampler, config=cfg,
+                max_spans=fcfg.max_spans,
+                timeseries_tail=fcfg.timeseries_tail, keep=fcfg.keep)
+            flight.add_metrics_source(_train_scalars)
+            flight.note(role="training", phase="init", step=start_step,
+                        last_completed_step=start_step,
+                        experiment=experiment_name_from_config(cfg))
+            install_recorder(flight)
+            self._fnote = flight.note
+            if self._fault is not None:
+                # Chaos forensics: the injected fault's last act is
+                # writing the black box — even for N:kill, where the
+                # pre-fire hook is the only code that runs before
+                # SIGKILL. The drill exists to produce the evidence.
+                self._fault.pre_fire = \
+                    lambda mode, where, step: flight.dump(
+                        reason=f"chaos_{mode}", force=True,
+                        extra={"where": where, "injected_at_step": step})
+        if wcfg.enabled:
+            watchdog = AnomalyWatchdog(wcfg, sampler, heartbeat=heartbeat,
+                                       tracer=tracer)
+            if flight is not None:
+                flight.add_context_source(
+                    lambda: {"watchdog_alerts": list(watchdog.alerts)})
+        if sampler is not None:
+            sampler.start()
+        if watchdog is not None:
+            watchdog.start()
+        fnote = self._fnote
+
         # Constants for the per-step MFU/throughput fields (same terms
         # _final_metrics uses for the run-level record).
         peak_flops = detect_chip_peak_flops() if steplog is not None else 0.0
@@ -617,8 +690,10 @@ class Trainer:
                 warm = step_fn_warm["done"]
                 if warm:
                     timer.start()
+                fnote(phase="step_dispatch")
                 with tracer.span("train/step_dispatch", cat="train"):
                     state, m = step_fn(state, gb, r)
+                fnote(phase="device_sync")
                 with tracer.span("train/device_sync", cat="train"):
                     m = jax.device_get(m)  # blocks: true step time
                 if warm:
@@ -642,9 +717,11 @@ class Trainer:
                        for key in window[0][0]}
             rngs = jnp.stack([r for _, _, r in window])
             with timer.measure(steps=k):
+                fnote(phase="step_dispatch")
                 with tracer.span("train/step_dispatch", cat="train",
                                  window=k):
                     state, mstack = multi_fn(state, stacked, rngs)
+                fnote(phase="device_sync")
                 with tracer.span("train/device_sync", cat="train"):
                     mstack = jax.device_get(mstack)
             executed = [(window[i][0], window[i][2],
@@ -739,6 +816,21 @@ class Trainer:
                         timer.steps_per_second * tokens_per_step
                         / max(jax.device_count(), 1),
                     )
+            # Self-monitoring bookkeeping: refresh the sampled scalars,
+            # feed the hung-step heartbeat, and stamp the flight context
+            # with the last completed step (what a postmortem names).
+            dt = timer.last_step_seconds
+            self._live.update(
+                train_step=global_step,
+                train_step_time_s=dt,
+                train_tokens_per_s=(tokens_per_step / dt if dt > 0 else 0.0),
+                samples_seen=samples_seen)
+            if losses:
+                self._live["train_loss"] = losses[-1]
+            if watchdog is not None:
+                watchdog.notify_step(global_step)
+            fnote(step=global_step, last_completed_step=global_step,
+                  phase="between_steps")
             if heartbeat is not None and (
                     global_step // tcfg.heartbeat_interval_steps
                     > step_before // tcfg.heartbeat_interval_steps):
@@ -773,6 +865,7 @@ class Trainer:
                     # Under prefetch this span measures the *stall* only —
                     # the gather itself runs in the worker's
                     # train/prefetch spans.
+                    fnote(phase="batch_fetch")
                     with tracer.span("train/batch_fetch", cat="train"):
                         batch = next(batch_iter, _EPOCH_END)
                     if batch is _EPOCH_END:
@@ -889,6 +982,26 @@ class Trainer:
                         "preemption checkpoint written at step %d", global_step)
         finally:
             close_prefetcher()  # a mid-epoch exception must not leak the worker
+            if flight is not None:
+                # The black box goes down with the ship: a fatal
+                # exception (or a preemption stop) dumps before any
+                # cleanup rewrites state. dump() never raises and
+                # throttles duplicates (the chaos pre-fire hook may have
+                # dumped milliseconds ago), so the original exception is
+                # never masked.
+                exc = sys.exc_info()[1]
+                if exc is not None:
+                    flight.dump(reason="fatal_exception", exc=exc)
+                elif self._stop_requested:
+                    flight.dump(reason="preemption_stop")
+            if watchdog is not None:
+                watchdog.stop()
+            if sampler is not None:
+                sampler.stop()
+            if flight is not None:
+                if get_recorder() is flight:
+                    install_recorder(None)
+                self._fnote = lambda **kw: None
             if sigterm_installed:
                 # signal.signal reports a non-Python-installed previous
                 # handler as None; SIG_DFL is the closest restorable state.
@@ -948,6 +1061,7 @@ class Trainer:
             state = state.replace(
                 params=jax.device_put(state.params, dev_sh))
         losses, toks = [], 0.0
+        self._fnote(phase="eval")
         with self._tracer.span("train/eval", cat="train", step=step):
             for batch in eval_dataset.epoch(0):
                 flat = {
@@ -984,6 +1098,7 @@ class Trainer:
             return
         from dlti_tpu.checkpoint import save_train_state
 
+        self._fnote(phase="checkpoint_save")
         with self._tracer.span("train/checkpoint_save", cat="train",
                                step=step):
             save_train_state(

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Render a flight-record dump into a human-readable incident summary.
+
+The reading half of the flight recorder
+(``dlti_tpu/telemetry/flightrecorder.py``): point it at a ``flight-*/``
+directory — or at the parent dir, where it picks the newest dump — and it
+prints what an on-call human needs first: why the process died, the last
+completed step, the phase active at death, the final span timeline, the
+watchdog alerts that preceded it, and whether any of the evidence is
+truncated (dropped span events) or damaged (manifest digest mismatch).
+
+Usage:
+    python scripts/postmortem.py runs/flightrecords            # newest
+    python scripts/postmortem.py runs/flightrecords/flight-step00000042
+    python scripts/postmortem.py ... --spans 30                # longer tail
+    python scripts/postmortem.py ... --json                    # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Source checkout wins over any installed copy; an installed dlti-tpu
+# serves scripts run from outside a checkout.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
+
+from dlti_tpu.telemetry.flightrecorder import (  # noqa: E402
+    list_dumps, load_dump, verify_dump,
+)
+
+# Metrics promoted into the summary when present (everything else is in
+# metrics.json for the deep read).
+_KEY_METRICS = (
+    "train_step", "train_loss", "train_tokens_per_s", "train_step_time_s",
+    "ckpt_save_retries", "ckpt_corrupt_skipped", "ckpt_last_verified_step",
+    "requests", "generated_tokens", "active_seqs", "waiting", "free_blocks",
+    "gateway_queue_depth", "gateway_inflight", "preemptions",
+    "trace_dropped_events",
+)
+
+
+def _resolve_dump(path: str) -> str:
+    path = os.path.abspath(path)
+    if os.path.isdir(path) and os.path.exists(
+            os.path.join(path, "MANIFEST.json")):
+        return path
+    dumps = list_dumps(path)
+    if not dumps:
+        raise SystemExit(f"no flight-*/ dump under {path}")
+    return dumps[-1]
+
+
+def summarize(dump_dir: str, span_tail: int = 15) -> dict:
+    """Machine-readable incident summary for one dump directory."""
+    data = load_dump(dump_dir)
+    problems = verify_dump(dump_dir)
+    ctx_file = data.get("context.json", {})
+    context = ctx_file.get("context", {})
+    spans = data.get("spans.json", {})
+    events = spans.get("traceEvents", [])
+    metrics = data.get("metrics.json", {})
+    ts = data.get("timeseries.json", {}).get("samples", [])
+
+    # The phase at death: the recorder's live context is authoritative;
+    # the last span in the tail corroborates (or supplies it for dumps
+    # taken without context notes).
+    last_span = next((e for e in reversed(events) if e.get("ph") == "X"),
+                     None)
+    phase = context.get("phase") or (last_span or {}).get("name")
+
+    alerts = context.get("watchdog_alerts", [])
+    span_counts: dict = {}
+    for e in events:
+        span_counts[e.get("name", "?")] = span_counts.get(
+            e.get("name", "?"), 0) + 1
+
+    exc = ctx_file.get("exception")
+    return {
+        "dump": dump_dir,
+        "reason": ctx_file.get("reason"),
+        "when": ctx_file.get("iso_time"),
+        "pid": ctx_file.get("pid"),
+        "role": context.get("role"),
+        "config_fingerprint": ctx_file.get("config_fingerprint"),
+        "last_completed_step": context.get("last_completed_step",
+                                           context.get("step")),
+        "phase_at_death": phase,
+        "exception_tail": (exc.strip().splitlines()[-3:] if exc else None),
+        "watchdog_alerts": alerts,
+        "dropped_span_events": spans.get("droppedEvents", 0),
+        "tracer_enabled": spans.get("tracerEnabled"),
+        "num_spans": len(events),
+        "span_names": dict(sorted(span_counts.items(),
+                                  key=lambda kv: -kv[1])[:12]),
+        "last_spans": [
+            {"name": e.get("name"), "cat": e.get("cat"),
+             "dur_ms": round(e.get("dur", 0) / 1000.0, 3)
+             if e.get("ph") == "X" else None,
+             "args": e.get("args")}
+            for e in events[-span_tail:]
+        ],
+        "key_metrics": {k: metrics[k] for k in _KEY_METRICS
+                        if k in metrics},
+        "timeseries_samples": len(ts),
+        "timeseries_span_s": (round(ts[-1]["ts"] - ts[0]["ts"], 1)
+                              if len(ts) >= 2 else 0.0),
+        "integrity_problems": problems,
+    }
+
+
+def render(summary: dict) -> str:
+    """The human-readable report (one incident, terminal-width prose)."""
+    out = []
+    w = out.append
+    w("=" * 72)
+    w(f"FLIGHT RECORD  {summary['dump']}")
+    w("=" * 72)
+    if summary["integrity_problems"]:
+        w("!! DUMP DAMAGED: " + "; ".join(summary["integrity_problems"]))
+    w(f"reason:        {summary['reason']}")
+    w(f"when:          {summary['when']}   (pid {summary['pid']}, "
+      f"role {summary['role'] or '?'})")
+    w(f"config:        fingerprint {summary['config_fingerprint']}")
+    w(f"last step:     {summary['last_completed_step']}")
+    w(f"phase:         {summary['phase_at_death'] or 'unknown'} "
+      f"(active at death)")
+    if summary["exception_tail"]:
+        w("exception:")
+        for line in summary["exception_tail"]:
+            w(f"    {line}")
+    if summary["watchdog_alerts"]:
+        w(f"watchdog:      {len(summary['watchdog_alerts'])} alert(s) "
+          f"before death:")
+        for a in summary["watchdog_alerts"][-5:]:
+            t = time.strftime("%H:%M:%S", time.localtime(a.get("wall", 0)))
+            w(f"    [{t}] {a.get('rule')}: {a.get('message')}")
+    else:
+        w("watchdog:      no alerts recorded")
+    dropped = summary["dropped_span_events"]
+    w(f"span tail:     {summary['num_spans']} events"
+      + (f"  (!! ring dropped {dropped} older events — "
+         f"the timeline below is a truncated window)" if dropped else
+         "  (complete since start)"))
+    if not summary.get("tracer_enabled", True):
+        w("               (tracer was DISABLED — spans predate disabling "
+          "or are empty; run with --trace-dir for full timelines)")
+    for s in summary["last_spans"]:
+        dur = f"{s['dur_ms']:9.3f} ms" if s["dur_ms"] is not None \
+            else "   instant  "
+        args = ""
+        if s.get("args"):
+            args = "  " + json.dumps(s["args"], default=str)[:60]
+        w(f"    {dur}  {s['cat'] or '':8s} {s['name']}{args}")
+    if summary["key_metrics"]:
+        w("metrics at death:")
+        for k, v in summary["key_metrics"].items():
+            w(f"    {k:28s} {v}")
+    w(f"time series:   {summary['timeseries_samples']} samples covering "
+      f"{summary['timeseries_span_s']}s before death (timeseries.json)")
+    w("=" * 72)
+    return "\n".join(out)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="render a flight-record dump into an incident summary")
+    p.add_argument("path", help="flight-*/ dump dir, or a dir containing "
+                                "dumps (newest wins)")
+    p.add_argument("--spans", type=int, default=15,
+                   help="span-tail length in the report")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary instead")
+    args = p.parse_args()
+    dump_dir = _resolve_dump(args.path)
+    summary = summarize(dump_dir, span_tail=args.spans)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(render(summary))
+    # A damaged dump is itself an incident: nonzero exit so scripts notice.
+    if summary["integrity_problems"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,29 @@ def parse_args():
     p.add_argument("--trace-capacity", type=int, default=65536,
                    help="span ring-buffer capacity (most recent events "
                         "kept; a long-lived server never grows past it)")
+    # -- self-monitoring (dlti_tpu.telemetry.{watchdog,flightrecorder}) --
+    # A /debug/vars time-series ring + /dashboard page are always on.
+    p.add_argument("--watchdog", action="store_true",
+                   help="enable the anomaly watchdog: throughput "
+                        "collapse, gateway queue/shed buildup rules over "
+                        "the /debug/vars ring, alerting via "
+                        "dlti_watchdog_alerts_total + JSONL event log")
+    p.add_argument("--watchdog-action", default="log",
+                   choices=["log", "dump", "abort"],
+                   help="alert escalation: log only, also dump a flight "
+                        "record, or dump + abort the process (CI chaos)")
+    p.add_argument("--watchdog-queue-depth", type=int, default=64,
+                   help="queue_buildup rule threshold (gateway queue "
+                        "depth sustained 3 samples; 0 = rule off)")
+    p.add_argument("--watchdog-shed-rate", type=float, default=1.0,
+                   help="shed_buildup rule threshold (gateway "
+                        "sheds+rejections per second; 0 = rule off)")
+    p.add_argument("--flight-dir", default="",
+                   help="enable the flight recorder: on engine fault, "
+                        "replica death, SIGTERM, or watchdog escalation, "
+                        "dump a flight-*/ black box (span tail, metrics, "
+                        "time-series tail) here; render with "
+                        "scripts/postmortem.py")
     return p.parse_args()
 
 
@@ -233,15 +256,33 @@ def main() -> None:
             drain_grace_s=args.drain_grace,
             max_retries=args.max_retries,
             fault_inject_step=args.fault_inject_step)
+    from dlti_tpu.config import (
+        FlightRecorderConfig, TelemetryConfig, WatchdogConfig,
+    )
+
+    tel_cfg = TelemetryConfig(
+        trace_dir=args.trace_dir,
+        trace_capacity=args.trace_capacity,
+        watchdog=WatchdogConfig(
+            enabled=args.watchdog,
+            action=args.watchdog_action,
+            queue_depth_limit=args.watchdog_queue_depth,
+            shed_rate_limit=args.watchdog_shed_rate,
+            alert_log_path=(os.path.join(args.flight_dir,
+                                         "watchdog_alerts.jsonl")
+                            if args.flight_dir else "")),
+        flight_recorder=FlightRecorderConfig(dir=args.flight_dir))
     sc = ServerConfig(host=args.host, port=args.port,
                       default_params=SamplingParams(max_tokens=args.max_tokens_default),
-                      gateway=gw_cfg)
+                      gateway=gw_cfg, telemetry=tel_cfg)
     print("pre-compiling decode programs (single-step + multi-step ladder)...")
     t0 = time.time()
     engine.warmup_decode_ladder()
     print(f"decode programs ready in {time.time() - t0:.0f}s")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
+    print(f"live dashboard: http://{args.host}:{args.port}/dashboard  "
+          f"(JSON: /debug/vars; profiler: POST /debug/profile)")
     try:
         serve(engine, tok, sc)
     finally:

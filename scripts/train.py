@@ -178,6 +178,25 @@ def parse_args():
     p.add_argument("--heartbeat-interval", type=int, default=0,
                    help="multi-host heartbeat cadence in steps (rank 0 "
                         "logs straggler lag; 0 = off)")
+    # Self-monitoring (dlti_tpu.telemetry.{watchdog,flightrecorder}).
+    p.add_argument("--watchdog", action="store_true",
+                   help="enable the anomaly watchdog: hung-step deadline "
+                        "(k x rolling-median step time), throughput "
+                        "collapse, heartbeat staleness, checkpoint retry "
+                        "storms — alerts via "
+                        "dlti_watchdog_alerts_total{rule=} + JSONL log")
+    p.add_argument("--watchdog-action", default="log",
+                   choices=["log", "dump", "abort"],
+                   help="alert escalation: log only, also dump a flight "
+                        "record, or dump + abort the run (CI chaos)")
+    p.add_argument("--watchdog-hung-step-min", type=float, default=30.0,
+                   help="hung-step deadline floor in seconds (the rule "
+                        "fires past max(this, factor x median step time))")
+    p.add_argument("--flight-dir", default="",
+                   help="enable the flight recorder: fatal exceptions, "
+                        "preemption stops, chaos faults (even N:kill), "
+                        "and watchdog escalations dump a flight-*/ black "
+                        "box here; render with scripts/postmortem.py")
     return p.parse_args()
 
 
@@ -214,8 +233,9 @@ def build_config(args):
     import jax
 
     from dlti_tpu.config import (
-        CheckpointConfig, DataConfig, LoRAConfig, OptimizerConfig,
-        TelemetryConfig, TrainConfig, ZeROStage, preset,
+        CheckpointConfig, DataConfig, FlightRecorderConfig, LoRAConfig,
+        OptimizerConfig, TelemetryConfig, TrainConfig, WatchdogConfig,
+        ZeROStage, preset,
     )
 
     cfg = preset(args.preset, model=args.model)
@@ -333,7 +353,16 @@ def build_config(args):
             trace_dir=args.trace_dir,
             trace_capacity=args.trace_capacity,
             step_log_path=args.step_log,
-            heartbeat_interval_steps=args.heartbeat_interval),
+            heartbeat_interval_steps=args.heartbeat_interval,
+            watchdog=WatchdogConfig(
+                enabled=args.watchdog,
+                action=args.watchdog_action,
+                hung_step_min_s=args.watchdog_hung_step_min,
+                heartbeat_stale_s=(600.0 if args.heartbeat_interval else 0.0),
+                alert_log_path=(os.path.join(args.flight_dir,
+                                             "watchdog_alerts.jsonl")
+                                if args.flight_dir else "")),
+            flight_recorder=FlightRecorderConfig(dir=args.flight_dir)),
         experiment_name=create_experiment_name(
             par.num_devices, int(par.zero_stage)),
     )

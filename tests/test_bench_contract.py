@@ -154,6 +154,41 @@ def test_ckpt_metric_names_are_schema_stable():
     assert store.last_verified_step.name == CKPT_METRIC_NAMES[4]
 
 
+def test_watchdog_and_flight_metric_names_are_schema_stable():
+    """Self-monitoring telemetry names are a scrape contract like the
+    gateway/prefetch/ckpt sets: the watchdog's per-rule alert counter,
+    the flight recorder's dump counter, and the tracer's ring-eviction
+    counter exposed by the server registry."""
+    from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
+    from dlti_tpu.telemetry import flightrecorder, watchdog
+
+    assert WATCHDOG_METRIC_NAMES == ("dlti_watchdog_alerts_total",)
+    assert FLIGHT_METRIC_NAMES == ("dlti_flight_dumps_total",)
+    assert watchdog.alerts_total.name == WATCHDOG_METRIC_NAMES[0]
+    assert flightrecorder.dumps_total.name == FLIGHT_METRIC_NAMES[0]
+    # The watchdog rule set is part of the alert-counter label contract
+    # (dashboards filter by rule=...).
+    assert watchdog.RULES == (
+        "hung_step", "throughput_collapse", "queue_buildup",
+        "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
+    )
+
+
+def test_debug_vars_and_dump_surface_contract():
+    """Keys consumers parse: the /debug/vars envelope (loadgen end-of-run
+    scrape, the dashboard page) and the flight-dump file set
+    (scripts/postmortem.py)."""
+    from dlti_tpu.telemetry import TimeSeriesSampler
+    from dlti_tpu.telemetry.flightrecorder import DUMP_FILES, MANIFEST
+
+    snap = TimeSeriesSampler().snapshot()
+    assert {"now", "interval_s", "capacity", "num_samples",
+            "source_errors", "latest", "samples"} <= set(snap)
+    assert DUMP_FILES == ("context.json", "spans.json", "metrics.json",
+                          "timeseries.json", "config.json")
+    assert MANIFEST == "MANIFEST.json"
+
+
 def test_load_report_schema_includes_gateway_fields():
     """scripts/benchmark_serving.py consumers parse the report JSON by
     key; the multi-tenant/priority additions are part of that schema."""
@@ -170,6 +205,9 @@ def test_load_report_schema_includes_gateway_fields():
         "tpot_mean_ms", "errors", "server_histograms",
         # Gateway-era additions: shed accounting + per-class breakdown.
         "num_shed", "shed_rate", "per_class",
+        # Watchdog-era additions: the server's own anomaly verdict from
+        # the end-of-run /debug/vars scrape.
+        "watchdog_alerts", "peak_queue_depth",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -1,0 +1,578 @@
+"""Flight recorder, anomaly watchdog, and time-series ring tests (tier-1).
+
+The self-monitoring contracts:
+
+* **Sampler** — bounded ring, nested-dict flattening, counter→rate
+  derivation, broken sources counted not fatal.
+* **Watchdog rules** — each rule fires on synthetic ring data, exactly
+  once per condition episode (edge-triggered), re-arming when the
+  condition clears; hung-step detection trips on a stalled fake trainer
+  within the configured deadline and increments
+  ``dlti_watchdog_alerts_total{rule="hung_step"}``.
+* **Flight recorder** — a dump is an atomically-visible, digest-verified
+  directory carrying span tail (with the ring's dropped-event count),
+  metrics, time-series tail, and live context; rotation and throttling
+  hold; a chaos-injected trainer fault leaves a dump whose context names
+  the last completed step and the phase at death; the postmortem CLI
+  round-trips it.
+* **Server surface** — ``GET /debug/vars`` serves the ring, ``GET
+  /dashboard`` serves the self-contained page, ``POST /debug/profile``
+  captures once and 409s a concurrent capture, and an engine step fault
+  dumps a flight record.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlti_tpu.config import (
+    CheckpointConfig, Config, DataConfig, FlightRecorderConfig, LoRAConfig,
+    MODEL_PRESETS, TelemetryConfig, TrainConfig, WatchdogConfig,
+)
+from dlti_tpu.telemetry import (
+    AnomalyWatchdog, FlightRecorder, SpanTracer, TimeSeriesSampler,
+    configure_tracer, get_tracer,
+)
+from dlti_tpu.telemetry.flightrecorder import (
+    list_dumps, load_dump, verify_dump,
+)
+from dlti_tpu.telemetry.watchdog import alerts_total
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+def _alert_count(rule: str) -> float:
+    return alerts_total.labels(rule=rule).value
+
+
+# ----------------------------------------------------------------------
+# Time-series sampler
+# ----------------------------------------------------------------------
+
+def test_sampler_ring_bounded_and_flattened():
+    s = TimeSeriesSampler(interval_s=0.1, capacity=5)
+    vals = {"x": 0}
+    s.add_source(lambda: {"x": vals["x"],
+                          "hist": {"count": 2, "mean": 0.25},
+                          "skip": "text", "flag": True})
+    for i in range(9):
+        vals["x"] = i
+        s.sample_now()
+    assert len(s) == 5  # ring bound
+    latest = s.latest()["values"]
+    assert latest == {"x": 8.0, "hist.count": 2.0, "hist.mean": 0.25}
+    assert [v for _, v in s.series("x")] == [4.0, 5.0, 6.0, 7.0, 8.0]
+    snap = s.snapshot(tail=2)
+    assert snap["num_samples"] == 2 and snap["latest"]["x"] == 8.0
+
+
+def test_sampler_rate_and_broken_source():
+    s = TimeSeriesSampler(interval_s=0.1, capacity=16)
+    state = {"c": 0.0, "t": 100.0}
+    s.add_source(lambda: {"c": state["c"]})
+    s.add_source(lambda: 1 / 0)  # broken source must not kill sampling
+    for _ in range(4):
+        s.sample_now()
+        state["c"] += 10.0
+        time.sleep(0.01)
+    assert s.source_errors == 4
+    r = s.rate("c")
+    assert r is not None and r > 0
+    # Counter reset (process restart) clamps to 0, never negative.
+    state["c"] = 0.0
+    s.sample_now()
+    assert s.rate("c") == 0.0
+    assert s.peak("c") == 30.0
+
+
+# ----------------------------------------------------------------------
+# Watchdog rules on synthetic ring data
+# ----------------------------------------------------------------------
+
+def _watchdog(sampler, tracer=None, heartbeat=None, clock=None, **over):
+    kw = dict(enabled=True, interval_s=0.05, hung_step_min_s=30.0)
+    kw.update(over)
+    return AnomalyWatchdog(
+        WatchdogConfig(**kw), sampler, heartbeat=heartbeat,
+        # NB `tracer or ...` would misfire: an empty SpanTracer is falsy
+        # (it defines __len__).
+        tracer=tracer if tracer is not None else SpanTracer(enabled=False),
+        clock=clock or time.monotonic)
+
+
+def test_throughput_collapse_fires_once_and_rearms():
+    s = TimeSeriesSampler(capacity=32)
+    state = {"tps": 100.0}
+    s.add_source(lambda: {"train_tokens_per_s": state["tps"]})
+    wd = _watchdog(s, throughput_min_samples=5, throughput_floor_frac=0.25)
+    for _ in range(6):
+        s.sample_now()
+    assert wd.check_now() == []  # healthy: no alert
+    state["tps"] = 5.0  # < 0.25 x median(100)
+    s.sample_now()
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["throughput_collapse"]
+    assert wd.check_now() == []  # edge-triggered: same episode, one alert
+    state["tps"] = 100.0  # recovery re-arms ...
+    s.sample_now()
+    assert wd.check_now() == []
+    state["tps"] = 3.0    # ... so a second collapse fires again
+    s.sample_now()
+    assert [a["rule"] for a in wd.check_now()] == ["throughput_collapse"]
+    assert wd.alert_counts() == {"throughput_collapse": 2}
+
+
+def test_queue_and_shed_buildup_rules():
+    s = TimeSeriesSampler(capacity=32)
+    state = {"depth": 0.0, "shed": 0.0}
+    s.add_source(lambda: {"gateway_queue_depth": state["depth"],
+                          "dlti_gateway_shed_total": state["shed"]})
+    wd = _watchdog(s, queue_depth_limit=8, shed_rate_limit=2.0)
+    for depth in (2, 9, 9):  # only 2 consecutive samples at/over the limit
+        state["depth"] = depth
+        s.sample_now()
+    assert wd.check_now() == []
+    state["depth"] = 10
+    s.sample_now()  # third consecutive sample over the limit
+    rules = [a["rule"] for a in wd.check_now()]
+    assert rules == ["queue_buildup"]
+    # Shed counter jumping across samples -> rate over the limit.
+    state["shed"] = 500.0
+    s.sample_now()
+    rules = [a["rule"] for a in wd.check_now()]
+    assert rules == ["shed_buildup"]
+
+
+def test_ckpt_retry_storm_rule():
+    s = TimeSeriesSampler(capacity=32)
+    state = {"r": 0.0}
+    s.add_source(lambda: {"ckpt_save_retries": state["r"]})
+    wd = _watchdog(s, ckpt_retry_limit=3)
+    s.sample_now()
+    state["r"] = 1.0
+    s.sample_now()
+    assert wd.check_now() == []  # 1 retry: below the storm threshold
+    state["r"] = 5.0
+    s.sample_now()
+    assert [a["rule"] for a in wd.check_now()] == ["ckpt_retry_storm"]
+
+
+def test_heartbeat_stale_rule():
+    class FakeHeartbeat:
+        last_seen = {0: (10, time.time()), 1: (7, time.time() - 120.0)}
+
+    wd = _watchdog(TimeSeriesSampler(), heartbeat=FakeHeartbeat(),
+                   heartbeat_stale_s=60.0)
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["heartbeat_stale"]
+    assert "proc 1" in fired[0]["message"]
+
+
+def test_hung_step_on_stalled_fake_trainer(tmp_path):
+    """A trainer that completes steps then stalls trips hung_step within
+    the deadline (k x rolling-median step time, floored), increments the
+    pinned counter, writes the JSONL event log, and emits a tracer
+    instant. New progress re-arms the rule."""
+    now = [0.0]
+    tr = SpanTracer(capacity=64, enabled=True)
+    log = tmp_path / "alerts.jsonl"
+    wd = _watchdog(TimeSeriesSampler(), tracer=tr, clock=lambda: now[0],
+                   hung_step_min_s=1.0, hung_step_factor=10.0,
+                   alert_log_path=str(log))
+    before = _alert_count("hung_step")
+    for step in range(1, 5):  # steps 0.1s apart -> median 0.1s
+        now[0] += 0.1
+        wd.notify_step(step)
+    assert wd.check_now() == []  # just stepped: healthy
+    assert wd.hung_step_deadline_s() == pytest.approx(1.0)  # floor wins
+    now[0] += 1.5  # stall past the deadline
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["hung_step"]
+    assert fired[0]["last_step"] == 4
+    assert _alert_count("hung_step") == before + 1
+    assert wd.check_now() == []  # one alert per hang episode
+    rows = [json.loads(l) for l in open(log)]
+    assert rows[-1]["rule"] == "hung_step"
+    assert any(e["name"] == "watchdog/alert" for e in tr.events())
+    # Progress re-arms; a second stall fires a second alert.
+    now[0] += 0.1
+    wd.notify_step(5)
+    assert wd.check_now() == []
+    now[0] += 2.0
+    assert [a["rule"] for a in wd.check_now()] == ["hung_step"]
+    assert _alert_count("hung_step") == before + 2
+
+
+def test_dump_escalation_invokes_flight_dump():
+    calls = []
+    s = TimeSeriesSampler()
+    state = {"tps": 50.0}
+    s.add_source(lambda: {"train_tokens_per_s": state["tps"]})
+    wd = _watchdog(s, action="dump", throughput_min_samples=3,
+                   throughput_floor_frac=0.5)
+    wd._on_dump = calls.append
+    for _ in range(4):
+        s.sample_now()
+    state["tps"] = 1.0
+    s.sample_now()
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["throughput_collapse"]
+    assert len(calls) == 1 and calls[0]["rule"] == "throughput_collapse"
+
+
+# ----------------------------------------------------------------------
+# Flight recorder dumps
+# ----------------------------------------------------------------------
+
+def test_dump_complete_verified_and_rotated(tmp_path):
+    tr = SpanTracer(capacity=4, enabled=True)
+    for i in range(9):  # overflow the ring: droppedEvents must report 5
+        tr.instant(f"e{i}")
+    s = TimeSeriesSampler(capacity=8)
+    s.add_source(lambda: {"v": 1.0})
+    s.sample_now()
+    cfg = Config()
+    rec = FlightRecorder(str(tmp_path / "fr"), tracer=tr, sampler=s,
+                         config=cfg, keep=2, min_interval_s=0.0)
+    rec.add_metrics_source(lambda: {"m": 7})
+    rec.note(phase="decode", step=41)
+    rec.note(step=42, last_completed_step=42)
+    paths = [rec.dump(reason=f"test_{i}") for i in range(3)]
+    assert all(p is not None for p in paths)
+    dumps = list_dumps(str(tmp_path / "fr"))
+    assert len(dumps) == 2  # keep=2 rotated the oldest away
+    assert verify_dump(dumps[-1]) == []
+    data = load_dump(dumps[-1])
+    ctx = data["context.json"]
+    assert ctx["reason"] == "test_2"
+    assert ctx["context"]["phase"] == "decode"  # later note kept earlier key
+    assert ctx["context"]["step"] == 42
+    assert ctx["config_fingerprint"]
+    spans = data["spans.json"]
+    assert spans["droppedEvents"] == 5
+    assert [e["name"] for e in spans["traceEvents"]] == \
+        ["e5", "e6", "e7", "e8"]
+    assert data["metrics.json"]["m"] == 7
+    assert data["timeseries.json"]["samples"][0]["values"] == {"v": 1.0}
+    assert data["config.json"]["train"]["seed"] == cfg.train.seed
+    # Dump-dir naming carries the step (flight-step<NNN>).
+    assert os.path.basename(dumps[-1]).startswith("flight-step00000042")
+
+
+def test_dump_throttles_but_force_wins(tmp_path):
+    rec = FlightRecorder(str(tmp_path), tracer=SpanTracer(),
+                         min_interval_s=60.0)
+    assert rec.dump(reason="first") is not None
+    assert rec.dump(reason="second") is None         # throttled
+    assert rec.dump(reason="third", force=True) is not None
+    # A damaged dump is detected (self-announcing forensics).
+    target = list_dumps(str(tmp_path))[-1]
+    with open(os.path.join(target, "metrics.json"), "a") as f:
+        f.write(" ")
+    assert any("metrics.json" in p for p in verify_dump(target))
+
+
+def test_dump_never_raises(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "nope"), tracer=SpanTracer())
+    rec.add_metrics_source(lambda: 1 / 0)  # broken source: counted, not fatal
+    path = rec.dump(reason="broken_source")
+    assert path is not None
+    assert load_dump(path)["metrics.json"]["metrics_source_errors"] == 1
+    # Unwritable directory: dump returns None instead of masking a fault.
+    rec2 = FlightRecorder("/proc/definitely-not-writable/x",
+                          tracer=SpanTracer())
+    assert rec2.dump(reason="nowhere") is None
+
+
+# ----------------------------------------------------------------------
+# Chaos-fault dump through the real Trainer + postmortem round trip
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def chaos_dump_dir(tmp_path_factory):
+    """Tiny training run with the flight recorder + watchdog on, killed by
+    the chaos injector (raise mode — in-process; the kill mode's SIGKILL
+    drill lives in the slow subprocess test below)."""
+    from dlti_tpu.training import Trainer
+    from dlti_tpu.training.chaos import TrainFault
+
+    tmp = tmp_path_factory.mktemp("flight")
+    cfg = Config(
+        model=CFG, lora=LoRAConfig(enabled=False),
+        data=DataConfig(max_seq_len=16),
+        checkpoint=CheckpointConfig(save_strategy="no"),
+        train=TrainConfig(num_epochs=1, micro_batch_size=2,
+                          grad_accum_steps=1, max_steps=4, logging_steps=100,
+                          fault_inject_step="2:raise"),
+        telemetry=TelemetryConfig(
+            watchdog=WatchdogConfig(enabled=True, interval_s=0.05),
+            flight_recorder=FlightRecorderConfig(dir=str(tmp))),
+    )
+    rng = np.random.default_rng(0)
+    ids = [rng.integers(1, 500, (1, 2, 16), dtype=np.int32)
+           for _ in range(5)]
+    batches = [{"input_ids": a, "labels": a} for a in ids]
+    try:
+        with pytest.raises(TrainFault):
+            Trainer(cfg).train(batches_per_epoch=batches)
+    finally:
+        configure_tracer(enabled=False)
+        get_tracer().clear()
+    return str(tmp)
+
+
+def test_chaos_fault_leaves_complete_dump(chaos_dump_dir):
+    dumps = list_dumps(chaos_dump_dir)
+    assert len(dumps) == 1, dumps  # one incident, one dump (throttled)
+    assert verify_dump(dumps[0]) == []
+    data = load_dump(dumps[0])
+    ctx = data["context.json"]
+    assert ctx["reason"] == "chaos_raise"
+    assert ctx["context"]["last_completed_step"] == 2
+    assert ctx["context"]["phase"]
+    assert ctx["context"]["role"] == "training"
+    assert ctx["injected_at_step"] == 2
+    # The span tail captured the real step phases (tracer force-enabled
+    # by the recorder even without --trace-dir).
+    names = {e["name"] for e in data["spans.json"]["traceEvents"]}
+    assert {"train/batch_fetch", "train/step_dispatch",
+            "train/device_sync"} <= names
+    # Metrics + time series rode along.
+    assert data["metrics.json"]["train_step"] == 2
+    assert data["timeseries.json"]["samples"]
+
+
+def test_postmortem_cli_round_trips_dump(chaos_dump_dir):
+    dumps = list_dumps(chaos_dump_dir)
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         chaos_dump_dir],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert r.returncode == 0, r.stderr[-1000:]
+    out = r.stdout
+    assert os.path.basename(dumps[0]) in out
+    assert "chaos_raise" in out
+    assert "last step:     2" in out
+    assert "phase:" in out and "active at death" in out
+    # Machine-readable mode parses and names the same facts.
+    rj = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         dumps[0], "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert rj.returncode == 0, rj.stderr[-1000:]
+    summary = json.loads(rj.stdout)
+    assert summary["last_completed_step"] == 2
+    assert summary["reason"] == "chaos_raise"
+    assert summary["phase_at_death"]
+    assert summary["integrity_problems"] == []
+
+
+# ----------------------------------------------------------------------
+# Server surface: /debug/vars, /dashboard, /debug/profile, fault dump
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def monitored_server(tmp_path_factory):
+    from dlti_tpu.data.tokenizer import ByteTokenizer
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+    from dlti_tpu.serving.server import ServerConfig, make_server
+
+    tmp = tmp_path_factory.mktemp("srv")
+    model = LlamaForCausalLM(CFG, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(max_seqs=2, block_size=8, num_blocks=64,
+                      max_model_len=64, cache_dtype="float32",
+                      eos_token_id=-1)
+    engine = InferenceEngine(CFG, params, ec)
+    tel = TelemetryConfig(
+        trace_dir=str(tmp / "traces"),
+        watchdog=WatchdogConfig(enabled=True, interval_s=0.1),
+        flight_recorder=FlightRecorderConfig(dir=str(tmp / "fr")))
+    httpd, aeng = make_server(
+        engine, ByteTokenizer(),
+        ServerConfig(host="127.0.0.1", port=0,
+                     default_params=SamplingParams(max_tokens=4),
+                     telemetry=tel))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield "127.0.0.1", port, httpd, engine, str(tmp)
+    httpd.watchdog.stop()
+    httpd.sampler.stop()
+    httpd.shutdown()
+    aeng.shutdown()
+    httpd.server_close()
+    from dlti_tpu.telemetry import install_recorder
+
+    install_recorder(None)
+    configure_tracer(enabled=False)
+    get_tracer().clear()
+
+
+def _get(host, port, path, timeout=60):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    ctype = resp.getheader("Content-Type", "")
+    conn.close()
+    return resp.status, data, ctype
+
+
+def _post(host, port, path, body, timeout=120):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_debug_vars_and_dashboard(monitored_server):
+    host, port, httpd, engine, _ = monitored_server
+    _post(host, port, "/v1/completions",
+          {"prompt": "hi", "max_tokens": 3, "temperature": 0.0})
+    # The ring samples on a cadence: wait until a sample *after* the
+    # completion landed (latest can be one interval stale).
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            len(httpd.sampler) < 2
+            or (httpd.sampler.latest()["values"]
+                .get("generated_tokens", 0)) < 3):
+        time.sleep(0.05)
+    st, data, ctype = _get(host, port, "/debug/vars")
+    assert st == 200 and ctype.startswith("application/json")
+    obj = json.loads(data)
+    assert obj["num_samples"] >= 2
+    assert obj["latest"]["generated_tokens"] >= 3
+    assert "trace_dropped_events" in obj["latest"]
+    st, data, _ = _get(host, port, "/debug/vars?tail=1")
+    assert st == 200 and json.loads(data)["num_samples"] == 1
+    st, data, ctype = _get(host, port, "/dashboard")
+    assert st == 200 and ctype.startswith("text/html")
+    page = data.decode()
+    assert "/debug/vars" in page and "sparkline" in page
+    assert "dlti_watchdog_alerts_total" in page  # alert banner wiring
+
+
+def test_debug_trace_reports_dropped_events(monitored_server):
+    host, port, *_ = monitored_server
+    st, data, _ = _get(host, port, "/debug/trace")
+    assert st == 200
+    obj = json.loads(data)
+    assert "droppedEvents" in obj and "traceEvents" in obj
+
+
+def test_profile_capture_and_concurrent_409(monitored_server):
+    host, port, _, _, tmp = monitored_server
+    results = {}
+
+    def long_capture():
+        results["first"] = _post(host, port, "/debug/profile",
+                                 {"seconds": 1.5})
+
+    t = threading.Thread(target=long_capture)
+    t.start()
+    time.sleep(0.4)  # the first capture is mid-flight now
+    st, data = _post(host, port, "/debug/profile", {"seconds": 0.1})
+    assert st == 409, data
+    t.join(timeout=120)
+    st, data = results["first"]
+    assert st == 200, data
+    out = json.loads(data)
+    assert out["status"] == "ok"
+    assert os.path.isdir(out["trace_dir"])  # jax.profiler wrote here
+    assert any(os.scandir(out["trace_dir"]))
+    # Bad inputs: non-numeric and out-of-range both 400.
+    assert _post(host, port, "/debug/profile", {"seconds": "x"})[0] == 400
+    assert _post(host, port, "/debug/profile", {"seconds": 0})[0] == 400
+
+
+def test_engine_step_fault_dumps_flight_record(monitored_server):
+    from dlti_tpu.serving.sampling import SamplingParams
+
+    host, port, httpd, engine, tmp = monitored_server
+    before = len(list_dumps(os.path.join(tmp, "fr")))
+    real_step = engine.step
+
+    def flaky_step():
+        raise RuntimeError("injected device fault")
+
+    engine.step = flaky_step
+    try:
+        st, data = _post(host, port, "/v1/completions",
+                         {"prompt": "zz", "max_tokens": 4})
+        assert st == 500
+    finally:
+        engine.step = real_step
+    dumps = list_dumps(os.path.join(tmp, "fr"))
+    assert len(dumps) == before + 1
+    data = load_dump(dumps[-1])
+    assert data["context.json"]["reason"] == "engine_step_fault"
+    assert "injected device fault" in data["context.json"]["exception"]
+    assert data["context.json"]["context"]["role"] == "serving"
+    assert verify_dump(dumps[-1]) == []
+
+
+# ----------------------------------------------------------------------
+# The honest drill: scripts/train.py kills ITSELF (SIGKILL, no Python
+# teardown) and the pre-fire hook must still leave the black box.
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_kill_chaos_leaves_dump_postmortem_renders(tmp_path):
+    rng = np.random.default_rng(5)
+    with open(tmp_path / "corpus.txt", "w") as f:
+        for i in range(160):
+            words = " ".join(f"w{int(w)}" for w in rng.integers(0, 50, 6))
+            f.write(f"sample {i}: {words}\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_backend_optimization_level=0"
+    flight = tmp_path / "fr"
+    cmd = [
+        sys.executable, os.path.join(REPO, "scripts", "train.py"),
+        "--preset", "baseline", "--model", "llama_tiny",
+        "--tokenizer", "byte",
+        "--dataset-path", str(tmp_path / "corpus.txt"),
+        "--output-dir", str(tmp_path / "ckpt"),
+        "--max-seq-len", "32", "--per-device-batch-size", "2",
+        "--gradient-accumulation-steps", "1", "--lora-r", "2",
+        "--warmup-steps", "2", "--max-steps", "6", "--save-steps", "2",
+        "--logging-steps", "1000",
+        "--metrics-csv", str(tmp_path / "m.csv"),
+        "--fault-inject-step", "3:kill",
+        "--flight-dir", str(flight), "--watchdog",
+    ]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       text=True, timeout=420)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr[-2000:])
+    dumps = list_dumps(str(flight))
+    assert dumps, "SIGKILL chaos left no flight record"
+    assert verify_dump(dumps[-1]) == []
+    data = load_dump(dumps[-1])
+    assert data["context.json"]["reason"] == "chaos_kill"
+    assert data["context.json"]["context"]["last_completed_step"] == 3
+    pm = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         str(flight)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert pm.returncode == 0, pm.stderr[-1000:]
+    assert "chaos_kill" in pm.stdout
+    assert "last step:     3" in pm.stdout

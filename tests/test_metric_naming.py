@@ -1,0 +1,125 @@
+"""Static guard: every metric the package registers follows the
+``dlti_`` prefix + snake_case convention.
+
+The /metrics names are a scrape contract (test_bench_contract pins the
+known sets); this guard closes the gap for *new* names — a metric added
+anywhere in the package that breaks the convention fails here before it
+can silently break external dashboards. It walks a fully-assembled
+serving registry (engine stats + lifecycle histograms + gateway +
+heartbeat + watchdog/flight counters + the trace eviction counter) after
+importing the trainer and server modules, plus every module-level metric
+object the training side owns (checkpoint store, prefetch, watchdog,
+flight recorder).
+"""
+
+import re
+
+import pytest
+
+# Importing these modules materializes every module-level metric object
+# in the package (checkpoint store counters, watchdog/flight counters).
+import dlti_tpu.serving.server as server_mod
+import dlti_tpu.training.trainer  # noqa: F401
+
+NAME_RE = re.compile(r"^dlti_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def _assert_convention(names, where):
+    bad = [n for n in names if not NAME_RE.fullmatch(n)]
+    assert not bad, (
+        f"metric names breaking the dlti_ + snake_case convention in "
+        f"{where}: {bad} — the /metrics exposition is a scrape contract; "
+        f"rename before shipping")
+
+
+def test_pinned_name_tuples_follow_convention():
+    from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
+    from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
+    from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
+    from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
+
+    for tup, where in ((CKPT_METRIC_NAMES, "checkpoint"),
+                       (PREFETCH_METRIC_NAMES, "prefetch"),
+                       (GATEWAY_METRIC_NAMES, "gateway"),
+                       (WATCHDOG_METRIC_NAMES, "watchdog"),
+                       (FLIGHT_METRIC_NAMES, "flightrecorder")):
+        _assert_convention(tup, where)
+
+
+def test_module_level_metric_objects_follow_convention():
+    from dlti_tpu.checkpoint import store
+    from dlti_tpu.telemetry import flightrecorder, watchdog
+
+    objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
+            store.save_retries, store.last_verified_step,
+            watchdog.alerts_total, flightrecorder.dumps_total)
+    _assert_convention([m.name for m in objs], "module-level metrics")
+
+
+@pytest.fixture()
+def full_registry():
+    """A registry assembled the way a real gateway'd server assembles it,
+    without paying for a real engine: a stats-shaped fake behind
+    build_registry, then the gateway's counters and scalar source, the
+    heartbeat gauge, and the prefetcher's metrics registered on top."""
+    from dlti_tpu.config import GatewayConfig
+    from dlti_tpu.serving.gateway import AdmissionGateway
+    from dlti_tpu.telemetry import Heartbeat, RequestTelemetry, SpanTracer
+
+    class FakeEngine:
+        stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
+                 "preemptions": 0, "decode_steps": 0, "decode_slot_steps": 0,
+                 "prefix_cached_tokens": 0, "spec_proposed": 0,
+                 "spec_accepted": 0, "spec_paused_rounds": 0,
+                 "decode_state_uploads": 0, "decode_state_rows": 0,
+                 "decode_state_clean_syncs": 0}
+        telemetry = RequestTelemetry(tracer=SpanTracer(enabled=False))
+        waiting: list = []
+        num_active = 0
+        num_free_blocks = 0
+
+        class cfg:
+            max_seqs = 4
+
+    class FakeAsync:
+        engine = FakeEngine()
+
+    registry = server_mod.build_registry(FakeAsync())
+    gw = AdmissionGateway(FakeAsync(), GatewayConfig(enabled=True), registry)
+    try:
+        Heartbeat(registry=registry)
+        from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
+
+        for name in PREFETCH_METRIC_NAMES:
+            registry.gauge(name) if name.endswith("depth") \
+                else registry.histogram(name)
+        yield registry
+    finally:
+        gw.shutdown()
+
+
+def test_every_registered_metric_follows_convention(full_registry):
+    names = full_registry.metric_names()
+    # The walk actually covered the full surface (engine scalars, request
+    # histograms, gateway, heartbeat, watchdog/flight, trace eviction) —
+    # an empty or partial registry would vacuously pass.
+    for expected in ("dlti_requests", "dlti_request_ttft_seconds",
+                     "dlti_gateway_queue_depth",
+                     "dlti_gateway_admitted_total",
+                     "dlti_heartbeat_last_step",
+                     "dlti_watchdog_alerts_total",
+                     "dlti_flight_dumps_total",
+                     "dlti_trace_dropped_events",
+                     "dlti_train_prefetch_queue_depth"):
+        assert expected in names, f"walk missed {expected}: {names}"
+    _assert_convention(names, "assembled serving registry")
+
+
+def test_convention_guard_actually_rejects():
+    """The regex does its job: names the convention forbids fail it."""
+    for bad in ("requests", "dlti_CamelCase", "dlti_", "dlti__double",
+                "dlti_trailing_", "vllm_requests", "dlti_has-dash"):
+        assert not NAME_RE.fullmatch(bad), bad
+    for good in ("dlti_requests", "dlti_gateway_queue_depth",
+                 "dlti_request_ttft_seconds", "dlti_ckpt_last_verified_step"):
+        assert NAME_RE.fullmatch(good), good
