@@ -124,18 +124,32 @@ def _leaf_entries(state: Any) -> Tuple[List[dict], List[bytes]]:
     """Snapshot every array leaf to host bytes NOW (the caller may donate
     the device buffers to the next step immediately after)."""
     leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(state)
+    # Multi-host: consolidate every cross-process leaf to a full host
+    # array in ONE jitted replicate launch (collective — every process
+    # participates; rank 0 alone writes files). One launch, not one
+    # process_allgather per leaf: on the gloo CPU backend, dozens of
+    # tiny back-to-back cross-process launches intermittently wedge or
+    # abort ("op.preamble.length <= op.nbytes") when one rank enters
+    # launch n+1 while its peer still drains launch n's socket buffers —
+    # a single launch gives XLA one rendezvous and per-op channel ids.
+    # Consolidated checkpoints also make resume onto a different process
+    # count trivial.
+    cross = [i for i, (_, leaf) in enumerate(leaves_with_path)
+             if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable]
+    consolidated: dict = {}
+    if cross:
+        from jax.sharding import GSPMDSharding
+
+        ins = [leaves_with_path[i][1] for i in cross]
+        reps = [GSPMDSharding.get_replicated(x.sharding._device_assignment)
+                for x in ins]
+        outs = jax.jit(lambda xs: xs, out_shardings=reps)(ins)
+        for i, out in zip(cross, outs):
+            consolidated[i] = np.asarray(out.addressable_data(0))
     metas, payloads = [], []
     for i, (path, leaf) in enumerate(leaves_with_path):
-        if (isinstance(leaf, jax.Array)
-                and not leaf.is_fully_addressable):
-            # Multi-host: consolidate to a full host array (collective —
-            # every process participates; rank 0 alone writes files).
-            # Consolidated checkpoints also make resume onto a different
-            # process count trivial.
-            from jax.experimental import multihost_utils
-
-            host = np.asarray(
-                multihost_utils.process_allgather(leaf, tiled=True))
+        if i in consolidated:
+            host = consolidated[i]
         else:
             host = np.asarray(jax.device_get(leaf))
         if not host.flags["C_CONTIGUOUS"]:

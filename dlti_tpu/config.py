@@ -334,7 +334,11 @@ class TrainConfig:
     # the gateway's DLTI_GATEWAY_FAULT_INJECT. Also settable via env
     # DLTI_TRAIN_FAULT_INJECT. Chaos tests and fire drills use it to kill
     # the trainer at an exact step (or mid-async-save) and prove the
-    # verified-resume path recovers. "" = off.
+    # verified-resume path recovers. "" = off. The additional
+    # "STEP:host-kill[:RANK]" mode is SUPERVISOR-owned (the elastic
+    # launcher SIGKILLs a whole worker process from outside —
+    # dlti_tpu.training.elastic.HostKillSpec); the in-process injector
+    # ignores it.
     fault_inject_step: str = ""
 
 

@@ -9,9 +9,12 @@ right-padding to ``max_seq_len`` with a loss mask, or optional sequence
 lacks and which removes pad waste — the single biggest input-side perf lever
 on TPU.
 
-Multi-host: each host materializes only its shard (``shard_by_host``),
-indexed by ``jax.process_index()`` — the analog of the per-rank
-``DistributedSampler`` HF Trainer gives the reference implicitly.
+Multi-host: each host materializes only its slice of every global batch
+(``shard_by_host``), indexed by ``jax.process_index()`` — the analog of
+the per-rank ``DistributedSampler`` HF Trainer gives the reference
+implicitly — while the *schedule* (which rows feed which optimizer step)
+stays a pure function of (corpus, seed, global batch shape), independent
+of world size, so an elastic mesh reshape preserves it exactly.
 """
 
 from __future__ import annotations
@@ -189,11 +192,12 @@ def packed_positions(segment_ids: np.ndarray) -> np.ndarray:
 
 
 class HostShardedSchedule:
-    """Per-host sharding + seeded epoch shuffle + ``skip_steps`` resume.
+    """World-size-invariant global schedule + seeded epoch shuffle +
+    ``skip_steps`` resume, with per-host materialization.
 
     Shared by :class:`TokenBatchDataset` and
     :class:`~dlti_tpu.data.streaming.StreamingTokenDataset` so the row
-    *schedule* (shard split, epoch permutation, resume skip) cannot
+    *schedule* (epoch permutation, per-step chunking, resume skip) cannot
     desynchronize between the in-memory and disk-backed paths. Note the
     shared piece is the schedule over rows, not row construction: in packed
     mode the two paths build rows from different document orders
@@ -201,6 +205,17 @@ class HostShardedSchedule:
     writer packs in arrival order), so a packed checkpoint resumes
     byte-identically only against the same dataset kind it was trained
     with. Unpacked rows are identical either way.
+
+    The schedule is a pure function of (corpus, seed, global batch shape)
+    and NOT of the world size: one seeded *global* permutation, chunked
+    ``samples_per_step`` rows per optimizer step; host p then materializes
+    only its 1/process_count batch-column slice of each chunk. That
+    invariance is what lets elastic training reshape the mesh to a
+    surviving world and resume the exact batch schedule (with
+    :func:`~dlti_tpu.training.elastic.rescale_batch_schedule` trading
+    batch rows for grad-accum steps) — under the pre-r06 contiguous
+    range-split, a shrunk world would have silently fed different rows
+    per step.
 
     Subclasses call :meth:`_init_procs` early (fail fast, before any
     expensive row construction), then :meth:`_init_host_shard` with their
@@ -222,63 +237,64 @@ class HostShardedSchedule:
     def _init_host_shard(self, n_rows: int, shard_by_host: bool) -> None:
         if not hasattr(self, "_procs"):
             self._init_procs(shard_by_host)
-        # Equal per-host shard (every host must agree on steps_per_epoch:
-        # a ragged split would deadlock collectives on the last step).
-        per_host = n_rows // self._procs
-        self._row_range = (self._proc_id * per_host,
-                           (self._proc_id + 1) * per_host)
+        self._n_rows = n_rows
 
     @property
     def samples_per_step(self) -> int:
         """Global samples consumed per optimizer step."""
         return self.micro_batch_size * self.grad_accum_steps
 
-    @property
-    def _host_samples_per_step(self) -> int:
-        return self.samples_per_step // self._procs
-
     def steps_per_epoch(self) -> int:
-        lo, hi = self._row_range
-        chunk = self._host_samples_per_step
+        # Global chunking: every host agrees by construction (a ragged
+        # split would deadlock collectives on the last step), at any
+        # world size.
         if getattr(self, "drop_remainder", True):
-            return (hi - lo) // chunk
-        # Final partial chunk is padded up to a full step (every host's
-        # shard is the same size, so all hosts agree on the extra step).
-        return -(-(hi - lo) // chunk)
+            return self._n_rows // self.samples_per_step
+        return -(-self._n_rows // self.samples_per_step)
 
-    def _pad_step(self, fields: dict, chunk: int) -> dict:
+    def _pad_partial(self, fields: dict, present: np.ndarray) -> dict:
         """Pad a partial final step to the static step shape: pad rows are
         all ``pad_id`` tokens with an all-zero loss mask (and zero
         segment ids / positions), so they contribute nothing to the loss
-        or gradients while keeping every compiled shape identical."""
+        or gradients while keeping every compiled shape identical. Pad
+        positions are fixed in GLOBAL batch coordinates, so the padded
+        step is world-size invariant too."""
         out = {}
+        n = present.shape[0]
         for k, v in fields.items():
-            pad_rows = chunk - v.shape[0]
             fill = self.pad_id if k == "input_ids" else 0
-            pad = np.full((pad_rows,) + v.shape[1:], fill, v.dtype)
-            out[k] = np.concatenate([v, pad], axis=0)
+            full = np.full((n,) + v.shape[1:], fill, v.dtype)
+            full[present] = v
+            out[k] = full
         return out
 
     def epoch(self, epoch_idx: int = 0, skip_steps: int = 0) -> Iterator[dict]:
-        lo, hi = self._row_range
-        order = np.arange(lo, hi)
+        order = np.arange(self._n_rows)
         if self.shuffle_seed is not None:
-            # Same permutation on every host of the *local* range.
+            # One GLOBAL permutation, identical on every host.
             rng = np.random.default_rng(self.shuffle_seed + epoch_idx)
             rng.shuffle(order)
-        chunk = self._host_samples_per_step
-        bs_local = self.micro_batch_size // self._procs
+        S = self.samples_per_step
+        bs = self.micro_batch_size
+        bs_local = bs // self._procs
         shape = (self.grad_accum_steps, bs_local, self.seq_len)
         drop = getattr(self, "drop_remainder", True)
-        for step_i, start in enumerate(range(0, len(order), chunk)):
-            rows = order[start : start + chunk]
-            if len(rows) < chunk and drop:
+        # This host's positions within a step's global chunk: local batch
+        # element (a, b) is global chunk row a*bs + proc_id*bs_local + b —
+        # the slice make_global_batch reassembles along the batch dim.
+        g_idx = (np.arange(self.grad_accum_steps)[:, None] * bs
+                 + self._proc_id * bs_local
+                 + np.arange(bs_local)[None, :]).ravel()
+        for step_i, start in enumerate(range(0, self._n_rows, S)):
+            chunk = order[start:start + S]
+            if len(chunk) < S and drop:
                 break  # legacy behavior: the ragged tail is dropped
             if step_i < skip_steps:
                 continue
-            fields = self._gather(rows)
-            if len(rows) < chunk:
-                fields = self._pad_step(fields, chunk)
+            present = g_idx < len(chunk)
+            fields = self._gather(chunk[g_idx[present]])
+            if not present.all():
+                fields = self._pad_partial(fields, present)
             yield {k: v.reshape(shape) for k, v in fields.items()}
 
 

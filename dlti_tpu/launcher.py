@@ -24,6 +24,13 @@ Rendezvous env contract (the LOCAL_RANK/WORLD_SIZE/MASTER_ADDR analog):
 ``DLTI_NUM_PROCESSES``      world size
 ``DLTI_PROCESS_ID``         this process's id (0-based)
 ==========================  =================================================
+
+Elastic supervision (``--elastic``) hands off to
+:class:`dlti_tpu.training.elastic.ElasticLauncher`, which extends the
+contract with ``DLTI_GENERATION`` (the rendezvous generation),
+``DLTI_ELASTIC_DIR`` (heartbeat/event dir), and
+``DLTI_ELASTIC_NUM_SLOTS`` (the full-size world the batch schedule is
+defined against) — see that module for the recovery loop.
 """
 
 from __future__ import annotations
@@ -156,18 +163,53 @@ def maybe_initialize_from_env() -> bool:
 
     Entry points call this exactly once, before any jax backend use. Returns
     True if multi-process init ran.
+
+    The connect retries with capped exponential backoff
+    (``DLTI_CONNECT_RETRIES`` / ``DLTI_CONNECT_BACKOFF_S``, defaults 3 /
+    1.0s, cap 10s): workers race rank-0 to the rendezvous and a cold
+    coordinator — rank 0 still importing jax, or an elastic relaunch
+    whose previous generation's port is mid-teardown — must read as
+    "not up yet", not as a fatal error.
     """
     num = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
     if num <= 1:
         return False
     from dlti_tpu.parallel.mesh import initialize_multihost
 
-    initialize_multihost(
-        coordinator_address=os.environ[ENV_COORDINATOR],
-        num_processes=num,
-        process_id=int(os.environ[ENV_PROCESS_ID]),
-    )
-    return True
+    coordinator = os.environ[ENV_COORDINATOR]
+    process_id = int(os.environ[ENV_PROCESS_ID])
+    retries = int(os.environ.get("DLTI_CONNECT_RETRIES", "3"))
+    backoff = float(os.environ.get("DLTI_CONNECT_BACKOFF_S", "1.0"))
+    attempt = 0
+    while True:
+        try:
+            initialize_multihost(
+                coordinator_address=coordinator,
+                num_processes=num,
+                process_id=process_id,
+            )
+            return True
+        except Exception:
+            attempt += 1
+            if attempt > retries:
+                raise
+            import logging
+            import time
+
+            # A failed connect can leave the client half-initialized;
+            # shut it down so the retry starts clean.
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            delay = min(backoff * (2 ** (attempt - 1)), 10.0)
+            logging.getLogger("dlti").warning(
+                "jax.distributed.initialize(%s) failed (attempt %d/%d); "
+                "retrying in %.1fs", coordinator, attempt, retries + 1,
+                delay)
+            time.sleep(delay)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -183,6 +225,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "command in-place (one srun task per host)")
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
     p.add_argument("--log-dir", default=None)
+    # Elastic supervision (dlti_tpu.training.elastic.ElasticLauncher):
+    # instead of kill-all-on-first-failure, recover worker death with a
+    # restart budget, exponential backoff, and generation-numbered
+    # rendezvous — shrink the world to the survivors, resume from the
+    # last verified checkpoint, and rejoin at the next checkpoint
+    # boundary.
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers elastically (restart budget + "
+                        "backoff + reshape-on-failure + rejoin) instead "
+                        "of kill-all-on-first-failure")
+    p.add_argument("--restart-budget", type=int, default=3,
+                   help="worker-failure recoveries before giving up")
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="initial restart backoff seconds (doubles per "
+                        "restart, capped at --backoff-max)")
+    p.add_argument("--backoff-max", type=float, default=30.0)
+    p.add_argument("--heartbeat-stale-s", type=float, default=0.0,
+                   help="supervisor-side staleness deadline for per-rank "
+                        "heartbeat files (0 = exits only)")
+    p.add_argument("--startup-grace", type=float, default=60.0,
+                   help="seconds before a never-beaten worker can be "
+                        "declared stale (covers cold jax compiles)")
+    p.add_argument("--no-rejoin", action="store_true",
+                   help="do not grow back to full size at the next "
+                        "checkpoint boundary after a shrink")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint dir to watch for rejoin boundaries "
+                        "(the trainer's --output-dir)")
+    p.add_argument("--min-world", type=int, default=1,
+                   help="smallest world the supervisor may shrink to")
+    p.add_argument("--term-grace", type=float, default=10.0,
+                   help="SIGTERM->SIGKILL grace seconds in teardown")
+    p.add_argument("--elastic-dir", default=None,
+                   help="rendezvous/heartbeat dir (default: under "
+                        "--log-dir, else a temp dir)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="-- command to run")
     args = p.parse_args(argv)
@@ -196,6 +273,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         os.execvpe(cmd[0], list(cmd), env)  # never returns
     if args.num_processes <= 0:
         p.error("--num-processes N or --coordinator-from-slurm required")
+    if args.elastic:
+        from dlti_tpu.training.elastic import ElasticLauncher
+
+        return ElasticLauncher(
+            cmd, args.num_processes, port=args.port, log_dir=args.log_dir,
+            restart_budget=args.restart_budget, backoff_s=args.backoff,
+            backoff_max_s=args.backoff_max,
+            heartbeat_stale_s=args.heartbeat_stale_s,
+            startup_grace_s=args.startup_grace,
+            rejoin=not args.no_rejoin, ckpt_dir=args.ckpt_dir,
+            min_world=args.min_world, term_grace_s=args.term_grace,
+            elastic_dir=args.elastic_dir,
+        ).run()
     return launch_local(cmd, args.num_processes, port=args.port,
                         log_dir=args.log_dir)
 

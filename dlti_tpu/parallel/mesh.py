@@ -61,6 +61,34 @@ def build_mesh(cfg: ParallelConfig, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(dev_array, MESH_AXES)
 
 
+def fit_parallel_to_devices(cfg: ParallelConfig,
+                            n_devices: int) -> ParallelConfig:
+    """Shrink the batch axes (``data``/``fsdp``) of a mesh config to fit
+    ``n_devices`` — the mesh half of elastic reshape-on-failure: when a
+    worker dies and the surviving world re-rendezvouses smaller, the
+    model-parallel axes (tensor/sequence/pipe/expert) must keep their
+    extent (the sharded program depends on them) while the batch extent
+    absorbs the loss. No-op when the config already fits."""
+    import dataclasses
+
+    if cfg.num_devices <= n_devices:
+        return cfg
+    fixed = cfg.tensor * cfg.sequence * cfg.pipe * cfg.expert
+    rows = n_devices // fixed
+    if rows < 1:
+        raise ValueError(
+            f"cannot reshape mesh to {n_devices} devices: the "
+            f"model-parallel extent tensor*sequence*pipe*expert={fixed} "
+            "alone exceeds the surviving world")
+    if cfg.data > 1 and cfg.fsdp > 1:
+        raise ValueError(
+            f"cannot reshape a mixed data={cfg.data} x fsdp={cfg.fsdp} "
+            "mesh automatically; relaunch with explicit extents")
+    if cfg.fsdp > 1:
+        return dataclasses.replace(cfg, fsdp=rows)
+    return dataclasses.replace(cfg, data=rows)
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -70,6 +98,19 @@ def initialize_multihost(
     MASTER_ADDR/LOCAL_RANK env contract (``train_deepspeed_zero1.py:120-121``,
     ``train.ipynb:640-647``). With no args, JAX auto-detects cluster env
     (GKE/GCE metadata, SLURM, or MEGASCALE vars)."""
+    import os
+
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            or getattr(jax.config, "jax_platforms", None) == "cpu"):
+        # Multi-process CPU (the gloo test/dev path): this jax's CPU
+        # client builds with NO cross-process collectives by default, and
+        # every multi-process computation then fails with "Multiprocess
+        # computations aren't implemented on the CPU backend". Select the
+        # gloo TCP implementation before the backend initializes.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax without the flag: gloo was the default
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

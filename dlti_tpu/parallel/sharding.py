@@ -30,6 +30,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlti_tpu.config import Config, ZeROStage
@@ -288,13 +289,47 @@ def state_shardings(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
     )
 
 
+def place_on_mesh(x, s):
+    """Place one host-resident leaf onto a mesh sharding.
+
+    Single-process: plain ``device_put``. Multi-process: assemble the
+    global array from this process's local shards
+    (``make_array_from_callback`` — the checkpoint store's restore
+    placement) instead of ``device_put``, whose uncommitted-array path
+    broadcasts every full value through ``multihost_utils.assert_equal``
+    — hundreds of redundant gloo collectives for a replicated-init state
+    (every process computed the identical value from the same seed), and
+    on this image's CPU gloo they desynchronize and crash the pairs.
+    """
+    if not hasattr(x, "shape"):
+        return x
+    if jax.process_count() > 1:
+        host = np.asarray(x)
+        return jax.make_array_from_callback(
+            host.shape, s, lambda idx: host[idx])
+    return jax.device_put(x, s)
+
+
+def launder_transfer_created(tree):
+    """Multi-process placement products must be laundered before they can
+    be DONATED into a compiled step: on this image's CPU jaxlib, donating
+    a transfer-created array (``make_array_from_callback`` over host
+    numpy) corrupts the process heap — the same root cause the
+    checkpoint store's restore path works around (``store._launder``,
+    where the full forensics live). Single-process trees pass through
+    untouched (their leaves are executable outputs already)."""
+    if jax.process_count() <= 1:
+        return tree
+    from dlti_tpu.checkpoint.store import _launder
+
+    return _launder(tree)
+
+
 def shard_train_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
     """Place an (unsharded, host-resident) TrainState onto the mesh."""
     sh = state_shardings(state, cfg, mesh)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s) if hasattr(x, "shape") else x,
-        state, sh,
-    )
+    return launder_transfer_created(
+        jax.tree_util.tree_map(place_on_mesh, state, sh))
 
 
 def make_sharded_train_step(

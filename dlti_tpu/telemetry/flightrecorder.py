@@ -158,6 +158,17 @@ class FlightRecorder:
 
         label = (f"step{int(context['step']):08d}" if "step" in context
                  else time.strftime("%Y%m%dT%H%M%S"))
+        # Multi-process / elastic runs: tag the dump dir with the rank and
+        # rendezvous generation so concurrent per-rank dumps land in
+        # distinct directories (two ranks dying at the same step must not
+        # race one dir name) and a postmortem can line dumps up by
+        # generation (scripts/postmortem.py --all).
+        rank = os.environ.get("DLTI_PROCESS_ID")
+        gen = os.environ.get("DLTI_GENERATION")
+        if gen is not None:
+            label += f"-g{int(gen)}"
+        if rank is not None:
+            label += f"-r{int(rank)}"
         os.makedirs(self.directory, exist_ok=True)
         final = self._unique_dir(f"{_PREFIX}{label}")
         tmp = os.path.join(self.directory,
@@ -171,6 +182,8 @@ class FlightRecorder:
                 "wall_time": time.time(),
                 "iso_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
                 "pid": os.getpid(),
+                "process_id": int(rank) if rank is not None else None,
+                "generation": int(gen) if gen is not None else None,
                 "config_fingerprint": config_fingerprint(self.config),
                 "exception": ("".join(traceback.format_exception(
                     type(exc), exc, exc.__traceback__)).rstrip()

@@ -292,6 +292,18 @@ class AnomalyWatchdog:
                     f.write(json.dumps(alert) + "\n")
             except OSError:
                 self.logger.exception("watchdog alert log write failed")
+        try:
+            # Elastic supervision: mirror the alert into the supervisor's
+            # rendezvous dir (no-op outside an elastic launch). Rank 0's
+            # heartbeat_stale alerts name the straggling processes — the
+            # supervisor turns that aggregated view into a TARGETED kill
+            # + mesh reshape instead of this process's own whole-job
+            # log/dump/abort ladder.
+            from dlti_tpu.training.elastic import mirror_alert
+
+            mirror_alert(alert)
+        except Exception:
+            pass
         self._escalate(alert)
         return alert
 

@@ -17,4 +17,8 @@ def __getattr__(name):
         from dlti_tpu.training.trainer import Trainer
 
         return Trainer
+    if name == "ElasticLauncher":
+        from dlti_tpu.training.elastic import ElasticLauncher
+
+        return ElasticLauncher
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

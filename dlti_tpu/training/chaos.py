@@ -23,6 +23,12 @@ where MODE is one of
                   writer thread dies mid-write, leaving the torn
                   ``.tmp-*`` staging dir the verified-resume scan must
                   quarantine.
+* ``host-kill`` — SUPERVISOR-owned (``STEP:host-kill[:RANK]``): the
+                  elastic launcher SIGKILLs an entire worker process
+                  from outside once heartbeats reach STEP — the
+                  whole-host death drill. The in-process injector
+                  ignores it (``from_spec`` returns None), so the env
+                  var can ride the launch env down to every worker.
 """
 
 from __future__ import annotations
@@ -70,6 +76,11 @@ class TrainFaultInjector:
         if not spec:
             return None
         step_s, _, mode = spec.partition(":")
+        if mode.partition(":")[0] == "host-kill":
+            # Supervisor-side whole-host chaos
+            # (dlti_tpu.training.elastic.HostKillSpec): not an in-process
+            # fault — every worker sees the env var and must ignore it.
+            return None
         try:
             step = int(step_s)
         except ValueError:

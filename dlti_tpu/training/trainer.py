@@ -274,14 +274,24 @@ class Trainer:
                                                 memory_kind=kind),
                         frozen_sh)
                     param_sh = combine_params(trainable_sh, frozen_sh)
-            state = state.replace(
-                params=jax.device_put(state.params, param_sh),
-                opt_state=jax.device_put(
-                    state.opt_state,
+            from dlti_tpu.parallel.sharding import (
+                launder_transfer_created, place_on_mesh,
+            )
+
+            # place_on_mesh, not device_put: multi-process placement of a
+            # replicated-init state assembles local shards instead of
+            # broadcasting every value; the launder makes the products
+            # safe to donate (see sharding.place_on_mesh /
+            # launder_transfer_created).
+            state = launder_transfer_created(state.replace(
+                params=jax.tree_util.tree_map(
+                    place_on_mesh, state.params, param_sh),
+                opt_state=jax.tree_util.tree_map(
+                    place_on_mesh, state.opt_state,
                     opt_state_shardings(state.opt_state, self.cfg,
                                         self.mesh)),
-                step=jax.device_put(state.step, repl),
-            )
+                step=place_on_mesh(state.step, repl),
+            ))
         elif self.mesh is not None:
             state = shard_train_state(state, self.cfg, self.mesh)
         return state
@@ -476,6 +486,21 @@ class Trainer:
         watchdog = None
         flight = None
         self._live = {"train_step": start_step}
+
+        # Elastic supervision (dlti_tpu.training.elastic): when launched
+        # by the ElasticLauncher, report per-step liveness via heartbeat
+        # files (the supervisor's staleness + chaos-trigger input) and
+        # expose the generation/world gauges.
+        from dlti_tpu.training import elastic as _elastic
+
+        einfo = _elastic.elastic_info()
+        if einfo is not None:
+            _elastic.generation_gauge.set(einfo["generation"])
+            _elastic.world_size_gauge.set(jax.process_count())
+            self._live["elastic_generation"] = einfo["generation"]
+            self._live["elastic_world_size"] = jax.process_count()
+            self._live["elastic_restarts"] = _elastic.restarts_total.value
+            _elastic.beat(start_step)  # liveness before the first step
 
         def _train_scalars():
             from dlti_tpu.checkpoint.store import (
@@ -829,6 +854,11 @@ class Trainer:
                 self._live["train_loss"] = losses[-1]
             if watchdog is not None:
                 watchdog.notify_step(global_step)
+            if einfo is not None:
+                # Per-step liveness file for the elastic supervisor
+                # (independent per process — unlike the collective
+                # Heartbeat below, it keeps reporting when a peer dies).
+                _elastic.beat(global_step)
             fnote(step=global_step, last_completed_step=global_step,
                   phase="between_steps")
             if heartbeat is not None and (
@@ -963,15 +993,18 @@ class Trainer:
                     break
             if self._stop_requested and cfg.checkpoint.save_strategy != "no":
                 from dlti_tpu.checkpoint import (
-                    latest_step, save_train_state, wait_for_saves)
+                    save_train_state, wait_for_saves)
 
                 # _maybe_save may have just written this very step (e.g. the
                 # stop landed on a save_steps boundary or at epoch end);
-                # settle any in-flight async save before checking (the
-                # store makes duplicate saves idempotent, but a redundant
-                # synchronous write is still wasted I/O).
+                # settle any in-flight async save first. The already-saved
+                # check is the trainer's own marker, NOT latest_step(): a
+                # filesystem probe races the (rank-0-only) async writer on
+                # multi-process meshes, and a rank-dependent answer would
+                # send only some ranks into the collective consolidation
+                # below — a deadlock, not a redundant write.
                 wait_for_saves(cfg.checkpoint.output_dir)
-                if latest_step(cfg.checkpoint.output_dir) != global_step:
+                if getattr(self, "_last_save_step", None) != global_step:
                     save_train_state(
                         cfg.checkpoint.output_dir, global_step, state,
                         keep=cfg.checkpoint.save_total_limit,
@@ -1096,6 +1129,14 @@ class Trainer:
         )
         if not due:
             return
+        if getattr(self, "_last_save_step", None) == step:
+            # Already saved this step (a save_steps boundary that is also
+            # the epoch end books two due saves). The store dedups the
+            # *write*, but on a multi-process mesh the state consolidation
+            # is a collective launch — skip it symmetrically on every
+            # rank, not just where the writer lives.
+            return
+        self._last_save_step = step
         from dlti_tpu.checkpoint import save_train_state
 
         self._fnote(phase="checkpoint_save")

@@ -14,6 +14,8 @@ Usage:
     python scripts/postmortem.py runs/flightrecords/flight-step00000042
     python scripts/postmortem.py ... --spans 30                # longer tail
     python scripts/postmortem.py ... --json                    # machine-readable
+    python scripts/postmortem.py runs/flightrecords --all      # elastic job:
+        # one incident summary across every per-rank flight-*-gG-rR dump
 """
 
 from __future__ import annotations
@@ -86,7 +88,10 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "dump": dump_dir,
         "reason": ctx_file.get("reason"),
         "when": ctx_file.get("iso_time"),
+        "wall": ctx_file.get("wall_time"),
         "pid": ctx_file.get("pid"),
+        "process_id": ctx_file.get("process_id"),
+        "generation": ctx_file.get("generation"),
         "role": context.get("role"),
         "config_fingerprint": ctx_file.get("config_fingerprint"),
         "last_completed_step": context.get("last_completed_step",
@@ -115,6 +120,61 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
     }
 
 
+def summarize_incident(dump_dirs: list, span_tail: int = 15) -> dict:
+    """One incident summary over a *directory of per-rank dumps* (an
+    elastic / multi-process job writes one black box per dying rank,
+    tagged with ``process_id`` + ``generation``): per-dump digest lines
+    grouped by generation, plus the full summary of the root-cause dump
+    (the earliest non-preemption death — preemption stops are the
+    supervisor's own drains, consequences rather than causes)."""
+    dumps = [summarize(d, span_tail=span_tail) for d in dump_dirs]
+    dumps.sort(key=lambda s: (s.get("wall") or 0.0))
+    failures = [s for s in dumps
+                if s.get("reason") not in ("preemption_stop",)]
+    root = (failures or dumps)[0] if dumps else None
+    by_gen: dict = {}
+    for s in dumps:
+        by_gen.setdefault(s.get("generation"), []).append({
+            "dump": os.path.basename(s["dump"]),
+            "rank": s.get("process_id"),
+            "reason": s.get("reason"),
+            "when": s.get("when"),
+            "last_completed_step": s.get("last_completed_step"),
+            "phase_at_death": s.get("phase_at_death"),
+            "damaged": bool(s["integrity_problems"]),
+        })
+    return {
+        "num_dumps": len(dumps),
+        "generations": {str(g): v for g, v in sorted(
+            by_gen.items(), key=lambda kv: (kv[0] is None, kv[0]))},
+        "root_cause": root,
+        "integrity_problems": sorted(
+            {p for s in dumps for p in s["integrity_problems"]}),
+    }
+
+
+def render_incident(incident: dict) -> str:
+    out = []
+    w = out.append
+    w("=" * 72)
+    w(f"INCIDENT  ({incident['num_dumps']} flight record(s))")
+    w("=" * 72)
+    for gen, rows in incident["generations"].items():
+        w(f"generation {gen}:")
+        for r in rows:
+            dmg = "  !!DAMAGED" if r["damaged"] else ""
+            w(f"    rank {r['rank'] if r['rank'] is not None else '?':>3}  "
+              f"{(r['reason'] or '?'):24s} last step "
+              f"{r['last_completed_step']!s:>6}  "
+              f"phase {(r['phase_at_death'] or '?')}{dmg}")
+    root = incident["root_cause"]
+    if root is not None:
+        w("")
+        w("root cause (earliest failure):")
+        w(render(root))
+    return "\n".join(out)
+
+
 def render(summary: dict) -> str:
     """The human-readable report (one incident, terminal-width prose)."""
     out = []
@@ -125,8 +185,12 @@ def render(summary: dict) -> str:
     if summary["integrity_problems"]:
         w("!! DUMP DAMAGED: " + "; ".join(summary["integrity_problems"]))
     w(f"reason:        {summary['reason']}")
-    w(f"when:          {summary['when']}   (pid {summary['pid']}, "
-      f"role {summary['role'] or '?'})")
+    who = f"pid {summary['pid']}, role {summary['role'] or '?'}"
+    if summary.get("process_id") is not None:
+        who += f", rank {summary['process_id']}"
+    if summary.get("generation") is not None:
+        who += f", generation {summary['generation']}"
+    w(f"when:          {summary['when']}   ({who})")
     w(f"config:        fingerprint {summary['config_fingerprint']}")
     w(f"last step:     {summary['last_completed_step']}")
     w(f"phase:         {summary['phase_at_death'] or 'unknown'} "
@@ -177,7 +241,23 @@ def main() -> None:
                    help="span-tail length in the report")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead")
+    p.add_argument("--all", action="store_true",
+                   help="treat PATH as a directory of per-rank dumps "
+                        "(elastic/multi-process job) and render ONE "
+                        "incident summary across all of them")
     args = p.parse_args()
+    if args.all:
+        dumps = list_dumps(args.path)
+        if not dumps:
+            raise SystemExit(f"no flight-*/ dump under {args.path}")
+        incident = summarize_incident(dumps, span_tail=args.spans)
+        if args.json:
+            print(json.dumps(incident, indent=2, default=str))
+        else:
+            print(render_incident(incident))
+        if incident["integrity_problems"]:
+            sys.exit(1)
+        return
     dump_dir = _resolve_dump(args.path)
     summary = summarize(dump_dir, span_tail=args.spans)
     if args.json:

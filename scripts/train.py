@@ -379,6 +379,16 @@ def main() -> None:
 
     cfg = build_config(args)
 
+    # Elastic launch (scripts/launch.py --elastic): when this generation
+    # runs at less than the full slot count, shrink the mesh batch axes
+    # to the surviving devices and recompute grad-accum so the GLOBAL
+    # batch schedule (rows per optimizer step, steps/epoch, rng folds) is
+    # exactly the full-size run's — a resumed shrunk generation replays
+    # the same batches the dead world would have.
+    from dlti_tpu.training.elastic import maybe_reshape_from_env
+
+    cfg = maybe_reshape_from_env(cfg)
+
     base_params = None
     if args.init_from_hf:
         from dlti_tpu.models import load_hf_checkpoint

@@ -174,6 +174,26 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
     )
 
 
+def test_elastic_metric_names_are_schema_stable():
+    """Elastic-training telemetry names are a scrape contract like the
+    watchdog/ckpt sets: the supervisor's restart counter and the
+    generation / live-world gauges every generation's workers re-set."""
+    from dlti_tpu.training import elastic
+
+    assert elastic.ELASTIC_METRIC_NAMES == (
+        "dlti_elastic_restarts_total",
+        "dlti_elastic_generation",
+        "dlti_elastic_world_size",
+    )
+    assert elastic.restarts_total.name == elastic.ELASTIC_METRIC_NAMES[0]
+    assert elastic.generation_gauge.name == elastic.ELASTIC_METRIC_NAMES[1]
+    assert elastic.world_size_gauge.name == elastic.ELASTIC_METRIC_NAMES[2]
+    # The rendezvous env extension is part of the launcher contract too.
+    assert elastic.ENV_GENERATION == "DLTI_GENERATION"
+    assert elastic.ENV_ELASTIC_DIR == "DLTI_ELASTIC_DIR"
+    assert elastic.ENV_NUM_SLOTS == "DLTI_ELASTIC_NUM_SLOTS"
+
+
 def test_debug_vars_and_dump_surface_contract():
     """Keys consumers parse: the /debug/vars envelope (loadgen end-of-run
     scrape, the dashboard page) and the flight-dump file set

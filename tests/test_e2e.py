@@ -130,27 +130,53 @@ def test_packed_training_runs_and_masks_boundaries(tmp_path):
 
 
 def test_multihost_sharding_math(monkeypatch):
-    """Per-host shards agree on steps_per_epoch (ragged splits would deadlock
-    collectives on the last step), rows are disjoint, and batches carry the
-    host's 1/N slice of the global microbatch."""
+    """Per-host views agree on steps_per_epoch (ragged splits would deadlock
+    collectives on the last step), each batch carries the host's 1/N
+    batch-column slice of the global microbatch, and the GLOBAL schedule
+    (which rows feed which optimizer step) is world-size invariant — the
+    contract elastic mesh reshape relies on."""
     from dlti_tpu.data import pipeline as pl_mod
 
     tok = ByteTokenizer()
-    seqs = [[1, 2, 3]] * 101
-    views = []
-    for pid in range(4):
-        monkeypatch.setattr(jax, "process_count", lambda: 4)
-        monkeypatch.setattr(jax, "process_index", lambda pid=pid: pid)
-        views.append(
-            pl_mod.TokenBatchDataset(seqs, 8, tok.pad_id, micro_batch_size=4,
-                                     grad_accum_steps=1, shard_by_host=True)
-        )
+    # Distinguishable rows: row j starts with token j.
+    seqs = [[j % 250 + 1, 2, 3] for j in range(101)]
+
+    def view(pid, procs, mbs=4, accum=1):
+        monkeypatch.setattr(jax, "process_count", lambda: procs)
+        monkeypatch.setattr(jax, "process_index", lambda: pid)
+        return pl_mod.TokenBatchDataset(
+            seqs, 8, tok.pad_id, micro_batch_size=mbs,
+            grad_accum_steps=accum, shard_by_host=True)
+
+    views = [view(pid, 4) for pid in range(4)]
     steps = {v.steps_per_epoch() for v in views}
-    assert len(steps) == 1 and steps.pop() == 25  # 101 // 4 = 25 rows/host
-    ranges = [v._row_range for v in views]
-    assert ranges == [(0, 25), (25, 50), (50, 75), (75, 100)]
+    assert len(steps) == 1 and steps.pop() == 25  # 101 // 4 global rows
     batch = next(views[0].epoch(0))
     assert batch["input_ids"].shape == (1, 1, 8)  # 4 global / 4 hosts = 1
+
+    # Reassembling the four host slices along the batch dim reproduces the
+    # single-host global batch exactly, step for step.
+    single = view(0, 1)
+    for step_idx, (g, *locals_) in enumerate(zip(
+            single.epoch(0), *[v.epoch(0) for v in views])):
+        stacked = np.concatenate([b["input_ids"] for b in locals_], axis=1)
+        np.testing.assert_array_equal(stacked, g["input_ids"])
+        if step_idx >= 3:
+            break
+
+    # World-size invariance incl. grad-accum rescale (2 hosts x bs2 vs
+    # 1 host x bs4, and 1 host with rows moved into the accum dim): the
+    # same global rows feed the same optimizer step.
+    two = [view(pid, 2) for pid in range(2)]
+    for g, a, b in zip(single.epoch(0), two[0].epoch(0), two[1].epoch(0)):
+        np.testing.assert_array_equal(
+            np.concatenate([a["input_ids"], b["input_ids"]], axis=1),
+            g["input_ids"])
+        break
+    reshaped = view(0, 1, mbs=2, accum=2)  # rescale_batch_schedule(4,1,2,1)
+    g0 = next(single.epoch(0))["input_ids"].reshape(-1, 8)
+    r0 = next(reshaped.epoch(0))["input_ids"].reshape(-1, 8)
+    np.testing.assert_array_equal(g0, r0)
 
 
 def test_global_bs_not_divisible_by_procs_raises(monkeypatch):

@@ -1,0 +1,601 @@
+"""Elastic self-healing training: supervisor state machine + drill.
+
+Tier-1 here is deliberately JAX-free on the worker side: the launcher
+rendezvous contract (``worker_env``/``slurm_env`` defaulting), the
+reshape math (``rescale_batch_schedule`` / ``fit_parallel_to_devices``),
+and the :class:`~dlti_tpu.training.elastic.ElasticLauncher`
+restart-budget/backoff/rejoin state machine driven by fake subprocess
+workers that fail, hang, or drain on cue in well under a second each.
+
+The slow tier runs the real drill the ISSUE's acceptance names: two gloo
+``jax.distributed`` processes training llama_tiny through
+``scripts/train.py``, a supervisor-side ``host-kill`` of worker 1
+mid-epoch, reshape to world 1, verified resume, rejoin to world 2 at the
+next checkpoint boundary, and a step-for-step loss match against an
+uninterrupted run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dlti_tpu.launcher import (
+    DEFAULT_PORT, ENV_COORDINATOR, ENV_NUM_PROCESSES, ENV_PROCESS_ID,
+    slurm_env, worker_env,
+)
+from dlti_tpu.training import elastic
+from dlti_tpu.training.elastic import (
+    ENV_ELASTIC_DIR, ENV_GENERATION, ENV_NUM_SLOTS, ElasticLauncher,
+    HostKillSpec, latest_committed_step, rescale_batch_schedule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(launcher):
+    path = os.path.join(launcher.elastic_dir, "elastic_events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------- rendezvous env
+
+def test_worker_env_contract_and_base_isolation():
+    base = {"HOME": "/h"}
+    env = worker_env("10.0.0.1:29400", 4, 2, base=base)
+    assert env[ENV_COORDINATOR] == "10.0.0.1:29400"
+    assert env[ENV_NUM_PROCESSES] == "4"
+    assert env[ENV_PROCESS_ID] == "2"
+    assert env["HOME"] == "/h"
+    assert ENV_COORDINATOR not in base  # base dict is never mutated
+
+
+def test_slurm_env_port_defaulting_and_id_fallbacks():
+    # Default port comes from the launcher contract, not SLURM.
+    env = slurm_env({"SLURM_NODELIST": "h[01-04]", "SLURM_NNODES": "4",
+                     "SLURM_NODEID": "3"})
+    assert env[ENV_COORDINATOR] == f"h01:{DEFAULT_PORT}"
+    # NNODES/NODEID are the fallback when NTASKS/PROCID are absent.
+    assert env[ENV_NUM_PROCESSES] == "4"
+    assert env[ENV_PROCESS_ID] == "3"
+    # Explicit NTASKS/PROCID win over the node-level vars.
+    env = slurm_env({"SLURM_JOB_NODELIST": "a,b", "SLURM_NNODES": "2",
+                     "SLURM_NTASKS": "8", "SLURM_NODEID": "1",
+                     "SLURM_PROCID": "5"}, port=1234)
+    assert env[ENV_COORDINATOR] == "a:1234"
+    assert (env[ENV_NUM_PROCESSES], env[ENV_PROCESS_ID]) == ("8", "5")
+
+
+# ------------------------------------------------------- reshape math
+
+def test_rescale_batch_schedule_preserves_rows_per_step():
+    for micro, accum, full, live in ((8, 2, 2, 1), (8, 16, 4, 2),
+                                     (8, 2, 2, 2), (6, 4, 3, 1)):
+        m, a = rescale_batch_schedule(micro, accum, full, live)
+        assert m * a == micro * accum  # the global schedule invariant
+        assert m == micro * live // full
+    with pytest.raises(ValueError, match="integral"):
+        rescale_batch_schedule(3, 2, 2, 1)
+    with pytest.raises(ValueError, match="positive"):
+        rescale_batch_schedule(8, 2, 0, 1)
+
+
+def test_fit_parallel_to_devices():
+    from dlti_tpu.config import ParallelConfig, ZeROStage
+    from dlti_tpu.parallel.mesh import fit_parallel_to_devices
+
+    z3 = ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=8)
+    assert fit_parallel_to_devices(z3, 8) is z3          # already fits
+    assert fit_parallel_to_devices(z3, 4).fsdp == 4      # shrink fsdp
+    dp = ParallelConfig(data=4, tensor=2)
+    got = fit_parallel_to_devices(dp, 4)
+    assert (got.data, got.tensor) == (2, 2)              # TP extent kept
+    with pytest.raises(ValueError, match="model-parallel"):
+        fit_parallel_to_devices(ParallelConfig(tensor=8), 4)
+    with pytest.raises(ValueError, match="mixed"):
+        fit_parallel_to_devices(ParallelConfig(data=2, fsdp=4), 4)
+
+
+# ------------------------------------------------------- chaos spec
+
+def test_host_kill_spec_is_supervisor_owned():
+    from dlti_tpu.training.chaos import TrainFaultInjector
+
+    spec = HostKillSpec.parse("3:host-kill")
+    assert (spec.step, spec.rank) == (3, 1)
+    assert HostKillSpec.parse("5:host-kill:0").rank == 0
+    assert HostKillSpec.parse("4:kill") is None          # in-process mode
+    assert HostKillSpec.parse("") is None
+    # ...and the in-process injector ignores the supervisor-owned mode,
+    # so DLTI_TRAIN_FAULT_INJECT can ride the launch env into workers.
+    assert TrainFaultInjector.from_spec("3:host-kill") is None
+    assert TrainFaultInjector.from_spec("3:host-kill:0") is None
+    assert TrainFaultInjector.from_spec("3:kill") is not None
+
+
+def test_latest_committed_step_requires_commit_marker(tmp_path):
+    assert latest_committed_step(None) is None
+    assert latest_committed_step(str(tmp_path / "nope")) is None
+    (tmp_path / "3").mkdir()                 # no COMMIT: not committed
+    assert latest_committed_step(str(tmp_path)) is None
+    (tmp_path / "3" / "COMMIT").write_text("{}")
+    (tmp_path / "7").mkdir()
+    (tmp_path / "7" / "COMMIT").write_text("{}")
+    (tmp_path / ".tmp-9-x").mkdir()          # staging dirs never count
+    assert latest_committed_step(str(tmp_path)) == 7
+
+
+# ------------------------------------------------- supervisor state machine
+#
+# Fake workers: tiny non-JAX python scripts that exercise exactly one
+# behavior each; the supervisor under test is the real one.
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def _launcher(cmd, n, tmp_path, **kw):
+    sleeps = []
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("term_grace_s", 2.0)
+    kw.setdefault("elastic_dir", str(tmp_path / "elastic"))
+    kw.setdefault("log_dir", str(tmp_path / "logs"))
+    real_sleep = time.sleep
+
+    def sleep(s):
+        sleeps.append(s)
+        real_sleep(min(s, 0.05))  # backoffs recorded, not waited out
+
+    lau = ElasticLauncher(cmd, n, sleep=sleep, **kw)
+    lau._test_sleeps = sleeps
+    return lau
+
+
+def test_clean_run_supervises_to_zero(tmp_path):
+    cmd = _script(tmp_path, "ok.py", """\
+        import os, sys
+        assert os.environ["DLTI_GENERATION"] == "0"
+        assert os.environ["DLTI_ELASTIC_DIR"]
+        assert os.environ["DLTI_ELASTIC_NUM_SLOTS"] == "2"
+        sys.exit(0)
+    """)
+    lau = _launcher(cmd, 2, tmp_path)
+    assert lau.run() == 0
+    assert lau.restarts == 0
+    assert [e["event"] for e in _events(lau)][-2:] == [
+        "done", "supervisor_exit"]
+
+
+def test_failure_shrinks_world_and_charges_budget(tmp_path):
+    # rank 1 dies in generation 0; the survivor relaunches as a 1-process
+    # generation 1 and completes.
+    cmd = _script(tmp_path, "flaky.py", """\
+        import os, sys, time
+        if (os.environ["DLTI_NUM_PROCESSES"] == "2"
+                and os.environ["DLTI_PROCESS_ID"] == "1"):
+            sys.exit(7)
+        time.sleep(0.3)
+        sys.exit(0)
+    """)
+    lau = _launcher(cmd, 2, tmp_path, restart_budget=2, backoff_s=0.5)
+    assert lau.run() == 0
+    assert lau.restarts == 1
+    ev = _events(lau)
+    kinds = [e["event"] for e in ev]
+    assert "failure" in kinds and "backoff" in kinds
+    fail = next(e for e in ev if e["event"] == "failure")
+    assert (fail["slot"], fail["rc"]) == (1, 7)
+    spawns = [e for e in ev if e["event"] == "spawn"]
+    assert [s["world_size"] for s in spawns] == [2, 1]
+    assert spawns[1]["world"] == [0]          # survivor renumbered to rank 0
+    assert [e["seconds"] for e in ev if e["event"] == "backoff"] == [0.5]
+    assert 0.5 in lau._test_sleeps            # backoff actually slept
+
+
+def test_budget_exhaustion_gives_up_with_failure_rc(tmp_path):
+    cmd = _script(tmp_path, "doomed.py", "import sys; sys.exit(5)\n")
+    lau = _launcher(cmd, 2, tmp_path, restart_budget=2, backoff_s=1.0,
+                    rejoin=False)
+    assert lau.run() == 5
+    assert lau.restarts == 2
+    ev = _events(lau)
+    assert ev[-1]["event"] == "give_up" and ev[-1]["rc"] == 5
+    # Exponential backoff: 1.0 then 2.0 (the third failure exhausts the
+    # budget before another backoff).
+    assert [e["seconds"] for e in ev if e["event"] == "backoff"] == [1.0, 2.0]
+    # rejoin=False: every relaunch is full-size.
+    assert [e["world_size"] for e in ev if e["event"] == "spawn"] == [2, 2, 2]
+
+
+def test_rejoin_at_next_checkpoint_boundary(tmp_path):
+    # gen 0: rank 1 dies -> shrink to world 1. gen 1: the survivor loops
+    # (SIGTERM-aware, exits 0 on drain). When a checkpoint commits, the
+    # supervisor drains gen 1 and relaunches at full size; gen 2 exits
+    # clean.
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    cmd = _script(tmp_path, "worker.py", f"""\
+        import json, os, signal, sys, time
+        gen = int(os.environ["DLTI_GENERATION"])
+        if gen == 0 and os.environ["DLTI_PROCESS_ID"] == "1":
+            sys.exit(3)
+        if gen >= 2:
+            sys.exit(0)
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        t0 = time.time()
+        while not stop and time.time() - t0 < 30:
+            if time.time() - t0 > 0.4:
+                # the shrunk generation 'reaches a save boundary'
+                d = os.path.join({str(ckpt)!r}, "4")
+                os.makedirs(d, exist_ok=True)
+                open(os.path.join(d, "COMMIT"), "w").write("{{}}")
+            time.sleep(0.05)
+        sys.exit(0)
+    """)
+    lau = _launcher(cmd, 2, tmp_path, restart_budget=3, backoff_s=0.2,
+                    ckpt_dir=str(ckpt))
+    assert lau.run() == 0
+    ev = _events(lau)
+    kinds = [e["event"] for e in ev]
+    assert "rejoin_drain" in kinds and "rejoin" in kinds
+    spawns = [e["world_size"] for e in ev if e["event"] == "spawn"]
+    assert spawns == [2, 1, 2]                # shrink, then full-size rejoin
+    rejoin = next(e for e in ev if e["event"] == "rejoin")
+    assert rejoin["world"] == [0, 1]
+    # The rejoin drain was triggered by the committed boundary.
+    drain = next(e for e in ev if e["event"] == "rejoin_drain")
+    assert drain["checkpoint_step"] == 4
+
+
+def test_host_kill_chaos_fires_once_on_observed_step(tmp_path):
+    # Workers write heartbeat files like the trainer does; the supervisor
+    # SIGKILLs rank 1 once step 3 is observed, then recovers to world 1.
+    cmd = _script(tmp_path, "beater.py", """\
+        import json, os, sys, time
+        d = os.environ["DLTI_ELASTIC_DIR"]
+        gen = os.environ["DLTI_GENERATION"]
+        rank = os.environ["DLTI_PROCESS_ID"]
+        if os.environ["DLTI_NUM_PROCESSES"] == "1":
+            sys.exit(0)   # recovered generation completes immediately
+        for step in range(1, 100):
+            path = os.path.join(d, f"hb_g{gen}_r{rank}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step, "wall": time.time()}, f)
+            os.replace(tmp, path)
+            time.sleep(0.05)
+        sys.exit(0)
+    """)
+    lau = _launcher(cmd, 2, tmp_path, restart_budget=1, backoff_s=0.2,
+                    fault_spec="3:host-kill")
+    assert lau.run() == 0
+    ev = _events(lau)
+    kills = [e for e in ev if e["event"] == "host_kill"]
+    assert len(kills) == 1 and kills[0]["rank"] == 1
+    assert kills[0]["step"] >= 3
+    assert lau.fault.fired
+    fail = next(e for e in ev if e["event"] == "failure")
+    assert fail["slot"] == 1                  # the SIGKILL books as failure
+    assert [e["world_size"] for e in ev if e["event"] == "spawn"] == [2, 1]
+
+
+def test_stale_heartbeat_triggers_targeted_ladder(tmp_path):
+    # One beat, then silence: the supervisor declares the worker stale,
+    # writes a supervisor incident, ladders it (SIGTERM->SIGKILL), and —
+    # with no budget — gives up nonzero.
+    cmd = _script(tmp_path, "hung.py", """\
+        import json, os, time
+        d = os.environ["DLTI_ELASTIC_DIR"]
+        path = os.path.join(d, "hb_g0_r0.json")
+        with open(path, "w") as f:
+            json.dump({"step": 1, "wall": time.time()}, f)
+        time.sleep(60)
+    """)
+    lau = _launcher(cmd, 1, tmp_path, restart_budget=0,
+                    heartbeat_stale_s=0.5, startup_grace_s=5.0,
+                    term_grace_s=0.3)
+    t0 = time.monotonic()
+    rc = lau.run()
+    assert rc != 0
+    assert time.monotonic() - t0 < 30         # did not wait out sleep(60)
+    ev = _events(lau)
+    assert any(e["event"] == "stale" for e in ev)
+    incident = json.load(open(os.path.join(
+        lau.elastic_dir, "supervisor_incident_g0.json")))
+    assert incident["rank"] == 0 and incident["heartbeat"]["step"] == 1
+
+
+def test_watchdog_stale_alert_drives_targeted_kill(tmp_path):
+    # Rank 0's in-worker watchdog aggregates collective heartbeats and
+    # fires heartbeat_stale naming the straggler; the mirrored alert file
+    # makes the supervisor ladder THAT rank instead of aborting the job.
+    cmd = _script(tmp_path, "quiet.py", """\
+        import os, time
+        time.sleep(60)
+    """)
+    lau = _launcher(cmd, 2, tmp_path, restart_budget=0, term_grace_s=0.3)
+    # Pre-plant the mirrored alert (what elastic.mirror_alert writes).
+    os.makedirs(lau.elastic_dir, exist_ok=True)
+    with open(os.path.join(lau.elastic_dir,
+                           "watchdog_alerts_g0_r0.jsonl"), "w") as f:
+        f.write(json.dumps({"rule": "heartbeat_stale",
+                            "stale": {"1": 42.0}}) + "\n")
+    t0 = time.monotonic()
+    rc = lau.run()
+    assert rc != 0
+    assert time.monotonic() - t0 < 30
+    ev = _events(lau)
+    stale = next(e for e in ev if e["event"] == "watchdog_stale")
+    assert stale["rank"] == 1                 # targeted, not whole-job
+
+
+# ------------------------------------------------- worker-side helpers
+
+def test_beat_and_mirror_alert_write_into_elastic_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(ENV_ELASTIC_DIR, str(tmp_path))
+    monkeypatch.setenv(ENV_GENERATION, "2")
+    monkeypatch.setenv("DLTI_PROCESS_ID", "1")
+    monkeypatch.setenv(ENV_NUM_SLOTS, "2")
+    elastic._last_beat[0] = 0.0
+    elastic.beat(7)
+    hb = json.load(open(tmp_path / "hb_g2_r1.json"))
+    assert (hb["step"], hb["generation"], hb["rank"]) == (7, 2, 1)
+    elastic.mirror_alert({"rule": "heartbeat_stale", "stale": {"0": 9.0}})
+    lines = open(tmp_path / "watchdog_alerts_g2_r1.jsonl").readlines()
+    assert json.loads(lines[0])["rule"] == "heartbeat_stale"
+
+
+def test_beat_noop_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_ELASTIC_DIR, raising=False)
+    elastic.beat(1)        # must not raise or write anywhere
+    elastic.mirror_alert({"rule": "x"})
+    assert elastic.elastic_info() is None
+
+
+def test_flight_dump_tagged_with_rank_and_generation(tmp_path, monkeypatch):
+    from dlti_tpu.telemetry.flightrecorder import FlightRecorder, verify_dump
+
+    monkeypatch.setenv("DLTI_PROCESS_ID", "1")
+    monkeypatch.setenv(ENV_GENERATION, "2")
+    rec = FlightRecorder(str(tmp_path))
+    rec.note(step=10)
+    path = rec.dump(reason="test", force=True)
+    assert os.path.basename(path).endswith("-g2-r1")
+    assert verify_dump(path) == []
+    ctx = json.load(open(os.path.join(path, "context.json")))
+    assert (ctx["process_id"], ctx["generation"]) == (1, 2)
+
+
+def test_postmortem_incident_mode_over_per_rank_dumps(tmp_path, monkeypatch):
+    from dlti_tpu.telemetry.flightrecorder import FlightRecorder
+
+    monkeypatch.setenv(ENV_GENERATION, "0")
+    monkeypatch.setenv("DLTI_PROCESS_ID", "1")
+    rec = FlightRecorder(str(tmp_path))
+    rec.note(step=3, role="training")
+    rec.dump(reason="chaos_kill", force=True)
+    monkeypatch.setenv(ENV_GENERATION, "1")
+    monkeypatch.setenv("DLTI_PROCESS_ID", "0")
+    rec2 = FlightRecorder(str(tmp_path))
+    rec2.note(step=5, role="training")
+    rec2.dump(reason="preemption_stop", force=True)
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         str(tmp_path), "--all", "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-800:]
+    incident = json.loads(out.stdout)
+    assert incident["num_dumps"] == 2
+    assert set(incident["generations"]) == {"0", "1"}
+    # Root cause is the earliest non-preemption death: the gen-0 chaos
+    # kill on rank 1, not the later drain.
+    assert incident["root_cause"]["reason"] == "chaos_kill"
+    assert incident["root_cause"]["process_id"] == 1
+    # Human rendering works too.
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         str(tmp_path), "--all"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "INCIDENT" in out.stdout and "root cause" in out.stdout
+
+
+def test_maybe_reshape_from_env(tmp_path, monkeypatch):
+    from dlti_tpu.config import (
+        Config, MODEL_PRESETS, ParallelConfig, TrainConfig, ZeROStage,
+    )
+    from dlti_tpu.training.elastic import maybe_reshape_from_env
+
+    cfg = Config(model=MODEL_PRESETS["llama_tiny"],
+                 parallel=ParallelConfig(zero_stage=ZeROStage.ZERO3, fsdp=4),
+                 train=TrainConfig(micro_batch_size=4, grad_accum_steps=2))
+    # Outside an elastic launch: untouched.
+    monkeypatch.delenv(ENV_ELASTIC_DIR, raising=False)
+    assert maybe_reshape_from_env(cfg) is cfg
+    # Live world 1 of 2 slots (this test process IS world 1): grad accum
+    # doubles, mesh/microbatch stay at what the live device count built.
+    monkeypatch.setenv(ENV_ELASTIC_DIR, str(tmp_path))
+    monkeypatch.setenv(ENV_GENERATION, "1")
+    monkeypatch.setenv(ENV_NUM_SLOTS, "2")
+    monkeypatch.setenv("DLTI_PROCESS_ID", "0")
+    got = maybe_reshape_from_env(cfg)
+    assert got.train.micro_batch_size == 4
+    assert got.train.grad_accum_steps == 4
+    assert (got.train.micro_batch_size * got.train.grad_accum_steps
+            == 4 * 2 * 2 // 2 * 2)  # rows/step of the full-world schedule
+    # At full size: untouched.
+    monkeypatch.setenv(ENV_NUM_SLOTS, "1")
+    assert maybe_reshape_from_env(cfg) is cfg
+
+
+# ------------------------------------------------------------ the drill
+#
+# The acceptance drill: 2 real gloo processes under the elastic
+# supervisor, worker 1 host-killed mid-epoch, reshape to world 1 +
+# verified resume, rejoin to world 2 at the next checkpoint boundary, and
+# the final loss trajectory matches an uninterrupted run step-for-step.
+
+@pytest.mark.slow
+def test_elastic_drill_host_kill_reshape_resume_rejoin(tmp_path):
+    import numpy as np
+
+    n_rows, seq = 128, 32
+    # Fixed-length rows (every line truncates to seq tokens): uniform
+    # loss masks make the grad-accum regrouping of the shrunk world
+    # mathematically identical, not just approximately so.
+    data = tmp_path / "data.txt"
+    data.write_text("".join(
+        f"row {i:04d} " + "x" * 64 + "\n" for i in range(n_rows)))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+
+    def train_cmd(out_dir, steplog):
+        return [
+            sys.executable, os.path.join(REPO, "scripts", "train.py"),
+            "--preset", "zero3", "--model", "llama_tiny",
+            "--tokenizer", "byte", "--dataset-path", str(data),
+            "--output-dir", str(out_dir), "--max-seq-len", str(seq),
+            "--per-device-batch-size", "1",
+            "--gradient-accumulation-steps", "2",
+            "--num-train-epochs", "1", "--save-steps", "2",
+            "--save-total-limit", "10", "--warmup-steps", "2",
+            "--logging-steps", "1", "--prefetch-depth", "0",
+            "--step-log", str(steplog),
+            "--metrics-csv", str(tmp_path / "m.csv"),
+        ]
+
+    def losses_from(steplog):
+        out = {}
+        order = []
+        with open(steplog) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("type") == "step":
+                    out[rec["step"]] = rec["loss"]
+                    order.append(rec["step"])
+        return out, order
+
+    # Uninterrupted reference: ONE process, 8 devices — the same global
+    # mesh extent and batch schedule the elastic job defines.
+    ref_env = dict(env)
+    ref_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    ref_log = tmp_path / "ref_steps.jsonl"
+    proc = subprocess.run(
+        train_cmd(tmp_path / "ref_ckpt", ref_log), env=ref_env,
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    ref_losses, _ = losses_from(ref_log)
+    assert len(ref_losses) == n_rows // (8 * 2)  # 8 steps/epoch
+
+    # Elastic run: 2 processes x 4 devices under the supervisor; the
+    # supervisor SIGKILLs worker 1 once heartbeats reach step 3.
+    el_env = dict(env)
+    el_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    el_env["DLTI_TRAIN_FAULT_INJECT"] = "3:host-kill"
+    ckpt = tmp_path / "ckpt"
+    el_log = tmp_path / "el_steps.jsonl"
+    elastic_dir = tmp_path / "elastic"
+    # Budget 4, not the 1 the drill strictly needs: this image's gloo CPU
+    # collectives are intrinsically flaky under contention (a rank can
+    # SIGABRT in a collective through no fault of the code under test),
+    # and absorbing such a failure with a spare recovery cycle is the
+    # supervisor's PURPOSE — the assertions below verify the mandated
+    # recovery invariants rather than a noise-free restart history.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "launch.py"),
+         "--num-processes", "2", "--elastic",
+         "--restart-budget", "4", "--backoff", "0.5",
+         "--ckpt-dir", str(ckpt), "--elastic-dir", str(elastic_dir),
+         "--log-dir", str(tmp_path / "logs"), "--term-grace", "30", "--",
+         *train_cmd(ckpt, el_log)],
+        env=el_env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.is_dir():
+        for p in sorted(logdir.iterdir()):
+            if p.suffix == ".err":
+                logs += f"--- {p.name} ---\n" + p.read_text()[-1500:]
+    assert proc.returncode == 0, (
+        f"supervisor rc={proc.returncode}\n{proc.stderr[-2000:]}\n{logs}")
+
+    events = [json.loads(line) for line in
+              open(elastic_dir / "elastic_events.jsonl")]
+    kinds = [e["event"] for e in events]
+    spawns = [e for e in events if e["event"] == "spawn"]
+    # The advertised sequence: full size -> host-kill -> reshape to the
+    # survivor -> rejoin at the next checkpoint boundary -> full size.
+    # (Spurious environment failures may add recovery cycles around it —
+    # absorbed by the spare budget — so assert the invariants, not an
+    # exact restart history.)
+    assert "host_kill" in kinds, kinds
+    assert "rejoin_drain" in kinds and "rejoin" in kinds, kinds
+    assert [k for k in kinds if k == "host_kill"] == ["host_kill"]
+    assert spawns[0]["world_size"] == 2
+    # The failure booked for the host-kill blames the killed slot, and
+    # the generation spawned right after it is the reshaped survivor.
+    hk = kinds.index("host_kill")
+    hk_fail = next(e for e in events[hk:] if e["event"] == "failure")
+    assert hk_fail["slot"] == 1, hk_fail
+    post_kill_spawn = next(e for e in events
+                           if e["event"] == "spawn"
+                           and e["generation"] > events[hk]["generation"])
+    assert post_kill_spawn["world_size"] == 1, post_kill_spawn
+    # A rejoin (after the post-kill shrink) grew the world back to 2.
+    rejoin = next(e for e in events[hk:] if e["event"] == "rejoin")
+    assert rejoin["world"] == [0, 1]
+    assert spawns[-1]["world_size"] == 2, spawns
+
+    # The post-kill generation resumed from the last VERIFIED step: its
+    # first re-logged step is watermark+1 (the supervisor recorded the
+    # watermark at every spawn). Every other resume in the log also
+    # restarts at some spawn's watermark+1 — nothing resumes from an
+    # unverified or uncommitted step.
+    el_losses, order = losses_from(el_log)
+    watermark = post_kill_spawn["ckpt_watermark"]
+    assert watermark is not None and watermark >= 2
+    restarts = [order[i] for i in range(1, len(order))
+                if order[i] <= order[i - 1]]
+    assert restarts, "step log shows no resume"
+    assert watermark + 1 in restarts
+    valid_resume_points = {(s["ckpt_watermark"] or 0) + 1 for s in spawns}
+    assert set(restarts) <= valid_resume_points, (restarts, spawns)
+
+    # Step-for-step loss match with the uninterrupted run — before the
+    # kill, through the shrunk generation (regrouped grad accum), and
+    # after the rejoin.
+    assert set(el_losses) == set(ref_losses)
+    for step in sorted(ref_losses):
+        np.testing.assert_allclose(
+            el_losses[step], ref_losses[step], rtol=2e-4,
+            err_msg=f"loss diverged at step {step} "
+                    f"(elastic {el_losses[step]} vs ref {ref_losses[step]})")
+
+    # Heartbeats respect the advertised worlds: no (generation, rank)
+    # beat outside what its spawn announced (the reshape really shrank
+    # the world), and the generations the drill hinges on — the one the
+    # host-kill hit, the shrunk survivor, and the rejoined full-size one
+    # — all have a beat from every advertised rank.
+    hb_files = {p.name for p in elastic_dir.glob("hb_g*_r*.json")}
+    allowed = {f"hb_g{s['generation']}_r{r}.json"
+               for s in spawns for r in range(s["world_size"])}
+    assert hb_files <= allowed, (hb_files, allowed)
+    for spawn in (next(s for s in spawns
+                       if s["generation"] == events[hk]["generation"]),
+                  post_kill_spawn, spawns[-1]):
+        for r in range(spawn["world_size"]):
+            assert f"hb_g{spawn['generation']}_r{r}.json" in hb_files, (
+                spawn, sorted(hb_files))

@@ -9,7 +9,7 @@ serving registry (engine stats + lifecycle histograms + gateway +
 heartbeat + watchdog/flight counters + the trace eviction counter) after
 importing the trainer and server modules, plus every module-level metric
 object the training side owns (checkpoint store, prefetch, watchdog,
-flight recorder).
+flight recorder, elastic supervisor).
 """
 
 import re
@@ -37,22 +37,27 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
     from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
+    from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
 
     for tup, where in ((CKPT_METRIC_NAMES, "checkpoint"),
                        (PREFETCH_METRIC_NAMES, "prefetch"),
                        (GATEWAY_METRIC_NAMES, "gateway"),
                        (WATCHDOG_METRIC_NAMES, "watchdog"),
-                       (FLIGHT_METRIC_NAMES, "flightrecorder")):
+                       (FLIGHT_METRIC_NAMES, "flightrecorder"),
+                       (ELASTIC_METRIC_NAMES, "elastic")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
     from dlti_tpu.telemetry import flightrecorder, watchdog
+    from dlti_tpu.training import elastic
 
     objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
             store.save_retries, store.last_verified_step,
-            watchdog.alerts_total, flightrecorder.dumps_total)
+            watchdog.alerts_total, flightrecorder.dumps_total,
+            elastic.restarts_total, elastic.generation_gauge,
+            elastic.world_size_gauge)
     _assert_convention([m.name for m in objs], "module-level metrics")
 
 
