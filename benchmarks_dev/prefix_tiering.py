@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Prefix-cache tiering microbench (CPU-hermetic): quantify the
+HBM → host → disk hierarchy plus cache-affinity routing on a
+recurring-session (chat-shaped) workload, and emit one JSON artifact.
+
+* **Engine A/B**: the same session schedule — N sessions, K turns each,
+  every turn's prompt a strict extension of the last — runs through two
+  engines whose HBM pool is deliberately too small to hold every
+  session's prefix at once. Tiering OFF evicts-and-discards, so a
+  returning session re-prefills from scratch; tiering ON demotes evicted
+  blocks host→disk and restores them with a scatter. Headlines:
+  ``prefill_tokens_saved`` (> 0 means restores replaced re-prefill on
+  the measured path) and warm-turn wall time, with outputs asserted
+  byte-identical between the two engines call-for-call.
+* **Serving end-to-end**: a 2-replica tiered fleet behind the admission
+  gateway with cache-affinity routing serves the recurring-session
+  loadgen (``--sessions``); the report's cold-vs-warm TTFT split and the
+  scraped cache hit rate are the serving-level proof, and the replica
+  affinity counters show sessions actually stuck to their warm replica.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks_dev/prefix_tiering.py
+Artifact: results/prefix_tiering_cpu.json (path override: first CLI arg).
+Wired into `pytest -m slow` as a smoke: tests/test_prefix_tiering_bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The serving section runs 2 replicas on host devices.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+
+SESSIONS = 4
+TURNS = 3
+SYSTEM_TOKENS = 32       # shared system prompt (4 full blocks of 8)
+TURN_TOKENS = 16         # history growth per turn
+GEN_TOKENS = 6
+
+
+def _session_prompt(vocab: int, session: int, turn: int) -> list:
+    """Turn ``turn``'s prompt for ``session``: shared system prefix plus
+    a growing per-session history — turn t strictly extends turn t-1."""
+    system = [(37 * j + 11) % vocab for j in range(SYSTEM_TOKENS)]
+    history = [(session * 101 + j * 13 + 7) % vocab
+               for j in range((turn + 1) * TURN_TOKENS)]
+    return system + history
+
+
+def _engine(tiered: bool, disk_dir: str):
+    import jax
+    import jax.numpy as jnp
+
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import EngineConfig, InferenceEngine
+
+    mc = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(mc, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(
+        max_seqs=1, block_size=8,
+        # 15 allocatable blocks vs ~12 per in-flight request: the cached
+        # chains of 4 sessions cannot coexist — the pool MUST evict, and
+        # only the tiers decide whether that costs a re-prefill later.
+        num_blocks=16, max_model_len=96,
+        cache_dtype="float32", eos_token_id=-1, enable_prefix_caching=True,
+        prefix_host_blocks=8 if tiered else 0,
+        prefix_disk_dir=disk_dir if tiered else "",
+        prefix_disk_blocks=64 if tiered else 0)
+    return InferenceEngine(mc, params, ec), mc
+
+
+def bench_engine_ab(disk_dir: str) -> dict:
+    from dlti_tpu.serving import SamplingParams
+
+    tiered, mc = _engine(True, disk_dir)
+    plain, _ = _engine(False, disk_dir)
+    sp = SamplingParams(temperature=0.0, max_tokens=GEN_TOKENS)
+
+    walls = {"on": {"cold": [], "warm": []}, "off": {"cold": [], "warm": []}}
+    outputs_equal = True
+    # Round-robin by turn: between a session's turns, the other sessions'
+    # traffic evicts its blocks — exactly the chat fleet access pattern.
+    for turn in range(TURNS):
+        for s in range(SESSIONS):
+            prompt = _session_prompt(mc.vocab_size, s, turn)
+            kind = "warm" if turn > 0 else "cold"
+            t0 = time.perf_counter()
+            [r_on] = tiered.generate([prompt], sp)
+            walls["on"][kind].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            [r_off] = plain.generate([prompt], sp)
+            walls["off"][kind].append(time.perf_counter() - t0)
+            outputs_equal &= (r_on.output_token_ids == r_off.output_token_ids)
+
+    def _mean(xs):
+        return round(sum(xs) / len(xs), 6) if xs else 0.0
+
+    saved = plain.stats["prefill_tokens"] - tiered.stats["prefill_tokens"]
+    ts = tiered.prefix_cache.tier_store.stats
+    return {
+        "sessions": SESSIONS, "turns": TURNS,
+        "hbm_blocks": 16, "host_blocks": 8, "disk_blocks": 64,
+        "outputs_equal": outputs_equal,
+        "prefill_tokens_off": plain.stats["prefill_tokens"],
+        "prefill_tokens_on": tiered.stats["prefill_tokens"],
+        "prefill_tokens_saved": saved,
+        "prefix_restored_tokens": tiered.stats["prefix_restored_tokens"],
+        "hbm_evictions": tiered.prefix_cache.stats["evictions"],
+        "demotions": tiered.prefix_cache.stats["demotions"],
+        "tier_traffic": ts,
+        "cold_turn_wall_mean_s": {"off": _mean(walls["off"]["cold"]),
+                                  "on": _mean(walls["on"]["cold"])},
+        "warm_turn_wall_mean_s": {"off": _mean(walls["off"]["warm"]),
+                                  "on": _mean(walls["on"]["warm"])},
+    }
+
+
+def bench_serving_e2e(disk_dir: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dlti_tpu.benchmarks.loadgen import LoadGenConfig, run_load_test
+    from dlti_tpu.config import GatewayConfig, MODEL_PRESETS
+    from dlti_tpu.data.tokenizer import IdTokenizer
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import (
+        EngineConfig, ReplicatedEngine, SamplingParams,
+    )
+    from dlti_tpu.serving.server import ServerConfig, make_server
+
+    mc = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(mc, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(
+        # Per-replica pool of 13 allocatable blocks vs ~4 sessions x up
+        # to 10 cached blocks each: warm turns can only stay cheap if
+        # evicted chains demote to the tiers and restore on revisit.
+        max_seqs=1, block_size=8, num_blocks=14, max_model_len=96,
+        cache_dtype="float32", eos_token_id=-1, enable_prefix_caching=True,
+        prefix_host_blocks=8, prefix_disk_dir=disk_dir, prefix_disk_blocks=64)
+    rep = ReplicatedEngine(mc, params, ec, replicas=2, tensor=1)
+    httpd, aeng = make_server(
+        rep, IdTokenizer(vocab_size=mc.vocab_size),
+        ServerConfig(host="127.0.0.1", port=0,
+                     default_params=SamplingParams(max_tokens=GEN_TOKENS),
+                     gateway=GatewayConfig(enabled=True,
+                                           max_queued_requests=64,
+                                           affinity=True)))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        # concurrency < sessions: the semaphore's FIFO interleaves the
+        # fleet's turns (all first turns, then all seconds, ...), so a
+        # returning session finds its blocks demoted — the tier restore
+        # path is ON the measured TTFT path, not just the engine A/B's.
+        report = run_load_test(LoadGenConfig(
+            host="127.0.0.1", port=httpd.server_address[1],
+            sessions=8, turns=TURNS, reuse_frac=1.0,
+            concurrency=4, max_tokens=GEN_TOKENS, temperature=0.0,
+            timeout_s=180.0))
+        stats = rep.stats
+        return {
+            "replicas": 2, "sessions": 8, "turns": TURNS,
+            "num_ok": report.num_ok, "errors": report.errors,
+            "num_cold": report.num_cold, "num_warm": report.num_warm,
+            "cold_ttft_p50_s": report.cold_ttft_p50_s,
+            "cold_ttft_p90_s": report.cold_ttft_p90_s,
+            "warm_ttft_p50_s": report.warm_ttft_p50_s,
+            "warm_ttft_p90_s": report.warm_ttft_p90_s,
+            "cache_hit_rate": report.cache_hit_rate,
+            "prefix_cached_tokens": stats.get("prefix_cached_tokens", 0),
+            "prefix_restored_tokens": stats.get("prefix_restored_tokens", 0),
+            "affinity": dict(rep.affinity),
+        }
+    finally:
+        httpd.shutdown()
+        if httpd.gateway is not None:
+            httpd.gateway.shutdown()
+        aeng.shutdown()
+        httpd.server_close()
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        _repo, "results", "prefix_tiering_cpu.json")
+    with tempfile.TemporaryDirectory(prefix="prefix-tiers-") as d1, \
+            tempfile.TemporaryDirectory(prefix="prefix-tiers-srv-") as d2:
+        engine_ab = bench_engine_ab(d1)
+        serving = bench_serving_e2e(d2)
+    report = {
+        "benchmark": "prefix_tiering_cpu",
+        "platform": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "engine_ab": engine_ab,
+        "serving": serving,
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report))
+    ok = (engine_ab["outputs_equal"]
+          and engine_ab["prefill_tokens_saved"] > 0
+          and engine_ab["prefix_restored_tokens"] > 0
+          and serving["num_ok"] > 0
+          and not serving["errors"]
+          and serving["warm_ttft_p50_s"] < serving["cold_ttft_p50_s"]
+          and serving["prefix_restored_tokens"] > 0
+          and serving["affinity"]["sticky"] > 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,18 @@ class LoadGenConfig:
     # Per-request queued-deadline (seconds) sent as body deadline_s when
     # > 0; a gateway sheds past-deadline queued requests with 503.
     deadline_s: float = 0.0
+    # Recurring-session (chat-shaped) workload: sessions > 0 switches the
+    # driver to N concurrent sessions of `turns` requests each. Every
+    # session replays a shared system prompt plus its own GROWING history
+    # (turn t's prompt is a strict extension of turn t-1's), sent with an
+    # X-Session header so an affinity-routing gateway keeps the session
+    # on one replica's warm prefix cache. `reuse_frac` is the fraction of
+    # non-first turns that actually revisit the session; the rest issue
+    # an unrelated cold prompt (one-off traffic mixed into the run).
+    # num_requests is ignored in this mode (sessions * turns requests).
+    sessions: int = 0
+    turns: int = 4
+    reuse_frac: float = 1.0
 
 
 @dataclass
@@ -79,6 +91,11 @@ class RequestRecord:
     status: int = 0          # HTTP status (0 = transport failure)
     tenant: str = ""
     priority: str = ""
+    # Recurring-session mode: which session (if any) and whether this
+    # request replayed a warm, previously-sent prefix (turn >= 1 of a
+    # session) vs a cold first-touch prompt.
+    session: str = ""
+    warm: bool = False
 
     @property
     def shed(self) -> bool:
@@ -128,6 +145,18 @@ class LoadReport:
     # the scrape is off, the route is absent, or nothing fired.
     watchdog_alerts: dict = field(default_factory=dict)
     peak_queue_depth: float = 0.0
+    # Recurring-session mode: cold (first-touch) vs warm (repeat-prefix)
+    # TTFT percentiles — the tiered-prefix-cache headline — plus the
+    # server's own prefix-cache hit rate scraped from /stats at run end
+    # ((cached + restored) / (cached + restored + prefilled) tokens;
+    # 0.0 when the scrape fails or the server runs without the cache).
+    num_cold: int = 0
+    num_warm: int = 0
+    cold_ttft_p50_s: float = 0.0
+    cold_ttft_p90_s: float = 0.0
+    warm_ttft_p50_s: float = 0.0
+    warm_ttft_p90_s: float = 0.0
+    cache_hit_rate: float = 0.0
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -444,6 +473,20 @@ def _class_summary(recs: List[RequestRecord]) -> dict:
     }
 
 
+async def _scrape_cache_hit_rate(cfg: LoadGenConfig) -> float:
+    """Prefix-cache hit rate from the server's own /stats counters:
+    tokens served from cache (HBM hits + lower-tier restores) over all
+    prompt tokens the engine handled. Best-effort like every scrape."""
+    stats = await _http_get_json(cfg.host, cfg.port, "/stats")
+    if not stats:
+        return 0.0
+    cached = float(stats.get("prefix_cached_tokens", 0) or 0)
+    restored = float(stats.get("prefix_restored_tokens", 0) or 0)
+    prefilled = float(stats.get("prefill_tokens", 0) or 0)
+    total = cached + restored + prefilled
+    return round((cached + restored) / total, 4) if total else 0.0
+
+
 async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     rng = random.Random(cfg.seed)
     mix = parse_priority_mix(cfg.priority_mix)
@@ -460,8 +503,59 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
             await _http_post_sse(cfg.host, cfg.port, path, body, rec,
                                  cfg.timeout_s, extra_headers=headers)
 
+    async def session_task(sidx: int) -> None:
+        # One chat session: `turns` sequential requests replaying a shared
+        # system prompt + this session's growing history. Turn t's prompt
+        # strictly extends turn t-1's, so a prefix-caching server skips
+        # everything but the new tail — the cold-vs-warm TTFT split below
+        # is the measurement of exactly that.
+        srng = random.Random(cfg.seed * 7919 + sidx)
+        sess = f"sess-{sidx}"
+        system = cfg.prompt
+        history: List[str] = []
+        for t in range(cfg.turns):
+            reuse = t > 0 and srng.random() < cfg.reuse_frac
+            if t == 0 or reuse:
+                history.append(f"[turn {len(history)}] {sess} follow-up "
+                               f"question {len(history)}")
+                text = system + "\n" + "\n".join(history)
+                headers = {"X-Session": sess}
+                rec_sess, warm = sess, t > 0
+            else:
+                # Defecting turn: unrelated one-off traffic (cold), no
+                # session header — the (1 - reuse_frac) noise floor.
+                text = f"one-off {sess}-{t}: {system[::-1]}"
+                headers = {}
+                rec_sess, warm = "", False
+            if cfg.tenants > 0:
+                headers["X-Tenant"] = f"tenant-{sidx % cfg.tenants}"
+            if cfg.chat:
+                path = "/v1/chat/completions"
+                body = {"messages": [{"role": "user", "content": text}]}
+            else:
+                path = "/v1/completions"
+                body = {"prompt": text}
+            body.update({"max_tokens": cfg.max_tokens,
+                         "temperature": cfg.temperature,
+                         "stream": cfg.stream})
+            if cfg.deadline_s and cfg.deadline_s > 0:
+                body["deadline_s"] = cfg.deadline_s
+            async with sem:
+                rec = RequestRecord(start=time.monotonic(),
+                                    tenant=headers.get("X-Tenant", ""),
+                                    session=rec_sess, warm=warm)
+                records.append(rec)
+                await _http_post_sse(cfg.host, cfg.port, path, body, rec,
+                                     cfg.timeout_s, extra_headers=headers)
+
     t0 = time.monotonic()
-    if cfg.qps:
+    if cfg.sessions > 0:
+        # Recurring-session mode: sessions run concurrently, each one's
+        # turns strictly in order (turn t+1 needs t's prefix resident).
+        await asyncio.gather(
+            *(session_task(i) for i in range(cfg.sessions)),
+            return_exceptions=True)
+    elif cfg.qps:
         # Open loop: Poisson arrivals; concurrency still caps in-flight.
         tasks = []
         for i in range(cfg.num_requests):
@@ -493,6 +587,12 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         for cls in {m[0] for m in mix}:
             per_class[cls] = _class_summary(
                 [r for r in records if r.priority == cls])
+    cold = [r for r in ok if not r.warm]
+    warm = [r for r in ok if r.warm]
+    cold_ttfts = [r.ttft for r in cold if r.ttft is not None]
+    warm_ttfts = [r.ttft for r in warm if r.ttft is not None]
+    cache_hit_rate = (await _scrape_cache_hit_rate(cfg)
+                      if cfg.sessions > 0 else 0.0)
     return LoadReport(
         num_requests=len(records),
         num_ok=len(ok),
@@ -516,6 +616,13 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         server_histograms=server_hists,
         watchdog_alerts=watchdog_alerts,
         peak_queue_depth=peak_queue,
+        num_cold=len(cold),
+        num_warm=len(warm),
+        cold_ttft_p50_s=round(_percentile(cold_ttfts, 50), 4),
+        cold_ttft_p90_s=round(_percentile(cold_ttfts, 90), 4),
+        warm_ttft_p50_s=round(_percentile(warm_ttfts, 50), 4),
+        warm_ttft_p90_s=round(_percentile(warm_ttfts, 90), 4),
+        cache_hit_rate=cache_hit_rate,
     )
 
 

@@ -456,6 +456,15 @@ class GatewayConfig:
     # Replica failover: how many times one request may be resubmitted onto
     # a surviving replica after its replica's step() faulted.
     max_retries: int = 2
+    # Cache-affinity routing (dlti_tpu.serving.replicas): route each
+    # request to its sticky rendezvous-hash replica (key = X-Session
+    # header, else a digest of the first affinity_prefix_tokens prompt
+    # ids) so repeat sessions land on the replica whose prefix cache is
+    # warm; spill least-loaded when the sticky target's backlog exceeds
+    # its decode slots by more than affinity_spill_threshold.
+    affinity: bool = False
+    affinity_spill_threshold: int = 4
+    affinity_prefix_tokens: int = 32
     # Graceful drain: seconds SIGTERM waits for in-flight requests before
     # the server exits anyway.
     drain_grace_s: float = 30.0
@@ -467,11 +476,33 @@ class GatewayConfig:
 
 
 @dataclass(frozen=True)
+class PrefixTierConfig:
+    """Hierarchical prefix-cache tiering
+    (``dlti_tpu.serving.prefix_tiers``): evicted HBM prefix blocks demote
+    to a bounded host-RAM tier and from there to digest-verified block
+    dirs on disk; a prefix match in a lower tier restores blocks with a
+    host→device scatter instead of a re-prefill. All tiers off by
+    default (eviction discards, the legacy behavior). Maps onto
+    ``EngineConfig.prefix_{host_blocks,disk_dir,disk_blocks}`` (see
+    ``scripts/serve.py``)."""
+
+    host_blocks: int = 0     # host-RAM tier budget, in KV blocks (0 = off)
+    disk_dir: str = ""       # disk-tier directory ("" = disk tier off)
+    disk_blocks: int = 0     # disk-tier budget, in block dirs (0 = off)
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_blocks > 0 or (bool(self.disk_dir)
+                                        and self.disk_blocks > 0)
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
 
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
+    prefix_tiers: PrefixTierConfig = field(default_factory=PrefixTierConfig)
 
 
 @dataclass(frozen=True)
@@ -522,7 +553,7 @@ class Config:
                 if dataclasses.is_dataclass(f.type) or f.name in (
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
-                    "watchdog", "flight_recorder",
+                    "watchdog", "flight_recorder", "prefix_tiers",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -532,6 +563,7 @@ class Config:
                         "serving": ServingConfig, "gateway": GatewayConfig,
                         "watchdog": WatchdogConfig,
                         "flight_recorder": FlightRecorderConfig,
+                        "prefix_tiers": PrefixTierConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -60,6 +60,17 @@ class EngineConfig:
     # later requests sharing a prompt prefix; unreferenced blocks are
     # evicted LRU under pool pressure.
     enable_prefix_caching: bool = False
+    # Prefix-cache tiering (dlti_tpu.serving.prefix_tiers): with a host
+    # and/or disk budget set (and prefix caching on), evicted HBM blocks
+    # demote HBM -> host RAM -> disk instead of being discarded, and a
+    # prefix match that runs past the HBM blocks restores lower-tier
+    # blocks with a host->device scatter (charged as a restore, not a
+    # re-prefill). prefix_host_blocks bounds the host tier (blocks);
+    # prefix_disk_blocks bounds digest-verified block dirs under
+    # prefix_disk_dir (0 = that tier off).
+    prefix_host_blocks: int = 0
+    prefix_disk_dir: str = ""
+    prefix_disk_blocks: int = 0
     # Multi-step decode: run this many decode iterations inside ONE
     # compiled program (lax.scan: forward -> sample -> feed back), syncing
     # with the host only at the boundary. Amortizes per-step dispatch and
@@ -334,10 +345,36 @@ class InferenceEngine:
             self.cache = jax.device_put(self.cache, self._device)
         self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
         self.prefix_cache = None
+        self._restore_fn = None  # lazily-jitted tier-restore scatter
+        self._demote_sharding = None  # pinned_host staging (if available)
         if ec.enable_prefix_caching:
             from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
 
-            self.prefix_cache = PrefixCachingAllocator(self.block_manager)
+            tier_store = None
+            if ec.prefix_host_blocks > 0 or ec.prefix_disk_blocks > 0:
+                from dlti_tpu.serving.prefix_tiers import TieredBlockStore
+
+                tier_store = TieredBlockStore(
+                    host_blocks=ec.prefix_host_blocks,
+                    disk_dir=ec.prefix_disk_dir,
+                    disk_blocks=ec.prefix_disk_blocks)
+                # Demotion fetches stage device→host through pinned_host
+                # when the backend exposes it (TPU) — the ZeRO-3 offload
+                # path; CPU's default memory space is host already.
+                try:
+                    dev = self._device or jax.devices()[0]
+                    kinds = {m.kind for m in dev.addressable_memories()}
+                    if "pinned_host" in kinds:
+                        from jax.sharding import SingleDeviceSharding
+
+                        self._demote_sharding = SingleDeviceSharding(
+                            dev, memory_kind="pinned_host")
+                except Exception:  # noqa: BLE001 — staging is an optimization
+                    self._demote_sharding = None
+            self.prefix_cache = PrefixCachingAllocator(
+                self.block_manager, tier_store=tier_store,
+                kv_fetch=self._fetch_block_kv if tier_store is not None
+                else None)
         self.slots = [_Slot(i) for i in range(ec.max_seqs)]
         self.waiting: collections.deque[Request] = collections.deque()
         # Recently-finished requests, for observability only (results are
@@ -410,6 +447,11 @@ class InferenceEngine:
                       # admission refills them; results/int8_kv_7b.json).
                       "decode_slot_steps": 0,
                       "prefix_cached_tokens": 0,
+                      # Tokens whose KV came back from a LOWER tier (host
+                      # or disk) via a restore scatter instead of either
+                      # an HBM hit or a re-prefill. Present (at 0) even
+                      # without tiering so the /metrics schema is stable.
+                      "prefix_restored_tokens": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_paused_rounds": 0,
                       # Decode-state cache accounting (decode_state.py):
@@ -794,8 +836,14 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               affinity_key: Optional[str] = None) -> Request:
         """Enqueue a request. Returns immediately; tokens arrive via step().
+
+        ``affinity_key`` is a replica-routing concern (session/prefix
+        stickiness — :meth:`ReplicatedEngine.submit`); a single engine
+        has nowhere to route, so it is accepted and ignored here to keep
+        the two submit surfaces interchangeable.
 
         THREAD-SAFETY CONTRACT (load-bearing): AsyncEngine runs step() on
         its stepper thread *without* holding a lock while HTTP handlers
@@ -898,6 +946,51 @@ class InferenceEngine:
             return self.prefix_cache.allocate(n)
         return self.block_manager.allocate(n)
 
+    # -- prefix-cache tiering (demote / restore) -----------------------
+    def _fetch_block_kv(self, block: int):
+        """One physical block's KV rows from every layer pool, fetched
+        device→host for demotion into a lower tier. Runs on the stepper
+        thread at eviction time; ``self.cache`` then holds the committed
+        output of the last dispatched program, so the read sees every
+        write the block ever received. Payload keys follow the disk
+        format ("l00000": {"k": ..., "v": ..., int8 scales if present})."""
+        try:
+            rows = [{name: arr[block] for name, arr in layer.items()}
+                    for layer in self.cache]
+            if self._demote_sharding is not None:
+                # Stage through pinned_host: the D2H DMA lands in pinned
+                # memory the host reads without a bounce (TPU path).
+                rows = jax.device_put(rows, self._demote_sharding)
+            host = jax.device_get(rows)
+        except Exception as e:  # noqa: BLE001 — demotion is best-effort:
+            # a fetch failure degrades to the legacy discard, never
+            # faults the step loop that triggered the eviction.
+            self.logger.warning("prefix-tier demotion fetch failed "
+                                "(%s: %s); block discarded",
+                                type(e).__name__, e)
+            return None
+        return {f"l{i:05d}": {k: np.asarray(v) for k, v in r.items()}
+                for i, r in enumerate(host)}
+
+    def _restore_block(self, block: int, payload: dict) -> None:
+        """Scatter a tier-fetched payload into physical ``block`` of every
+        layer pool. Dispatch is async (jit): the scatter overlaps host-side
+        admission work, and the following prefill/decode programs see the
+        restored rows through the ``self.cache`` data dependency."""
+        if self._restore_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def restore(cache_kv, rows, bid):
+                return [
+                    {k: v.at[bid].set(r[k].astype(v.dtype)) for k, v in
+                     layer.items()}
+                    for layer, r in zip(cache_kv, rows)
+                ]
+
+            self._restore_fn = restore
+        rows = [payload[f"l{i:05d}"] for i in range(len(self.cache))]
+        self.cache = self._restore_fn(self.cache, rows,
+                                      jnp.asarray(block, jnp.int32))
+
     def _admit(self) -> None:
         """Admit waiting requests into free slots via bucketed prefill.
 
@@ -922,11 +1015,17 @@ class InferenceEngine:
             tokens = req.prompt_token_ids + req.output_token_ids
             cached_blocks: List[int] = []
             n_cached = 0
+            tier_keys: List[tuple] = []
             if self.prefix_cache is not None:
                 cached_blocks, n_cached = self.prefix_cache.match_prefix(tokens)
                 # Pin the matched blocks BEFORE allocating the suffix —
                 # otherwise the allocation's own eviction could reclaim them.
                 self.prefix_cache.acquire(cached_blocks)
+                # Continue the chain into host/disk tiers: these keys'
+                # payloads restore into freshly allocated blocks below
+                # (a restore scatter instead of a re-prefill).
+                tier_keys = self.prefix_cache.match_tiers(
+                    tokens, len(cached_blocks))
             need = (self.block_manager.blocks_needed(len(tokens) + 1)
                     - len(cached_blocks))
             blocks = self._alloc(need)
@@ -934,11 +1033,30 @@ class InferenceEngine:
                 if cached_blocks:
                     self.prefix_cache.release(cached_blocks)
                 break  # head-of-line blocking: FCFS, no starvation
-            if cached_blocks:
+            restored_by_tier: Dict[str, int] = {}
+            n_restored = 0
+            for j, key in enumerate(tier_keys):
+                # The alloc's own evictions may have demoted MORE blocks
+                # since the match, but never removed these keys (puts
+                # only add); a fetch can still miss if the alloc cascaded
+                # them off the bounded disk tier, or fail verification —
+                # either way the chain stops and the rest prefills.
+                payload, tier = self.prefix_cache.fetch_restore(key)
+                if payload is None:
+                    break
+                self._restore_block(blocks[j], payload)
+                self.prefix_cache.register_restored(key, blocks[j])
+                restored_by_tier[tier] = restored_by_tier.get(tier, 0) + 1
+                n_restored += 1
+            if self.prefix_cache is not None:
                 self.stats["prefix_cached_tokens"] += n_cached
-                self.prefix_cache.record_hit(cached_blocks)
+                self.stats["prefix_restored_tokens"] += \
+                    n_restored * self.cfg.block_size
+                self.prefix_cache.record_admission(cached_blocks,
+                                                   restored_by_tier)
             self.waiting.popleft()
-            admissions.append((slot, req, cached_blocks + blocks, n_cached))
+            n_prefix = n_cached + n_restored * self.cfg.block_size
+            admissions.append((slot, req, cached_blocks + blocks, n_prefix))
 
         if self.cfg.max_prefill_tokens_per_step > 0:
             # Chunked mode: register now, prefill in bounded chunks from

@@ -63,6 +63,11 @@ GATEWAY_METRIC_NAMES = (
     "dlti_gateway_shed_total",
     "dlti_gateway_retries_total",
     "dlti_gateway_replica_faults_total",
+    # Cache-affinity routing (ReplicatedEngine): requests routed to their
+    # sticky rendezvous-hash replica vs spilled least-loaded because the
+    # sticky target's backlog exceeded the spill threshold.
+    "dlti_gateway_affinity_sticky_total",
+    "dlti_gateway_affinity_spill_total",
 )
 
 
@@ -131,6 +136,9 @@ class _Pending:
     tenant: str
     priority: str
     deadline: Optional[float]  # absolute monotonic, None = none
+    # Session/prefix stickiness key for cache-affinity replica routing
+    # (None = least-loaded dispatch, the legacy behavior).
+    affinity_key: Optional[str] = None
     enqueue_t: float = field(default_factory=time.monotonic)
 
 
@@ -166,6 +174,21 @@ def tenant_from_headers(headers, default: str = "default") -> str:
     if auth:
         return "auth-" + hashlib.sha256(auth.encode()).hexdigest()[:12]
     return default
+
+
+def affinity_key_from(headers, prompt_token_ids,
+                      prefix_tokens: int = 32) -> str:
+    """Session/prefix key for cache-affinity replica routing.
+
+    ``X-Session`` wins (a chat client naming its conversation); else a
+    stable digest of the prompt's first ``prefix_tokens`` token ids — so
+    even session-less clients sharing a system prompt land on the replica
+    whose prefix cache already holds it."""
+    sess = headers.get("X-Session") if headers is not None else None
+    if sess:
+        return "sess-" + sess.strip()
+    ids = list(prompt_token_ids[:max(1, prefix_tokens)])
+    return "pfx-" + hashlib.sha256(repr(ids).encode()).hexdigest()[:16]
 
 
 def parse_tenant_weights(spec: str) -> Dict[str, float]:
@@ -239,6 +262,7 @@ class AdmissionGateway:
     def _scalars(self) -> dict:
         eng = self.async_engine.engine
         fail = getattr(eng, "failover", None) or {}
+        aff = getattr(eng, "affinity", None) or {}
         with self._lock:
             depth, toks, infl = (self._queued_requests, self._queued_tokens,
                                  len(self._inflight))
@@ -249,6 +273,8 @@ class AdmissionGateway:
             "gateway_replicas_alive": getattr(eng, "num_live", 1),
             "gateway_retries_total": fail.get("retries", 0),
             "gateway_replica_faults_total": fail.get("replica_faults", 0),
+            "gateway_affinity_sticky_total": aff.get("sticky", 0),
+            "gateway_affinity_spill_total": aff.get("spill", 0),
         }
 
     @property
@@ -259,7 +285,9 @@ class AdmissionGateway:
     def submit(self, prompt_token_ids, params: SamplingParams,
                request_id: str, *, tenant: Optional[str] = None,
                priority: str = "interactive",
-               deadline_s: float = 0.0) -> Tuple[GatewayRequest, queue.Queue]:
+               deadline_s: float = 0.0,
+               affinity_key: Optional[str] = None,
+               ) -> Tuple[GatewayRequest, queue.Queue]:
         """Admit or refuse synchronously. Returns ``(handle, event_queue)``
         — same event protocol as ``AsyncEngine.submit`` plus the terminal
         ``("reject", status, message)`` for post-admission sheds. Raises
@@ -309,7 +337,8 @@ class AdmissionGateway:
                 handle=handle, q=queue.Queue(), tenant=tenant,
                 priority=priority,
                 deadline=(time.monotonic() + deadline_s
-                          if deadline_s and deadline_s > 0 else None))
+                          if deadline_s and deadline_s > 0 else None),
+                affinity_key=affinity_key)
             dq = self._queues[priority].setdefault(tenant, collections.deque())
             if not dq:
                 # (Re)activating tenant: sync its virtual time to the
@@ -415,9 +444,13 @@ class AdmissionGateway:
                 entry.q.put(("done", "stop"))
                 continue
             try:
+                # affinity_key rides as a kwarg only when set, so engine
+                # facades predating it keep working with affinity off.
+                kw = ({"affinity_key": entry.affinity_key}
+                      if entry.affinity_key else {})
                 req, _ = self.async_engine.submit(
                     entry.handle.prompt_token_ids, entry.handle.params,
-                    entry.handle.request_id, q=entry.q)
+                    entry.handle.request_id, q=entry.q, **kw)
             except Exception as e:  # engine parked / all replicas dead
                 self._reject("engine_unavailable")
                 entry.q.put(("reject", 503, f"{type(e).__name__}: {e}"))

@@ -19,6 +19,7 @@ round-robin from one host thread overlaps their device work.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import time
@@ -83,6 +84,7 @@ class ReplicatedEngine:
         devices: Optional[Sequence] = None,
         max_retries: int = 2,
         fault_inject_step: str = "",
+        affinity_spill_threshold: int = 4,
     ):
         devices = list(devices if devices is not None else jax.devices())
         if replicas < 1 or tensor < 1:
@@ -109,8 +111,18 @@ class ReplicatedEngine:
             # default device.
             rep_params = (params if mesh is not None
                           else jax.device_put(params, group[0]))
+            rep_cfg = engine_cfg
+            if engine_cfg.prefix_disk_dir:
+                # Per-replica disk-tier namespace: one shared dir would
+                # let replica A's budget eviction delete a block dir
+                # replica B's index still points at.
+                import dataclasses
+
+                rep_cfg = dataclasses.replace(
+                    engine_cfg, prefix_disk_dir=os.path.join(
+                        engine_cfg.prefix_disk_dir, f"replica{r}"))
             self.engines.append(
-                InferenceEngine(model_cfg, rep_params, engine_cfg, lora_cfg,
+                InferenceEngine(model_cfg, rep_params, rep_cfg, lora_cfg,
                                 mesh=mesh, telemetry=self.telemetry))
         self._rr = 0
         # Own id namespace: each engine's req-N counter starts at 0, so
@@ -128,6 +140,16 @@ class ReplicatedEngine:
         # /stats name contract — stay untouched).
         self.failover = {"retries": 0, "replica_faults": 0,
                          "failover_errors": 0}
+        # Cache-affinity routing (the tiered-prefix-cache companion: a
+        # warm cache is per-replica, so repeat sessions must LAND on it).
+        # A submit carrying an affinity key routes by rendezvous hashing
+        # over the live replicas — stable under replica death (only keys
+        # sticky to the dead replica re-rank; everyone else stays warm) —
+        # with load-aware spill: when the sticky target's backlog exceeds
+        # its slots by more than affinity_spill_threshold, the request
+        # goes least-loaded instead (latency beats cache warmth).
+        self.affinity_spill_threshold = affinity_spill_threshold
+        self.affinity = {"sticky": 0, "spill": 0}
 
     # ------------------------------------------------------------------
     def _load(self, eng: InferenceEngine) -> int:
@@ -140,17 +162,44 @@ class ReplicatedEngine:
     def num_live(self) -> int:
         return len(self.engines) - len(self._dead)
 
+    def _sticky_target(self, key: str,
+                       live: List[InferenceEngine]) -> InferenceEngine:
+        """Rendezvous (highest-random-weight) hashing: every live replica
+        scores sha256(key:replica_index); the max wins. Removing a
+        replica re-ranks only the keys it owned — the property that keeps
+        the rest of the fleet's caches warm through a failover."""
+        def score(eng: InferenceEngine) -> bytes:
+            idx = self.engines.index(eng)
+            return hashlib.sha256(f"{key}:{idx}".encode()).digest()
+
+        return max(live, key=score)
+
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
-               request_id: Optional[str] = None) -> Request:
-        """Dispatch to the least-loaded live replica (round-robin tiebreak)."""
+               request_id: Optional[str] = None,
+               affinity_key: Optional[str] = None) -> Request:
+        """Dispatch to the least-loaded live replica (round-robin
+        tiebreak) — or, with an ``affinity_key``, to its sticky
+        rendezvous-hash target unless that replica's backlog exceeds its
+        decode slots by more than ``affinity_spill_threshold``."""
         live = self.live_engines()
         if not live:
             raise RuntimeError("all replicas dead (step faults); "
                                "engine cannot accept requests")
-        order = (live[self._rr % len(live):] + live[:self._rr % len(live)])
-        self._rr = (self._rr + 1) % len(live)
-        eng = min(order, key=self._load)
+        eng = None
+        if affinity_key:
+            sticky = self._sticky_target(affinity_key, live)
+            backlog = self._load(sticky) - sticky.cfg.max_seqs
+            if backlog <= self.affinity_spill_threshold:
+                eng = sticky
+                self.affinity["sticky"] += 1
+            else:
+                self.affinity["spill"] += 1
+        if eng is None:
+            order = (live[self._rr % len(live):]
+                     + live[:self._rr % len(live)])
+            self._rr = (self._rr + 1) % len(live)
+            eng = min(order, key=self._load)
         if request_id is None:
             request_id = f"rep-req-{next(self._req_counter)}"
         req = eng.submit(prompt_token_ids, params, request_id)

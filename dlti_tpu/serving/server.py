@@ -34,7 +34,8 @@ from dlti_tpu.config import GatewayConfig, TelemetryConfig
 from dlti_tpu.data.tokenizer import Tokenizer
 from dlti_tpu.serving.engine import InferenceEngine, Request
 from dlti_tpu.serving.gateway import (
-    AdmissionError, AdmissionGateway, PRIORITIES, tenant_from_headers,
+    AdmissionError, AdmissionGateway, PRIORITIES, affinity_key_from,
+    tenant_from_headers,
 )
 from dlti_tpu.serving.sampling import SamplingParams
 from dlti_tpu.telemetry import (
@@ -82,6 +83,32 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
 
     registry.register(alerts_total)
     registry.register(dumps_total)
+    # Tiered prefix-cache telemetry (module-level like the watchdog /
+    # flight counters, so replicas aggregate into one series): per-tier
+    # hit/miss/eviction/promotion/demotion counters + block gauges.
+    from dlti_tpu.serving import prefix_cache as _pc
+
+    for metric in (_pc.hits_total, _pc.misses_total, _pc.evictions_total,
+                   _pc.promotions_total, _pc.demotions_total,
+                   _pc.blocks_gauge):
+        registry.register(metric)
+
+    def _prefix_hit_rate() -> dict:
+        # Derived hit-rate gauge so /dashboard gets a ready-made series
+        # (the raw token counters are cumulative; a sparkline of the
+        # ratio is what a human actually reads during a run): fraction of
+        # prompt tokens served from cache — HBM hits plus lower-tier
+        # restores — over everything the engine handled.
+        s = async_engine.engine.stats
+        cached = s.get("prefix_cached_tokens", 0)
+        restored = s.get("prefix_restored_tokens", 0)
+        total = cached + restored + s.get("prefill_tokens", 0)
+        return {"prefix_cache_hit_rate":
+                (cached + restored) / total if total else 0.0}
+
+    registry.add_scalar_source(_prefix_hit_rate,
+                               gauge_keys=("prefix_cache_hit_rate",),
+                               prefix="dlti_")
     return registry
 
 
@@ -146,6 +173,7 @@ class AsyncEngine:
     def submit(self, prompt_ids: List[int], params: SamplingParams,
                request_id: Optional[str] = None,
                q: Optional[queue.Queue] = None,
+               affinity_key: Optional[str] = None,
                ) -> Tuple[Request, queue.Queue]:
         """Enqueue a request; returns (request, event queue).
 
@@ -153,14 +181,18 @@ class AsyncEngine:
         then ``("done", finish_reason)`` — or ``("error", message)``.
         ``q`` lets a caller that pre-created the consumer queue (the
         admission gateway hands it to the HTTP handler before dispatch)
-        receive events on its own instance.
+        receive events on its own instance. ``affinity_key`` rides through
+        to the engine's submit (session/prefix replica stickiness — a
+        no-op on a single engine).
         """
         q = q if q is not None else queue.Queue()
         with self._work:
             if self._dead:
                 raise RuntimeError(
                     "engine is down (unrecoverable step fault)")
-            req = self.engine.submit(prompt_ids, params, request_id)
+            req = self.engine.submit(
+                prompt_ids, params, request_id,
+                **({"affinity_key": affinity_key} if affinity_key else {}))
             self._queues[req.request_id] = q
             self._seen[req.request_id] = 0
             self._work.notify()
@@ -570,6 +602,7 @@ class _Handler(BaseHTTPRequestHandler):
         # a bad value 400s this request, same contract as sampling params.
         tenant = priority = None
         deadline_s = 0.0
+        affinity_key = None
         if self.gateway is not None:
             tenant = tenant_from_headers(
                 self.headers, self.gateway.cfg.default_tenant)
@@ -583,12 +616,19 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s = float(body.get("deadline_s", 0) or 0)
             except (TypeError, ValueError):
                 return self._error(400, "deadline_s must be a number")
+            if self.gateway.cfg.affinity:
+                # Cache-affinity routing: a session (X-Session) or
+                # hashed prompt-prefix key makes repeat traffic land on
+                # the replica whose prefix cache is already warm.
+                affinity_key = affinity_key_from(
+                    self.headers, prompt_ids,
+                    self.gateway.cfg.affinity_prefix_tokens)
 
         def _submit(p_ids, p, rid_):
             if self.gateway is not None:
                 return self.gateway.submit(
                     p_ids, p, rid_, tenant=tenant, priority=priority,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, affinity_key=affinity_key)
             return self.async_engine.submit(p_ids, p, rid_)
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]

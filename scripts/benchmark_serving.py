@@ -59,6 +59,18 @@ def main() -> None:
                    help="per-request queued-deadline seconds (body "
                         "deadline_s; a gateway sheds expired queued "
                         "requests with 503)")
+    p.add_argument("--sessions", type=int, default=0,
+                   help="recurring-session (chat-shaped) mode: N concurrent "
+                        "sessions each replaying a shared system prompt + "
+                        "growing history with an X-Session header; the "
+                        "report splits cold vs warm TTFT percentiles and "
+                        "scrapes the server's prefix-cache hit rate "
+                        "(num-requests is ignored: sessions x turns)")
+    p.add_argument("--turns", type=int, default=4,
+                   help="requests per session in --sessions mode")
+    p.add_argument("--reuse-frac", type=float, default=1.0,
+                   help="fraction of non-first turns that revisit their "
+                        "session; the rest issue unrelated cold one-offs")
     p.add_argument("--scrape-server-metrics", action="store_true",
                    help="attach the server's on-engine histogram "
                         "summaries (/metrics) to the report")
@@ -82,6 +94,8 @@ def main() -> None:
         tenants=args.tenants, priority_mix=args.priority_mix,
         deadline_s=args.deadline,
         scrape_server_metrics=args.scrape_server_metrics,
+        sessions=args.sessions, turns=args.turns,
+        reuse_frac=args.reuse_frac,
     )
     report = run_load_test(cfg)
     d = report.to_dict()

@@ -51,6 +51,22 @@ def parse_args():
     p.add_argument("--enable-prefix-caching", action="store_true",
                    help="reuse KV blocks across requests sharing a prompt "
                         "prefix (content-addressed, LRU-evicted)")
+    # -- prefix-cache tiering (dlti_tpu.serving.prefix_tiers) -----------
+    p.add_argument("--prefix-host-blocks", type=int, default=0,
+                   help="host-RAM prefix tier budget in KV blocks: evicted "
+                        "HBM prefix blocks demote here instead of being "
+                        "discarded, and restore with one host->device "
+                        "scatter instead of a re-prefill (0 = tier off; "
+                        "implies --enable-prefix-caching)")
+    p.add_argument("--prefix-disk-dir", default="",
+                   help="disk prefix tier directory: host-tier overflow "
+                        "demotes to digest-verified block dirs here "
+                        "(checkpoint-store manifest/SHA-256 format; corrupt "
+                        "blocks quarantine to _quarantine/ and read as "
+                        "misses)")
+    p.add_argument("--prefix-disk-blocks", type=int, default=0,
+                   help="disk prefix tier budget in block dirs (0 = disk "
+                        "tier off; needs --prefix-disk-dir)")
     p.add_argument("--tensor", type=int, default=1,
                    help="tensor-parallel extent: shard weights + KV pools "
                         "over this many chips (ICI collectives via GSPMD)")
@@ -89,6 +105,19 @@ def parse_args():
                    help="chaos hook 'REPLICA:STEP': kill that replica on "
                         "its STEP-th step (also env "
                         "DLTI_GATEWAY_FAULT_INJECT)")
+    p.add_argument("--affinity", action="store_true",
+                   help="cache-affinity routing: sticky rendezvous-hash a "
+                        "session key (X-Session header, else hashed prompt "
+                        "prefix) to a replica so repeat sessions land on "
+                        "warm prefix caches; spills least-loaded past the "
+                        "backlog threshold (needs --gateway)")
+    p.add_argument("--affinity-spill-threshold", type=int, default=4,
+                   help="spill to least-loaded when the sticky replica's "
+                        "backlog exceeds its decode slots by more than "
+                        "this many requests")
+    p.add_argument("--affinity-prefix-tokens", type=int, default=32,
+                   help="prompt tokens hashed into the affinity key when "
+                        "no X-Session header is present")
     p.add_argument("--steps-per-sync", type=int, default=1,
                    help="decode iterations per compiled program (multi-step "
                         "scheduling; amortizes host round-trips)")
@@ -204,11 +233,16 @@ def main() -> None:
                             jnp.zeros((1, 8), jnp.int32))["params"]
         print(f"random-initialized preset {args.random_init}")
 
+    tiered = args.prefix_host_blocks > 0 or (
+        args.prefix_disk_blocks > 0 and args.prefix_disk_dir)
     ec = EngineConfig(
         max_seqs=args.max_seqs, block_size=args.block_size,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         eos_token_id=tok.eos_id,
-        enable_prefix_caching=args.enable_prefix_caching,
+        enable_prefix_caching=args.enable_prefix_caching or tiered,
+        prefix_host_blocks=args.prefix_host_blocks,
+        prefix_disk_dir=args.prefix_disk_dir,
+        prefix_disk_blocks=args.prefix_disk_blocks,
         steps_per_sync=args.steps_per_sync,
         cache_dtype=args.kv_cache_dtype,
         quantization=args.quantization,
@@ -228,7 +262,8 @@ def main() -> None:
             model_cfg, params, ec, lora_cfg,
             replicas=args.replicas, tensor=args.tensor,
             max_retries=args.max_retries,
-            fault_inject_step=args.fault_inject_step)
+            fault_inject_step=args.fault_inject_step,
+            affinity_spill_threshold=args.affinity_spill_threshold)
     else:
         mesh = None
         if args.tensor > 1:
@@ -255,7 +290,10 @@ def main() -> None:
             tenant_weights=args.tenant_weights,
             drain_grace_s=args.drain_grace,
             max_retries=args.max_retries,
-            fault_inject_step=args.fault_inject_step)
+            fault_inject_step=args.fault_inject_step,
+            affinity=args.affinity,
+            affinity_spill_threshold=args.affinity_spill_threshold,
+            affinity_prefix_tokens=args.affinity_prefix_tokens)
     from dlti_tpu.config import (
         FlightRecorderConfig, TelemetryConfig, WatchdogConfig,
     )

@@ -97,7 +97,32 @@ def test_gateway_metric_names_are_schema_stable():
         "dlti_gateway_shed_total",
         "dlti_gateway_retries_total",
         "dlti_gateway_replica_faults_total",
+        "dlti_gateway_affinity_sticky_total",
+        "dlti_gateway_affinity_spill_total",
     )
+
+
+def test_prefix_cache_metric_names_are_schema_stable():
+    """Tiered prefix-cache telemetry names are a scrape contract like the
+    gateway set: per-tier (tier="hbm" | "host" | "disk") hit / miss /
+    eviction / promotion / demotion counters plus the per-tier block
+    gauge, all registered by the server registry."""
+    from dlti_tpu.serving import prefix_cache as pc
+
+    assert pc.PREFIX_CACHE_METRIC_NAMES == (
+        "dlti_prefix_cache_hits_total",
+        "dlti_prefix_cache_misses_total",
+        "dlti_prefix_cache_evictions_total",
+        "dlti_prefix_cache_promotions_total",
+        "dlti_prefix_cache_demotions_total",
+        "dlti_prefix_cache_blocks",
+    )
+    assert pc.hits_total.name == pc.PREFIX_CACHE_METRIC_NAMES[0]
+    assert pc.misses_total.name == pc.PREFIX_CACHE_METRIC_NAMES[1]
+    assert pc.evictions_total.name == pc.PREFIX_CACHE_METRIC_NAMES[2]
+    assert pc.promotions_total.name == pc.PREFIX_CACHE_METRIC_NAMES[3]
+    assert pc.demotions_total.name == pc.PREFIX_CACHE_METRIC_NAMES[4]
+    assert pc.blocks_gauge.name == pc.PREFIX_CACHE_METRIC_NAMES[5]
 
 
 def test_host_overlap_metric_names_are_schema_stable():
@@ -228,6 +253,10 @@ def test_load_report_schema_includes_gateway_fields():
         # Watchdog-era additions: the server's own anomaly verdict from
         # the end-of-run /debug/vars scrape.
         "watchdog_alerts", "peak_queue_depth",
+        # Recurring-session (prefix-tiering) additions: cold-vs-warm TTFT
+        # split + the server-scraped cache hit rate.
+        "num_cold", "num_warm", "cold_ttft_p50_s", "cold_ttft_p90_s",
+        "warm_ttft_p50_s", "warm_ttft_p90_s", "cache_hit_rate",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -277,6 +277,94 @@ def test_gateway_metric_names_exposed():
 
 
 # ----------------------------------------------------------------------
+# Cache-affinity routing units (fake replicas: no decode, no jit)
+# ----------------------------------------------------------------------
+
+def _fake_replicated(n: int, max_seqs: int = 4, spill_threshold: int = 4):
+    """A ReplicatedEngine skeleton around load-controllable fakes — the
+    routing logic under test is pure host code over engines' load/cfg."""
+
+    def _mk(i):
+        eng = types.SimpleNamespace(
+            idx=i, waiting=[], num_active=0,
+            cfg=types.SimpleNamespace(max_seqs=max_seqs))
+        eng.submit = lambda ids, params, rid, _e=eng: types.SimpleNamespace(
+            request_id=rid, engine=_e)
+        return eng
+
+    import itertools
+
+    rep = ReplicatedEngine.__new__(ReplicatedEngine)
+    rep.engines = [_mk(i) for i in range(n)]
+    rep._dead = set()
+    rep._rr = 0
+    rep._req_counter = itertools.count()
+    rep.affinity_spill_threshold = spill_threshold
+    rep.affinity = {"sticky": 0, "spill": 0}
+    return rep
+
+
+def test_affinity_rendezvous_is_sticky_and_spreads():
+    rep = _fake_replicated(3)
+    keys = [f"sess-{i}" for i in range(30)]
+    owner = {k: rep._sticky_target(k, rep.live_engines()).idx for k in keys}
+    # Deterministic: resubmitting a key always lands on the same replica.
+    for k in keys:
+        req = rep.submit([1, 2, 3], SamplingParams(), f"r-{k}",
+                         affinity_key=k)
+        assert req.engine.idx == owner[k]
+    assert rep.affinity["sticky"] == 30 and rep.affinity["spill"] == 0
+    # And it actually spreads sessions (not a degenerate hash).
+    assert len(set(owner.values())) == 3
+
+
+def test_affinity_rendezvous_stable_under_replica_death():
+    """Killing one replica re-ranks ONLY the keys it owned — every other
+    session keeps its (warm) target. The property that makes failover
+    cheap for the fleet's caches."""
+    rep = _fake_replicated(3)
+    keys = [f"sess-{i}" for i in range(60)]
+    before = {k: rep._sticky_target(k, rep.live_engines()).idx for k in keys}
+    rep._dead.add(1)
+    after = {k: rep._sticky_target(k, rep.live_engines()).idx for k in keys}
+    for k in keys:
+        if before[k] != 1:
+            assert after[k] == before[k], f"{k} moved off a live replica"
+        else:
+            assert after[k] in (0, 2)  # orphans re-rank to survivors
+
+
+def test_affinity_spills_least_loaded_past_backlog_threshold():
+    rep = _fake_replicated(2, max_seqs=2, spill_threshold=1)
+    key = "sess-hot"
+    sticky = rep._sticky_target(key, rep.live_engines())
+    other = next(e for e in rep.engines if e is not sticky)
+    # Backlog = load - max_seqs = 4 - 2 = 2 > threshold 1: spill.
+    sticky.num_active = 2
+    sticky.waiting = [object(), object()]
+    req = rep.submit([1], SamplingParams(), "r0", affinity_key=key)
+    assert req.engine is other
+    assert rep.affinity == {"sticky": 0, "spill": 1}
+    # Backlog back under threshold: sticky again.
+    sticky.waiting = []
+    req = rep.submit([1], SamplingParams(), "r1", affinity_key=key)
+    assert req.engine is sticky
+    assert rep.affinity == {"sticky": 1, "spill": 1}
+
+
+def test_affinity_key_from_headers_and_prefix():
+    from dlti_tpu.serving.gateway import affinity_key_from
+
+    # X-Session wins over the prompt digest.
+    assert affinity_key_from({"X-Session": "abc "}, [1, 2, 3]) == "sess-abc"
+    # Session-less: same prompt prefix -> same key, regardless of tail.
+    k1 = affinity_key_from({}, list(range(64)), prefix_tokens=32)
+    k2 = affinity_key_from({}, list(range(32)) + [99] * 32, prefix_tokens=32)
+    k3 = affinity_key_from({}, [7] + list(range(63)), prefix_tokens=32)
+    assert k1 == k2 and k1 != k3 and k1.startswith("pfx-")
+
+
+# ----------------------------------------------------------------------
 # Full-stack integration (real engine + HTTP)
 # ----------------------------------------------------------------------
 
@@ -578,23 +666,35 @@ def test_replica_warmup_aot_stays_engaged_off_default_device(devices):
 
 
 def test_replica_kill_failover_through_server(devices):
-    """Acceptance: with one replica fault-injected mid-run, its in-flight
-    requests complete on the survivor — client error rate from the fault
-    is 0 and the retries are visible in dlti_gateway_retries_total."""
+    """Acceptance: with affinity routing on and one replica fault-injected
+    mid-run, its in-flight requests complete on the survivor — client
+    error rate from the fault is 0, the retries are visible in
+    dlti_gateway_retries_total, and sessions that were sticky to the dead
+    replica re-route to the survivor and still complete."""
     ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=128,
                       max_model_len=128, cache_dtype="float32",
                       eos_token_id=-1)
     rep = ReplicatedEngine(CFG, _tiny_params(), ec, replicas=2, tensor=1,
                            devices=devices[:2], max_retries=2,
                            fault_inject_step="0:3")
-    gw_cfg = GatewayConfig(enabled=True, max_queued_requests=64)
+    gw_cfg = GatewayConfig(enabled=True, max_queued_requests=64,
+                           affinity=True)
+    # With 2 replicas, 6 sessions hash to both sides — some are sticky to
+    # the replica the chaos hook is about to kill.
+    sessions = [f"sess-{i}" for i in range(6)]
+    doomed = [s for s in sessions
+              if rep._sticky_target("sess-" + s, rep.live_engines())
+              is rep.engines[0]]
+    assert doomed, "rendezvous hash left replica 0 unused; test is vacuous"
     httpd, aeng, port = _start_server(rep, gw_cfg)
     try:
         results = [None] * 6
 
         def _one(i):
-            results[i] = _post(port, "/v1/completions", {
-                "prompt": f"req {i}", "max_tokens": 12, "temperature": 0.0})
+            results[i] = _post(
+                port, "/v1/completions",
+                {"prompt": f"req {i}", "max_tokens": 12, "temperature": 0.0},
+                headers={"X-Session": sessions[i]})
 
         threads = [threading.Thread(target=_one, args=(i,))
                    for i in range(6)]
@@ -608,7 +708,21 @@ def test_replica_kill_failover_through_server(devices):
             assert obj["usage"]["completion_tokens"] == 12, obj
         assert rep.num_live == 1
         assert rep.failover["retries"] >= 1
-        # Retries are on /metrics under the contract name.
+        assert rep.affinity["sticky"] >= 1
+
+        # Sessions sticky to the DEAD replica re-route: rendezvous over
+        # the survivors now owns them, and their follow-up turns complete
+        # with zero client errors.
+        for s in doomed:
+            status, data, _ = _post(
+                port, "/v1/completions",
+                {"prompt": f"follow-up {s}", "max_tokens": 6,
+                 "temperature": 0.0},
+                headers={"X-Session": s})
+            assert status == 200, (s, status, data)
+            assert json.loads(data)["usage"]["completion_tokens"] == 6
+
+        # Retries + affinity counters are on /metrics under contract names.
         status, data = _get(port, "/metrics")
         assert status == 200
         text = data.decode()
@@ -618,5 +732,8 @@ def test_replica_kill_failover_through_server(devices):
         line = next(l for l in text.splitlines()
                     if l.startswith("dlti_gateway_replicas_alive "))
         assert float(line.split()[1]) == 1
+        line = next(l for l in text.splitlines()
+                    if l.startswith("dlti_gateway_affinity_sticky_total "))
+        assert float(line.split()[1]) >= 1
     finally:
         _stop_server(httpd, aeng)

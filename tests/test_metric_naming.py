@@ -36,12 +36,14 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
+    from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
     from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
     from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
 
     for tup, where in ((CKPT_METRIC_NAMES, "checkpoint"),
                        (PREFETCH_METRIC_NAMES, "prefetch"),
                        (GATEWAY_METRIC_NAMES, "gateway"),
+                       (PREFIX_CACHE_METRIC_NAMES, "prefix_cache"),
                        (WATCHDOG_METRIC_NAMES, "watchdog"),
                        (FLIGHT_METRIC_NAMES, "flightrecorder"),
                        (ELASTIC_METRIC_NAMES, "elastic")):
@@ -115,7 +117,10 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_watchdog_alerts_total",
                      "dlti_flight_dumps_total",
                      "dlti_trace_dropped_events",
-                     "dlti_train_prefetch_queue_depth"):
+                     "dlti_train_prefetch_queue_depth",
+                     "dlti_prefix_cache_hits_total",
+                     "dlti_prefix_cache_blocks",
+                     "dlti_prefix_cache_hit_rate"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")
 

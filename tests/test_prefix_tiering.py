@@ -1,0 +1,399 @@
+"""Hierarchical prefix-cache tiering (HBM → host → disk).
+
+Three layers, bottom-up:
+
+* **Allocator edge cases** that predate tiering but were untested —
+  eviction ordering under mixed refcounts, partial-block tails,
+  double-release, acquire-after-evict contract violations. Pure host
+  data structures, no jit: these run in the tier-1 gate.
+* **TieredBlockStore units** — demote/promote round-trip byte equality,
+  host→disk cascade, disk budget eviction, and the corrupt-block
+  quarantine path (bit-flip and truncation both read as a miss, never an
+  error, with the bytes preserved under ``_quarantine/``).
+* **Engine integration** (slow: jit compiles) — outputs are
+  byte-identical with tiering on vs off while the tiers absorb real
+  eviction traffic, restores replace re-prefill on the measured path,
+  and a corrupted disk tier degrades to misses without failing a single
+  request.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from dlti_tpu.serving.block_manager import BlockManager
+from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
+from dlti_tpu.serving.prefix_tiers import TieredBlockStore, key_digest
+
+
+def _payload(block: int, layers: int = 2) -> dict:
+    """A recognizable per-block payload (content encodes the block id)."""
+    rng = np.random.default_rng(block)
+    return {f"l{i:05d}": {
+        "k": rng.standard_normal((4, 2, 3)).astype(np.float32),
+        "v": np.full((4, 2, 3), block * 10 + i, np.float32),
+    } for i in range(layers)}
+
+
+def _alloc_with_store(num_blocks=8, block_size=4, **store_kw):
+    bm = BlockManager(num_blocks=num_blocks, block_size=block_size)
+    store = TieredBlockStore(**store_kw) if store_kw else None
+    fetched = {}
+
+    def kv_fetch(block):
+        fetched[block] = _payload(block)
+        return fetched[block]
+
+    pc = PrefixCachingAllocator(bm, tier_store=store,
+                                kv_fetch=kv_fetch if store else None)
+    return pc, bm, store, fetched
+
+
+def _register(pc, tokens):
+    """Prefill-shaped registration: allocate, then retire the sequence so
+    its full blocks enter the cache at refcount 0."""
+    n = -(-len(tokens) // pc.block_size)
+    blocks = pc.allocate(n)
+    assert blocks is not None
+    pc.release_sequence(tokens, blocks)
+    return blocks
+
+
+# ----------------------------------------------------------------------
+# Allocator edge cases (previously untested, pre-tiering semantics)
+# ----------------------------------------------------------------------
+
+def test_eviction_order_mixed_refcounts():
+    """Eviction is LRU over refcount-0 entries ONLY: an older but pinned
+    chain survives while a younger unpinned one demotes, in its own
+    registration order."""
+    pc, bm, store, _ = _alloc_with_store(num_blocks=8, host_blocks=10)
+    tok_a = list(range(8))          # older
+    tok_b = list(range(100, 108))   # younger
+    _register(pc, tok_a)
+    _register(pc, tok_b)
+    m, _ = pc.match_prefix(tok_a + [9])
+    pc.acquire(m)  # pin A (older) — B is now the only evictable chain
+
+    assert pc.allocate(5) is not None  # free=3: must evict both B blocks
+    b_keys = PrefixCachingAllocator._chain_keys(tok_b, 4)
+    assert [store.tier_of(k) for k in b_keys] == ["host", "host"]
+    # Demotion preserved LRU (registration) order: b0 before b1.
+    assert list(store._host.keys()) == b_keys
+    # A never moved: still cached in HBM, nothing of it in the tiers.
+    for k in PrefixCachingAllocator._chain_keys(tok_a, 4):
+        assert store.tier_of(k) is None
+    m2, n2 = pc.match_prefix(tok_a + [9])
+    assert n2 == 8 and m2 == m
+
+
+def test_partial_block_tail_never_cached_or_demoted():
+    """The partial tail block is exclusively owned: it goes straight back
+    to the pool at retirement and can never demote into a tier."""
+    pc, bm, store, _ = _alloc_with_store(num_blocks=8, host_blocks=10)
+    tokens = list(range(10))  # 2 full blocks + a 2-token tail
+    free_before = bm.num_free
+    _register(pc, tokens)
+    assert pc.num_cached_blocks == 2
+    assert bm.num_free == free_before - 2  # tail block freed immediately
+
+    assert pc.allocate(7) is not None  # evict (and demote) everything
+    assert store.num_host_blocks == 2
+    # The tier chain for the full token list stops at the 2 full blocks.
+    assert len(pc.match_tiers(tokens + [42], 0)) == 2
+
+
+def test_double_release_raises_not_underflows():
+    pc, _, _, _ = _alloc_with_store(num_blocks=8)
+    tokens = list(range(4))
+    _register(pc, tokens)
+    [b] = pc.match_prefix(tokens + [5])[0]
+    pc.acquire([b])
+    pc.release([b])
+    with pytest.raises(ValueError, match="matching acquire"):
+        pc.release([b])  # refcount is 0: a second release must not go -1
+    with pytest.raises(ValueError, match="not cached"):
+        pc.release([b + 1])  # never-cached block id
+
+
+def test_acquire_after_evict_raises_all_or_nothing():
+    """A caller that allocates between match_prefix and acquire (contract
+    violation) can see its matched block evicted; the acquire must fail
+    loudly AND undo any refs it already took."""
+    pc, _, _, _ = _alloc_with_store(num_blocks=8, host_blocks=10)
+    tok_a, tok_b = list(range(4)), list(range(50, 54))
+    _register(pc, tok_a)
+    _register(pc, tok_b)
+    [a] = pc.match_prefix(tok_a + [9])[0]
+    [b] = pc.match_prefix(tok_b + [9])[0]
+    assert pc.allocate(6) is not None  # evicts BOTH cached blocks
+    with pytest.raises(ValueError, match="evicted between"):
+        pc.acquire([a])
+    # All-or-nothing: a partially-valid acquire leaves no stray refs.
+    tok_c = list(range(80, 84))
+    [c] = _register(pc, tok_c)[:1]
+    with pytest.raises(ValueError):
+        pc.acquire([c, 99])  # 99: never cached
+    # c's refcount went back to 0 — still evictable, pool fully drains.
+    assert pc.allocate(1) is not None
+
+
+def test_restored_block_reenters_cache_pinned():
+    pc, _, store, _ = _alloc_with_store(num_blocks=8, host_blocks=4)
+    tokens = list(range(4))
+    _register(pc, tokens)
+    assert pc.allocate(7) is not None  # demote it
+    [key] = pc.match_tiers(tokens + [9], 0)
+    payload, tier = pc.fetch_restore(key)
+    assert tier == "host" and payload is not None
+    pc.release_sequence([], [])  # no-op; keeps gauges callable
+    pc.register_restored(key, block=1)
+    # Pinned for the admitting sequence: not evictable until released.
+    assert pc.num_reclaimable == 0
+    m, n = pc.match_prefix(tokens + [9])
+    assert m == [1] and n == 4
+    pc.release([1])
+    assert pc.num_reclaimable == 1
+
+
+# ----------------------------------------------------------------------
+# TieredBlockStore units
+# ----------------------------------------------------------------------
+
+def test_host_round_trip_byte_equality():
+    store = TieredBlockStore(host_blocks=4)
+    key = ((), (1, 2, 3, 4))
+    p = _payload(7)
+    store.put(key, p)
+    got, tier = store.fetch(key)
+    assert tier == "host"
+    for layer in p:
+        for name in p[layer]:
+            a, b = p[layer][name], got[layer][name]
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes()
+    # fetch pops: a second fetch is a miss (the block went back to HBM).
+    assert store.fetch(key) == (None, None)
+
+
+def test_disk_round_trip_byte_equality(tmp_path):
+    store = TieredBlockStore(host_blocks=0, disk_dir=str(tmp_path),
+                             disk_blocks=4)
+    key = ((), (9, 9, 9, 9))
+    p = _payload(3)
+    assert store.put(key, p) == "disk"
+    got, tier = store.fetch(key)
+    assert tier == "disk"
+    for layer in p:
+        for name in p[layer]:
+            assert p[layer][name].tobytes() == got[layer][name].tobytes()
+            assert p[layer][name].dtype == got[layer][name].dtype
+    # Promotion removed the block dir (budgets stay meaningful).
+    assert not glob.glob(os.path.join(str(tmp_path), "block-*"))
+
+
+def test_host_overflow_cascades_to_disk(tmp_path):
+    store = TieredBlockStore(host_blocks=1, disk_dir=str(tmp_path),
+                             disk_blocks=8)
+    k1, k2 = ((), (1,)), ((), (2,))
+    store.put(k1, _payload(1))
+    store.put(k2, _payload(2))  # k1 (LRU) cascades down
+    assert store.tier_of(k1) == "disk" and store.tier_of(k2) == "host"
+    got, tier = store.fetch(k1)
+    assert tier == "disk" and got is not None
+    assert store.stats["host_puts"] == 2 and store.stats["disk_puts"] == 1
+
+
+def test_disk_budget_evicts_oldest_block_dir(tmp_path):
+    store = TieredBlockStore(disk_dir=str(tmp_path), disk_blocks=2)
+    keys = [((), (i,)) for i in range(3)]
+    for i, k in enumerate(keys):
+        store.put(k, _payload(i))
+    assert store.tier_of(keys[0]) is None  # oldest fell off the edge
+    assert store.stats["disk_evictions"] == 1
+    assert not os.path.isdir(
+        os.path.join(str(tmp_path), f"block-{key_digest(keys[0])}"))
+    assert store.num_disk_blocks == 2
+
+
+def test_duplicate_put_is_dropped():
+    store = TieredBlockStore(host_blocks=4)
+    key = ((), (5,))
+    assert store.put(key, _payload(1)) == "host"
+    assert store.put(key, _payload(2)) is None  # same content key
+    assert store.num_host_blocks == 1
+
+
+def test_put_without_tiers_drops_payload(tmp_path):
+    assert TieredBlockStore().put(((), (1,)), _payload(0)) is None
+    with pytest.raises(ValueError, match="disk_dir"):
+        TieredBlockStore(disk_blocks=4)
+
+
+# ----------------------------------------------------------------------
+# Corrupt-tier robustness: quarantine, miss, never a fault
+# ----------------------------------------------------------------------
+
+def _one_disk_block(tmp_path):
+    store = TieredBlockStore(disk_dir=str(tmp_path), disk_blocks=4)
+    key = ((), (1, 2, 3, 4))
+    store.put(key, _payload(11))
+    [path] = [p for k, p in store._disk.items() if k == key]
+    return store, key, path
+
+
+def test_bitflipped_disk_block_is_quarantined_miss(tmp_path):
+    store, key, path = _one_disk_block(tmp_path)
+    victim = sorted(glob.glob(os.path.join(path, "**", "*.bin"),
+                              recursive=True))[0]
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+
+    assert store.fetch(key) == (None, None)  # miss, not an exception
+    assert store.stats["corrupt_dropped"] == 1
+    qdirs = glob.glob(os.path.join(str(tmp_path), "_quarantine", "*"))
+    assert len(qdirs) == 1 and "CheckpointCorruptError" in qdirs[0]
+    assert not os.path.isdir(path)  # index and live dir both gone
+
+
+def test_truncated_disk_block_is_quarantined_miss(tmp_path):
+    store, key, path = _one_disk_block(tmp_path)
+    victim = sorted(glob.glob(os.path.join(path, "**", "*.bin"),
+                              recursive=True))[0]
+    raw = open(victim, "rb").read()
+    open(victim, "wb").write(raw[: max(1, len(raw) // 2)])
+    assert store.fetch(key) == (None, None)
+    assert store.stats["corrupt_dropped"] == 1
+    assert glob.glob(os.path.join(str(tmp_path), "_quarantine", "*"))
+
+
+def test_missing_manifest_is_quarantined_miss(tmp_path):
+    store, key, path = _one_disk_block(tmp_path)
+    os.remove(os.path.join(path, "MANIFEST.json"))
+    assert store.fetch(key) == (None, None)
+    assert store.stats["corrupt_dropped"] == 1
+
+
+def test_allocator_counts_corruption_as_tier_miss(tmp_path):
+    """fetch_restore surfaces the quarantine as a plain miss plus the
+    tier_corrupt_dropped stat the /stats schema carries."""
+    pc, _, store, _ = _alloc_with_store(num_blocks=8, disk_dir=str(tmp_path),
+                                        disk_blocks=8)
+    tokens = list(range(4))
+    _register(pc, tokens)
+    assert pc.allocate(7) is not None  # demote to disk
+    [key] = pc.match_tiers(tokens + [9], 0)
+    for f in glob.glob(os.path.join(str(tmp_path), "block-*", "**", "*.bin"),
+                       recursive=True):
+        open(f, "wb").write(b"garbage")
+    assert pc.fetch_restore(key) == (None, None)
+    assert pc.stats["tier_corrupt_dropped"] == 1
+
+
+# ----------------------------------------------------------------------
+# Engine integration (jit-heavy: slow tier, like test_prefix_cache.py)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    import jax
+    import jax.numpy as jnp
+
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.models import LlamaForCausalLM
+
+    cfg = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(cfg, None)
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _engine(params, **kw):
+    from dlti_tpu.config import MODEL_PRESETS
+    from dlti_tpu.serving import EngineConfig, InferenceEngine
+
+    defaults = dict(max_seqs=1, block_size=8, num_blocks=7, max_model_len=40,
+                    cache_dtype="float32", eos_token_id=-1,
+                    enable_prefix_caching=True)
+    defaults.update(kw)
+    return InferenceEngine(MODEL_PRESETS["llama_tiny"], params,
+                           EngineConfig(**defaults))
+
+
+def _session_prompts():
+    # 4 "sessions": shared 8-token block + per-session block + tail. An
+    # HBM pool of 6 allocatable blocks cannot hold all of them at once.
+    return [[i] * 8 + [7] * 8 + [1, 2, 3] for i in range(4)]
+
+
+@pytest.mark.slow
+def test_engine_tiered_outputs_byte_identical_and_prefill_saved(tmp_path,
+                                                                tiny_params):
+    """Acceptance: tiering on vs off is byte-identical, while the tiers
+    absorb eviction traffic and restores replace re-prefill."""
+    from dlti_tpu.serving import SamplingParams
+
+    tiered = _engine(tiny_params, prefix_host_blocks=2,
+                     prefix_disk_dir=str(tmp_path), prefix_disk_blocks=16)
+    plain = _engine(tiny_params)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    for _ in range(2):  # round 2 revisits everything the pool evicted
+        for p in _session_prompts():
+            [rt] = tiered.generate([p], sp)
+            [rp] = plain.generate([p], sp)
+            assert rt.output_token_ids == rp.output_token_ids
+    assert tiered.stats["prefix_restored_tokens"] > 0
+    assert tiered.prefix_cache.stats["demotions"] > 0
+    assert tiered.prefix_cache.tier_store.stats["disk_hits"] > 0
+    # The headline: restores shrink prefill below the untier'd engine's.
+    assert tiered.stats["prefill_tokens"] < plain.stats["prefill_tokens"]
+
+
+@pytest.mark.slow
+def test_engine_host_tier_only_round_trip(tiny_params):
+    from dlti_tpu.serving import SamplingParams
+
+    eng = _engine(tiny_params, prefix_host_blocks=8)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    outs = {}
+    for p in _session_prompts():
+        [r] = eng.generate([p], sp)
+        outs[tuple(p)] = r.output_token_ids
+    for p in _session_prompts():
+        [r] = eng.generate([p], sp)
+        assert r.output_token_ids == outs[tuple(p)]
+    assert eng.prefix_cache.tier_store.stats["host_hits"] > 0
+    assert eng.stats["prefix_restored_tokens"] > 0
+
+
+@pytest.mark.slow
+def test_engine_corrupt_disk_tier_degrades_to_miss(tmp_path, tiny_params):
+    """Chaos: every on-disk block bit-flipped mid-run. Requests still
+    complete with byte-identical outputs (the tier reads as cold), the
+    blocks are quarantined, and the engine never faults."""
+    from dlti_tpu.serving import SamplingParams
+
+    eng = _engine(tiny_params, prefix_disk_dir=str(tmp_path),
+                  prefix_disk_blocks=16)
+    plain = _engine(tiny_params)
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    for p in _session_prompts():
+        eng.generate([p], sp)
+        plain.generate([p], sp)
+    assert eng.prefix_cache.stats["demotions"] > 0
+
+    for f in glob.glob(os.path.join(str(tmp_path), "block-*", "**", "*.bin"),
+                       recursive=True):
+        raw = bytearray(open(f, "rb").read())
+        raw[0] ^= 0xFF
+        open(f, "wb").write(bytes(raw))
+
+    for p in _session_prompts():
+        [rt] = eng.generate([p], sp)
+        [rp] = plain.generate([p], sp)
+        assert rt.output_token_ids == rp.output_token_ids  # no fault, no drift
+    assert eng.prefix_cache.stats["tier_corrupt_dropped"] > 0
+    assert glob.glob(os.path.join(str(tmp_path), "_quarantine", "*"))
