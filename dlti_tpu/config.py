@@ -258,6 +258,41 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class SentinelConfig:
+    """Numeric-fault sentinel (``dlti_tpu.training.sentinel``): per-step
+    nonfinite/spike detection over the compiled step's own metrics (no
+    extra host syncs), automatic rollback to the last verified checkpoint
+    with strike-counted data quarantine, and a periodic cross-rank
+    parameter-digest probe that attributes silent data corruption to a
+    suspect host for the elastic supervisor to evict."""
+
+    # Host-side detection (spike windows, anomaly streaks, steplog
+    # fields). The in-step nonfinite update gate is always compiled in —
+    # it is a correctness fix, not an option.
+    enabled: bool = True
+    # Rolling-median spike window and its cold-start sample floor.
+    window: int = 32
+    min_samples: int = 8
+    # Spike thresholds: latest > factor x rolling median (loss moves
+    # slowly; grad norms are noisy, hence the wider factor).
+    loss_spike_factor: float = 2.0
+    grad_spike_factor: float = 10.0
+    # Consecutive anomalous steps before automatic rollback to the last
+    # verified checkpoint (0 = never roll back; detection still runs).
+    rollback_after: int = 3
+    # Total rollbacks allowed per run; exceeding raises SentinelGiveUp
+    # (anomalies that survive every recovery need a human).
+    max_rollbacks: int = 8
+    # Strikes (rollbacks implicating a window) before that data window is
+    # quarantined permanently; below that it is replayed (transient
+    # faults pass on the second try).
+    quarantine_after: int = 2
+    # Cross-rank param-digest probe cadence in optimizer steps (0 = off;
+    # multi-process runs only).
+    sdc_check_interval: int = 0
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Training loop knobs (reference: ``TrainingArguments`` uses across scripts)."""
 
@@ -338,8 +373,18 @@ class TrainConfig:
     # "STEP:host-kill[:RANK]" mode is SUPERVISOR-owned (the elastic
     # launcher SIGKILLs a whole worker process from outside —
     # dlti_tpu.training.elastic.HostKillSpec); the in-process injector
-    # ignores it.
+    # ignores it. Numeric chaos modes (dlti_tpu.training.sentinel
+    # drills): "STEP:nan-grad" poisons one batch's loss mask with NaN
+    # (transient nonfinite step), "POS:poison-batch" deterministically
+    # scrambles the batch at data position POS every time it is fed
+    # (re-fires after rollback — the bad-data simulation), and
+    # "STEP:param-flip[:RANK]" flips one mantissa bit in a replicated
+    # param leaf on rank RANK (the silent-data-corruption simulation the
+    # SDC probe must catch).
     fault_inject_step: str = ""
+    # Numeric-fault sentinel (dlti_tpu.training.sentinel): see the
+    # block's own docstring.
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
 
 
 @dataclass(frozen=True)
@@ -468,8 +513,12 @@ class GatewayConfig:
     # Graceful drain: seconds SIGTERM waits for in-flight requests before
     # the server exits anyway.
     drain_grace_s: float = 30.0
-    # Deterministic chaos hook: "REPLICA:STEP" kills replica REPLICA by
-    # raising on its STEP-th step() call (1-based). Also settable via env
+    # Deterministic chaos hook: "REPLICA:STEP[:MODE]" kills replica
+    # REPLICA on its STEP-th step() call (1-based). MODE "raise"
+    # (default) raises in place of a device fault; "nan-logits" poisons
+    # the replica's params with NaN so the engine's REAL numeric output
+    # guard (EngineConfig.guard_nonfinite) detects the garbage and trips
+    # the same quarantine path. Also settable via env
     # DLTI_GATEWAY_FAULT_INJECT; tests and chaos runs use it to exercise
     # failover without a real device fault.
     fault_inject_step: str = ""
@@ -553,7 +602,7 @@ class Config:
                 if dataclasses.is_dataclass(f.type) or f.name in (
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
-                    "watchdog", "flight_recorder", "prefix_tiers",
+                    "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -564,6 +613,7 @@ class Config:
                         "watchdog": WatchdogConfig,
                         "flight_recorder": FlightRecorderConfig,
                         "prefix_tiers": PrefixTierConfig,
+                        "sentinel": SentinelConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -608,6 +608,16 @@ def make_pipeline_train_step(
                 new_opt, state.opt_state, cfg.train.fp16_scale_window,
                 cfg.train.fp16_min_scale, cfg.train.fp16_hysteresis)
             metrics.update(extra)
+            metrics["nonfinite"] = extra["overflow"]
+            metrics["skipped_update"] = extra["overflow"]
+        else:
+            # bf16 nonfinite gate — same skip semantics as the flat step.
+            from dlti_tpu.training.step import guard_nonfinite_update
+
+            new_trainable, new_opt, extra = guard_nonfinite_update(
+                grad_norm, ce_mean, new_trainable, trainable,
+                new_opt, state.opt_state)
+            metrics.update(extra)
         return state.replace(
             step=state.step + 1,
             params=combine_params(new_trainable, frozen),

@@ -25,6 +25,7 @@ from dlti_tpu.serving.engine import (  # noqa: F401
     EngineConfig,
     GenerationResult,
     InferenceEngine,
+    NumericFault,
     Request,
 )
 from dlti_tpu.serving.replicas import ReplicatedEngine  # noqa: F401

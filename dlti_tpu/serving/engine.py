@@ -135,6 +135,20 @@ class EngineConfig:
     # the decode batch when it lands. 0 = unbounded (throughput mode:
     # whole prompts in one batched call per bucket).
     max_prefill_tokens_per_step: int = 0
+    # Numeric output guard (PR 8): before ANY token from a decode round
+    # is appended/streamed, its logprob (computed device-side alongside
+    # the sample — NaN/inf logits surface there) must be finite; a
+    # nonfinite round raises NumericFault, which AsyncEngine treats as an
+    # engine fault and ReplicatedEngine answers by quarantining the
+    # replica and recomputing the round's requests on survivors — users
+    # never see the garbage tokens a numerically-dead replica samples.
+    guard_nonfinite: bool = True
+    # Token-storm guard: N consecutive decode steps in which EVERY active
+    # slot (>= 2 of them) sampled the same token reads as a degenerate
+    # output distribution (the all-pad storm a silently-corrupted model
+    # produces) and raises NumericFault. 0 = off (legitimate decodes CAN
+    # agree; enable with a window sized for your traffic).
+    guard_token_storm: int = 0
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -149,6 +163,14 @@ class EngineConfig:
     @property
     def max_blocks_per_seq(self) -> int:
         return -(-self.max_model_len // self.block_size)
+
+
+class NumericFault(RuntimeError):
+    """A decode round produced numerically-dead output (nonfinite
+    logits/logprobs, or an all-slots token storm). Raised BEFORE any of
+    the round's tokens are appended, so nothing garbage is ever streamed;
+    the replica layer answers by quarantining the engine and recomputing
+    its requests on survivors (:meth:`ReplicatedEngine._fail_replica`)."""
 
 
 @dataclass
@@ -459,7 +481,14 @@ class InferenceEngine:
                       # syncs. Present (at 0) even with the cache disabled
                       # so the /metrics exposition schema is stable.
                       "decode_state_uploads": 0, "decode_state_rows": 0,
-                      "decode_state_clean_syncs": 0}
+                      "decode_state_clean_syncs": 0,
+                      # Numeric-guard trips (nonfinite decode outputs /
+                      # token storms). Present (at 0) so the /metrics
+                      # schema is stable.
+                      "numeric_faults": 0}
+        # Token-storm guard run length (consecutive all-slots-identical
+        # decode steps).
+        self._storm_run = 0
 
         # Device-resident twins of the per-slot mirrors, maintained
         # incrementally (per-slot dirty tracking; clean steps upload
@@ -1223,6 +1252,18 @@ class InferenceEngine:
         )
         toks = np.asarray(jax.device_get(toks))
         lps = np.asarray(jax.device_get(lps))
+        if self.cfg.guard_nonfinite:
+            bad = [slot.slot_id
+                   for r, (slot, *_rest, is_last) in enumerate(chunks)
+                   if is_last and not np.isfinite(lps[r])]
+            if bad:
+                # First-token guard: a numerically-dead model's prefill
+                # sample must not stream either (failover's resubmit
+                # preserves generated-so-far tokens).
+                self.stats["numeric_faults"] += 1
+                raise NumericFault(
+                    f"nonfinite prefill output on slot(s) {bad}: the "
+                    f"model is producing NaN/inf logits")
         for r, (slot, tokens, start, is_last) in enumerate(chunks):
             if is_last:
                 self._append_token(slot, int(toks[r]), float(lps[r]))
@@ -1386,6 +1427,30 @@ class InferenceEngine:
         logprobs = np.asarray(jax.device_get(logprobs))
         self.stats["decode_steps"] += k_steps
 
+        # Numeric guard — the WHOLE round is validated before any token
+        # is appended: a partially-appended round would survive failover
+        # (resubmit keeps generated-so-far tokens) and stream garbage.
+        if self.cfg.guard_nonfinite:
+            bad = [s.slot_id for s in active
+                   if not np.isfinite(logprobs[s.slot_id, :k_steps]).all()]
+            if bad:
+                self.stats["numeric_faults"] += 1
+                raise NumericFault(
+                    f"nonfinite decode output on slot(s) {bad} "
+                    f"(window of {k_steps} step(s)): the model is "
+                    f"producing NaN/inf logits")
+        if self.cfg.guard_token_storm > 0 and len(active) >= 2:
+            for k in range(k_steps):
+                col = {int(tokens[s.slot_id, k]) for s in active}
+                self._storm_run = self._storm_run + 1 if len(col) == 1 \
+                    else 0
+                if self._storm_run >= self.cfg.guard_token_storm:
+                    self.stats["numeric_faults"] += 1
+                    raise NumericFault(
+                        f"token storm: every active slot sampled the "
+                        f"same token for {self._storm_run} consecutive "
+                        f"steps (token {col.pop()})")
+
         finished = []
         for s in active:
             for k in range(k_steps):
@@ -1477,6 +1542,20 @@ class InferenceEngine:
         prop = np.asarray(jax.device_get(prop))
         acc = np.asarray(jax.device_get(acc))
         self.stats["decode_steps"] += R
+
+        # Numeric guard over every EMITTED token (rejected draft
+        # positions legitimately carry junk), before anything appends —
+        # same no-garbage-survives-failover contract as plain decode.
+        if self.cfg.guard_nonfinite:
+            bad = [s.slot_id for s in active
+                   if any(not np.isfinite(
+                       lps[s.slot_id, r, :int(emit[s.slot_id, r])]).all()
+                       for r in range(R))]
+            if bad:
+                self.stats["numeric_faults"] += 1
+                raise NumericFault(
+                    f"nonfinite speculative-decode output on slot(s) "
+                    f"{bad}: the model is producing NaN/inf logits")
 
         finished = []
         gate_rounds = 0

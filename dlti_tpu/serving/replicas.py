@@ -40,17 +40,30 @@ from dlti_tpu.utils.logging import get_logger
 FAULT_INJECT_ENV = "DLTI_GATEWAY_FAULT_INJECT"
 
 
-def _parse_fault_inject(spec: str) -> Optional[Tuple[int, int]]:
-    """"REPLICA:STEP" -> (replica_idx, 1-based step count), None if unset."""
+_FAULT_MODES = ("raise", "nan-logits")
+
+
+def _parse_fault_inject(spec: str) -> Optional[Tuple[int, int, str]]:
+    """"REPLICA:STEP[:MODE]" -> (replica_idx, 1-based step count, mode);
+    None if unset. MODE "raise" (default) raises :class:`ReplicaFault` in
+    place of a device fault; "nan-logits" instead poisons the replica's
+    params with NaN so the engine's REAL numeric guard
+    (:class:`~dlti_tpu.serving.engine.NumericFault`) detects the garbage
+    output and trips the same quarantine path."""
     spec = (spec or "").strip()
     if not spec:
         return None
     try:
-        rep, _, step = spec.partition(":")
-        return int(rep), int(step)
+        rep, _, rest = spec.partition(":")
+        step, _, mode = rest.partition(":")
+        mode = mode or "raise"
+        if mode not in _FAULT_MODES:
+            raise ValueError(mode)
+        return int(rep), int(step), mode
     except ValueError:
         raise ValueError(
-            f"fault_inject_step must be 'REPLICA:STEP', got {spec!r}")
+            f"fault_inject_step must be 'REPLICA:STEP[:MODE]' with MODE "
+            f"in {_FAULT_MODES}, got {spec!r}")
 
 
 class ReplicaFault(RuntimeError):
@@ -231,13 +244,41 @@ class ReplicatedEngine:
                 if (self._fault_inject is not None
                         and self._fault_inject[0] == i
                         and self._step_counts[i] == self._fault_inject[1]):
-                    raise ReplicaFault(
-                        f"gateway.fault_inject_step: injected fault on "
-                        f"replica {i} step {self._step_counts[i]}")
+                    if self._fault_inject[2] == "nan-logits":
+                        # Poison the replica's params so this step's REAL
+                        # forward emits NaN logits — the engine's numeric
+                        # guard (not this hook) must catch it before any
+                        # garbage token streams.
+                        self._poison_params_nan(eng, i)
+                    else:
+                        raise ReplicaFault(
+                            f"gateway.fault_inject_step: injected fault on "
+                            f"replica {i} step {self._step_counts[i]}")
                 finished.extend(eng.step())
             except Exception as e:  # noqa: BLE001 — isolate per replica
                 finished.extend(self._fail_replica(i, e))
         return finished
+
+    def _poison_params_nan(self, eng: InferenceEngine, idx: int) -> None:
+        """nan-logits chaos: overwrite the first float param leaf of one
+        replica with NaN (on that replica's own devices) — the honest
+        silent-corruption simulation; detection is the engine guard's
+        job."""
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(eng.params)
+        for j, leaf in enumerate(leaves):
+            if (hasattr(leaf, "dtype")
+                    and jnp.issubdtype(leaf.dtype, jnp.inexact)):
+                poisoned = jax.device_put(
+                    jnp.full(leaf.shape, jnp.nan, leaf.dtype),
+                    leaf.sharding)
+                leaves[j] = poisoned
+                break
+        eng.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.logger.warning(
+            "chaos: poisoned replica %d params with NaN (nan-logits "
+            "fault injection)", idx)
 
     def _fail_replica(self, idx: int, exc: Exception) -> List[Request]:
         """Mark replica ``idx`` dead and fail its requests over.

@@ -83,6 +83,19 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
 
     registry.register(alerts_total)
     registry.register(dumps_total)
+    # Numeric-fault sentinel + SDC counters (dlti_tpu.training.sentinel):
+    # module-level like the watchdog/flight pair, so an in-process
+    # trainer's anomalies and the serving guard drills share one series
+    # and /dashboard plots them.
+    from dlti_tpu.training import sentinel as _sentinel
+
+    for metric in (_sentinel.anomalies_total,
+                   _sentinel.skipped_updates_total,
+                   _sentinel.rollbacks_total,
+                   _sentinel.quarantined_windows_total,
+                   _sentinel.sdc_probes_total,
+                   _sentinel.sdc_mismatches_total):
+        registry.register(metric)
     # Tiered prefix-cache telemetry (module-level like the watchdog /
     # flight counters, so replicas aggregate into one series): per-tier
     # hit/miss/eviction/promotion/demotion counters + block gauges.

@@ -30,11 +30,17 @@ from dlti_tpu.config import OptimizerConfig
 from dlti_tpu.utils.metrics import MetricsRecord
 
 # Keys every "step" record carries (the per-step contract; the schema test
-# asserts run ∪ step ∪ final covers the reference CSV columns).
+# asserts run ∪ step ∪ final covers the reference CSV columns). The
+# sentinel fields (PR 8): `anomaly` is "" for a clean step or the verdict
+# kind (nonfinite | loss_spike | grad_spike), `skipped_update` marks
+# optimizer updates the in-step nonfinite gate skipped, and
+# `rollbacks_total` is the run's cumulative automatic-rollback count —
+# the triple an incident reader greps first.
 STEP_RECORD_FIELDS = (
     "type", "step", "loss", "grad_norm", "lr",
     "tokens_per_second_per_chip", "mfu_percent",
     "peak_memory_gb", "peak_memory_source", "step_time_s",
+    "anomaly", "skipped_update", "rollbacks_total",
 )
 
 RUN_RECORD_FIELDS = ("type", "experiment", "num_gpus", "zero_stage",

@@ -34,6 +34,11 @@ alert, not one per check interval):
 * ``ckpt_retry_storm``    — ``ckpt_save_retries`` grew by at least
   ``ckpt_retry_limit`` across the ring window (storage going bad under
   the async writer's backoff).
+* ``nonfinite_step`` / ``loss_spike`` / ``sdc_mismatch`` — the numeric
+  sentinel's counters (``dlti_tpu.training.sentinel``) grew since the
+  previous check: nonfinite loss/grads (update skipped in-step), a
+  loss/grad-norm spike vs the rolling window, or a cross-rank parameter
+  digest mismatch (suspected silent data corruption).
 """
 
 from __future__ import annotations
@@ -63,7 +68,19 @@ alerts_total = Counter(
     help="watchdog alerts fired, labeled by rule")
 
 RULES = ("hung_step", "throughput_collapse", "queue_buildup",
-         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm")
+         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
+         "nonfinite_step", "loss_spike", "sdc_mismatch")
+
+# Sentinel-counter rules (rule, ring keys summed): fire when the summed
+# counters grew since the previous check (edge: a sustained anomaly burst
+# is one alert; the rule re-arms after a quiet check). The keys are the
+# trainer's _train_scalars sentinel snapshot
+# (dlti_tpu.training.sentinel.NumericSentinel.scalars / SDCProbe.scalars).
+_SENTINEL_RULES = (
+    ("nonfinite_step", ("sentinel_nonfinite_steps",)),
+    ("loss_spike", ("sentinel_loss_spikes", "sentinel_grad_spikes")),
+    ("sdc_mismatch", ("sdc_mismatches",)),
+)
 
 ACTIONS = ("log", "dump", "abort")
 
@@ -108,6 +125,10 @@ class AnomalyWatchdog:
         self._step_durations: deque = deque(maxlen=32)
         # Edge-trigger state: condition keys currently firing.
         self._active: set = set()
+        # Sentinel-counter watermarks: value at the previous check, per
+        # rule (first sighting initializes without firing, so a resumed
+        # run's nonzero counters don't alert spuriously).
+        self._watermarks: dict = {}
         self.alerts: deque = deque(maxlen=256)  # recent alerts (forensics)
         self._last_dump_t = 0.0
         self._stop = threading.Event()
@@ -249,6 +270,29 @@ class AnomalyWatchdog:
                 else:
                     self._active.discard("ckpt_retry_storm")
                 break
+
+        # sentinel rules: nonfinite_step / loss_spike / sdc_mismatch ---
+        latest = (self.sampler.latest() or {}).get("values", {})
+        for rule, keys in _SENTINEL_RULES:
+            present = [k for k in keys if k in latest]
+            if not present:
+                continue
+            total = sum(float(latest[k]) for k in present)
+            prev = self._watermarks.get(rule)
+            self._watermarks[rule] = total
+            if prev is None:
+                continue
+            if total > prev:
+                a = self._fire(rule, rule,
+                               f"{rule}: sentinel counter(s) "
+                               f"{'+'.join(present)} grew "
+                               f"{total - prev:.0f} since last check "
+                               f"(now {total:.0f})",
+                               grew=total - prev, total=total)
+                if a:
+                    fired.append(a)
+            else:
+                self._active.discard(rule)
         return fired
 
     def _throughput_series(self):

@@ -4,8 +4,16 @@ from dlti_tpu.training.optimizer import build_optimizer, build_schedule  # noqa:
 from dlti_tpu.training.state import TrainState, create_train_state  # noqa: F401
 from dlti_tpu.training.step import (  # noqa: F401
     causal_lm_loss,
+    guard_nonfinite_update,
     make_multi_step,
     make_train_step,
+)
+from dlti_tpu.training.sentinel import (  # noqa: F401
+    DataSkipList,
+    NumericSentinel,
+    SDC_EXIT_CODE,
+    SentinelGiveUp,
+    SpikeDetector,
 )
 
 

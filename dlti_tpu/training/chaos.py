@@ -29,6 +29,26 @@ where MODE is one of
                   whole-host death drill. The in-process injector
                   ignores it (``from_spec`` returns None), so the env
                   var can ride the launch env down to every worker.
+
+Numeric chaos (the sentinel drills, ``dlti_tpu.training.sentinel``):
+
+* ``nan-grad``    — poison ONE batch's loss mask with NaN right before
+                    dispatch (fires once, at the batch feeding optimizer
+                    step >= STEP): the loss and grads go nonfinite
+                    through the real compiled step, the in-step gate
+                    must skip the update, and the next batch is clean —
+                    the transient-blowup simulation.
+* ``poison-batch``— deterministically scramble the batch at *data
+                    position* STEP (``rng(seed=pos).permutation`` of its
+                    tokens) EVERY time that position is fed — keyed by
+                    position, not optimizer step, so a rollback that
+                    replays the window re-poisons it exactly like real
+                    corrupt data, until the sentinel quarantines it.
+* ``param-flip``  — ``STEP:param-flip[:RANK]``: flip one mantissa bit in
+                    the first cross-process-replicated float param leaf
+                    on rank RANK (default 1) at step boundary STEP — the
+                    silent-data-corruption simulation the cross-rank
+                    digest probe must catch and attribute.
 """
 
 from __future__ import annotations
@@ -42,13 +62,16 @@ class TrainFault(RuntimeError):
     """Raised by the fault injector (``raise`` / ``save-raise`` modes)."""
 
 
-_MODES = ("raise", "kill", "save-raise", "save-kill")
+_MODES = ("raise", "kill", "save-raise", "save-kill",
+          "nan-grad", "poison-batch", "param-flip")
 
 
 class TrainFaultInjector:
-    """Parsed ``STEP[:MODE]`` spec; fires at most once."""
+    """Parsed ``STEP[:MODE[:RANK]]`` spec; fires at most once — except
+    ``poison-batch``, which (like the real corrupt shard it simulates)
+    re-fires every time its data position is fed."""
 
-    def __init__(self, step: int, mode: str):
+    def __init__(self, step: int, mode: str, rank: int = 1):
         if step < 1:
             raise ValueError(f"fault-inject step must be >= 1, got {step}")
         if mode not in _MODES:
@@ -57,6 +80,7 @@ class TrainFaultInjector:
                 f"{_MODES}")
         self.step = step
         self.mode = mode
+        self.rank = rank  # param-flip only: which process corrupts
         self.fired = False
         # Forensics hook, called (mode, where, step) right before the
         # fault fires — even in the ``kill`` modes, where it is the ONLY
@@ -75,19 +99,25 @@ class TrainFaultInjector:
             "DLTI_TRAIN_FAULT_INJECT", "").strip()
         if not spec:
             return None
-        step_s, _, mode = spec.partition(":")
-        if mode.partition(":")[0] == "host-kill":
+        step_s, _, rest = spec.partition(":")
+        mode, _, rank_s = rest.partition(":")
+        if mode == "host-kill":
             # Supervisor-side whole-host chaos
             # (dlti_tpu.training.elastic.HostKillSpec): not an in-process
             # fault — every worker sees the env var and must ignore it.
             return None
         try:
             step = int(step_s)
+            rank = int(rank_s) if rank_s else 1
         except ValueError:
             raise ValueError(
-                f"bad fault-inject spec {spec!r}; expected 'STEP[:MODE]' "
-                f"with MODE in {_MODES}") from None
-        return cls(step, mode or "raise")
+                f"bad fault-inject spec {spec!r}; expected "
+                f"'STEP[:MODE[:RANK]]' with MODE in {_MODES}") from None
+        if rank_s and mode != "param-flip":
+            raise ValueError(
+                f"fault-inject spec {spec!r}: only param-flip takes a "
+                f"RANK field")
+        return cls(step, mode or "raise", rank=rank)
 
     # ------------------------------------------------------------------
     def _fire(self, where: str, step: int) -> None:
@@ -116,3 +146,107 @@ class TrainFaultInjector:
         if (not self.fired and self.mode in ("save-raise", "save-kill")
                 and step >= self.step):
             self._fire("mid-save", step)
+
+    # -- numeric chaos (sentinel drills) --------------------------------
+    def maybe_corrupt_batch(self, pos: int, step: int,
+                            host_batch: dict) -> Optional[dict]:
+        """Called by the trainer with each fetched batch's *data
+        position* and the optimizer step it will execute as, BEFORE
+        device placement. Returns a corrupted copy to feed instead, or
+        None (feed the original). Never mutates ``host_batch`` — the
+        dataset may own those arrays."""
+        import numpy as np
+
+        if self.mode == "nan-grad" and not self.fired and step >= self.step:
+            self.fired = True
+            if self.pre_fire is not None:
+                try:
+                    self.pre_fire(self.mode, "batch poisoned (NaN mask)",
+                                  step)
+                except Exception:
+                    pass
+            out = dict(host_batch)
+            mask = np.asarray(out.get(
+                "loss_mask", np.ones_like(out["input_ids"])),
+                dtype=np.float32).copy()
+            # NaN on every real token: the masked loss sum, n_tok, and
+            # every grad go nonfinite through the genuine compiled step.
+            mask[mask != 0] = np.nan
+            out["loss_mask"] = mask
+            return out
+        if self.mode == "poison-batch" and pos == self.step:
+            # Keyed by DATA POSITION and re-firing: after a rollback the
+            # replayed window is poisoned again, exactly like the corrupt
+            # shard it simulates; once quarantined it is never fed, so
+            # this stops firing. Deterministic per position.
+            self.fired = True  # informational; the gate is `pos ==`
+            out = dict(host_batch)
+            ids = np.asarray(out["input_ids"])
+            rng = np.random.default_rng(0x5EED + pos)
+            out["input_ids"] = rng.permutation(
+                ids.reshape(-1)).reshape(ids.shape).astype(ids.dtype)
+            return out
+        return None
+
+    def maybe_corrupt_state(self, step: int, state):
+        """Called at each optimizer-step boundary with the live train
+        state. ``param-flip`` (on the configured rank only) returns a
+        state whose first cross-process-replicated float param leaf has
+        one mantissa bit flipped — a bit-exact SDC simulation the digest
+        probe must attribute; other ranks/modes return None."""
+        if self.mode != "param-flip" or self.fired or step < self.step:
+            return None
+        if os.environ.get("DLTI_GENERATION", "0") != "0":
+            # Elastic relaunch: the spec rides the env into every
+            # generation, but the flip simulates ONE corruption event —
+            # the restarted generations are the recovery under test
+            # (same rationale as elastic.HostKillSpec firing once).
+            return None
+        self.fired = True
+        import jax
+        import numpy as np
+
+        if jax.process_index() != self.rank:
+            return None
+        if self.pre_fire is not None:
+            try:
+                self.pre_fire(self.mode, f"param bit flipped on rank "
+                              f"{self.rank}", step)
+            except Exception:
+                pass
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        target = None
+        for i, leaf in enumerate(leaves):
+            if (hasattr(leaf, "dtype") and hasattr(leaf, "sharding")
+                    and jax.numpy.issubdtype(leaf.dtype, jax.numpy.inexact)
+                    and getattr(leaf.sharding, "is_fully_replicated",
+                                False)
+                    and leaf.size > 0):
+                target = i
+                break
+        if target is None:
+            return None
+        leaf = leaves[target]
+        try:
+            host = np.array(leaf.addressable_data(0))
+        except Exception:
+            host = np.array(jax.device_get(leaf))
+        flat = host.reshape(-1)
+        bits = flat.view(np.dtype(f"u{flat.dtype.itemsize}"))
+        bits[0] ^= 1  # lowest mantissa bit: silent, tiny, bit-exact
+        # make_array_from_callback (not device_put): each process builds
+        # its local shards without the multi-process broadcast path's
+        # cross-rank equality collectives — per-rank divergence is the
+        # POINT here. The product is transfer-created, so launder before
+        # it can be donated into the next compiled step (see
+        # checkpoint.store._launder).
+        if jax.process_count() > 1:
+            new_leaf = jax.make_array_from_callback(
+                host.shape, leaf.sharding, lambda idx: host[idx])
+        else:
+            new_leaf = jax.device_put(host, leaf.sharding)
+        from dlti_tpu.checkpoint.store import _launder
+
+        leaves[target] = _launder([new_leaf])[0]
+        return state.replace(
+            params=jax.tree_util.tree_unflatten(treedef, leaves))

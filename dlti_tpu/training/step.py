@@ -148,6 +148,32 @@ def apply_loss_scaler(scaler: dict, grad_norm, new_trainable, old_trainable,
     return new_trainable, new_opt_state, new_scaler, metrics_extra
 
 
+def guard_nonfinite_update(grad_norm, loss, new_trainable, old_trainable,
+                           new_opt_state, old_opt_state):
+    """bf16-path nonfinite gate: skip the optimizer update when the loss
+    or grad norm is nonfinite, exactly as :func:`apply_loss_scaler` has
+    always done for fp16 overflow — without it a single NaN batch writes
+    NaN into every AdamW moment and the run is numerically dead from then
+    on. Params/opt state keep their old values; the step counter still
+    advances (the lr/rng schedule is a pure function of the step index,
+    so skipping is rollback- and world-size-invariant). Returns
+    ``(trainable, opt_state, metrics_extra)`` with the ``nonfinite`` /
+    ``skipped_update`` flags the host-side sentinel
+    (``dlti_tpu.training.sentinel``) reads from the already-synced
+    metrics."""
+    finite = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
+    new_trainable = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old),
+        new_trainable, old_trainable)
+    new_opt_state = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old)
+        if hasattr(new, "shape") else new,
+        new_opt_state, old_opt_state)
+    bad = (~finite).astype(jnp.float32)
+    return new_trainable, new_opt_state, {
+        "nonfinite": bad, "skipped_update": bad}
+
+
 def make_train_step(
     model,
     *,
@@ -308,6 +334,16 @@ def make_train_step(
                     state.scaler, grad_norm, new_trainable, trainable,
                     new_opt_state, opt_state, fp16_scale_window,
                     fp16_min_scale, fp16_hysteresis)
+            metrics.update(extra)
+            # Uniform sentinel schema with the bf16 path: an fp16
+            # overflow IS a skipped nonfinite step.
+            metrics["nonfinite"] = extra["overflow"]
+            metrics["skipped_update"] = extra["overflow"]
+        else:
+            # bf16 path: same skip semantics, no scale to evolve.
+            new_trainable, new_opt_state, extra = guard_nonfinite_update(
+                grad_norm, loss, new_trainable, trainable,
+                new_opt_state, opt_state)
             metrics.update(extra)
 
         new_params = combine_params(new_trainable, frozen)

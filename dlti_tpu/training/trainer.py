@@ -398,6 +398,8 @@ class Trainer:
 
         start_step = 0
         resume_meta = None
+        self._rollback_due = None
+        self._sdc_evict = False
         if resume and cfg.checkpoint.save_strategy != "no":
             from dlti_tpu.checkpoint import restore_latest_verified
 
@@ -486,6 +488,10 @@ class Trainer:
         watchdog = None
         flight = None
         self._live = {"train_step": start_step}
+        # Sentinel handles for _train_scalars (populated after resume).
+        self._sentinel = None
+        self._skiplist = None
+        self._sdc_probe = None
 
         # Elastic supervision (dlti_tpu.training.elastic): when launched
         # by the ElasticLauncher, report per-step liveness via heartbeat
@@ -512,12 +518,26 @@ class Trainer:
             d["ckpt_corrupt_skipped"] = corrupt_skipped.value
             d["ckpt_last_verified_step"] = last_verified_step.value
             d["trace_dropped_events"] = tracer.dropped_events
+            # Sentinel/SDC counters (set once the sentinel initializes a
+            # few lines below the sampler start): the watchdog's
+            # loss_spike / nonfinite_step / sdc_mismatch rules watch
+            # these ring series.
+            if self._sentinel is not None:
+                d.update(self._sentinel.scalars())
+                d["sentinel_quarantined_windows"] = len(
+                    self._skiplist.quarantined())
+            if self._sdc_probe is not None:
+                d.update(self._sdc_probe.scalars())
             return d
 
         if wcfg.enabled or fcfg.enabled:
             sampler = TimeSeriesSampler(interval_s=wcfg.interval_s)
             sampler.add_source(_train_scalars)
-        if fcfg.enabled and is_main_process():
+        if fcfg.enabled and (is_main_process() or einfo is not None):
+            # Every rank records under an elastic supervisor: per-rank
+            # black boxes (tagged -gG-rR) are what postmortem --all
+            # renders into one incident, and the SDC probe's suspect rank
+            # must be able to dump before it evicts itself.
             if not tracer.enabled:
                 # The black box needs a span tail even without a
                 # --trace-dir export: recording is cheap (ring appends),
@@ -569,12 +589,19 @@ class Trainer:
         # Resume the *data schedule* too, not just the weights: skip the
         # epochs/steps already consumed so no batch is trained twice (the
         # reference delegates this to HF Trainer's resume machinery).
+        # The DATA CURSOR is tracked separately from the optimizer step:
+        # they are equal until the sentinel quarantines a data window,
+        # after which the cursor leads the step by the windows skipped
+        # (the sidecar records both, so resume replays exactly).
+        spe = dataset.steps_per_epoch() if dataset is not None else 0
+        data_cursor = start_step
+        if resume_meta and resume_meta.get("data_pos") is not None:
+            data_cursor = int(resume_meta["data_pos"])
         start_epoch, skip_steps = 0, 0
-        if start_step > 0 and dataset is not None:
-            spe = dataset.steps_per_epoch()
+        if data_cursor > 0 and dataset is not None:
             if spe > 0:
-                start_epoch = min(start_step // spe, cfg.train.num_epochs)
-                skip_steps = start_step % spe
+                start_epoch = min(data_cursor // spe, cfg.train.num_epochs)
+                skip_steps = data_cursor % spe
             if resume_meta and resume_meta.get("dataset"):
                 # The sidecar records the data cursor the checkpoint was
                 # saved at; a mismatch means the resumed run is feeding a
@@ -594,10 +621,54 @@ class Trainer:
                         "from the original run",
                         saved.get("shuffle_seed"), cur_shuffle)
 
+        # Mutable resume point: rollback rewinds it mid-run.
+        resume_point = {"epoch": start_epoch, "skip": skip_steps}
+        # fetch: next data position the loop will consume; committed:
+        # position after the last EXECUTED batch (what the sidecar
+        # records — prefetched/dropped batches replay on resume).
+        cursor = {"fetch": data_cursor, "committed": data_cursor}
+
         def epoch_batches(epoch):
             if dataset is not None:
-                return dataset.epoch(epoch, skip_steps=skip_steps if epoch == start_epoch else 0)
+                return dataset.epoch(
+                    epoch,
+                    skip_steps=resume_point["skip"]
+                    if epoch == resume_point["epoch"] else 0)
             return batches_per_epoch
+
+        # -- numeric-fault sentinel (dlti_tpu.training.sentinel) --------
+        # Detection is pure host math over the metrics the compiled step
+        # already syncs; rollback needs a dataset (exact replay) and a
+        # checkpoint store to restore from.
+        from dlti_tpu.training import sentinel as sentinel_mod
+
+        scfg = cfg.train.sentinel
+        sentinel = None
+        skiplist = None
+        sdc_probe = None
+        if scfg.enabled:
+            sentinel = sentinel_mod.NumericSentinel(scfg)
+            skiplist = sentinel_mod.DataSkipList(scfg.quarantine_after)
+            if cfg.checkpoint.save_strategy != "no":
+                skiplist.load(cfg.checkpoint.output_dir)
+            if resume_meta:
+                skiplist.merge_meta(resume_meta.get("skip_list"))
+            if skiplist.quarantined() and is_main_process():
+                self.logger.warning(
+                    "sentinel: honoring persistent skip-list — %d data "
+                    "window(s) quarantined: %s", len(skiplist.quarantined()),
+                    sorted(skiplist.quarantined()))
+            if scfg.sdc_check_interval > 0 and jax.process_count() > 1:
+                sdc_probe = sentinel_mod.SDCProbe(scfg.sdc_check_interval)
+        self._sentinel, self._skiplist, self._sdc_probe = \
+            sentinel, skiplist, sdc_probe
+        rollback_allowed = (sentinel is not None and dataset is not None
+                            and cfg.checkpoint.save_strategy != "no"
+                            and scfg.rollback_after > 0)
+        # step -> data position of the batch that fed it (bounded; the
+        # rollback path looks up the anomalous streak's windows here).
+        step_pos: dict = {}
+        skipped_windows = 0
 
         # -- background batch prefetch (dlti_tpu.data.prefetch) ---------
         # Gather/pack runs on a worker thread, double-buffered
@@ -711,7 +782,7 @@ class Trainer:
         def exec_steps(state, items):
             """Classic path: one compiled call + host sync per step."""
             executed = []
-            for hb, gb, r in items:
+            for hb, gb, r, pos in items:
                 warm = step_fn_warm["done"]
                 if warm:
                     timer.start()
@@ -725,7 +796,7 @@ class Trainer:
                     timer.stop()
                 else:
                     step_fn_warm["done"] = True
-                executed.append((hb, r, m))
+                executed.append((hb, r, m, pos))
             return state, executed
 
         def exec_window(state):
@@ -738,9 +809,9 @@ class Trainer:
             import jax.numpy as jnp
 
             k = len(window)
-            stacked = {key: np.stack([hb[key] for hb, _, _ in window])
+            stacked = {key: np.stack([it[0][key] for it in window])
                        for key in window[0][0]}
-            rngs = jnp.stack([r for _, _, r in window])
+            rngs = jnp.stack([it[2] for it in window])
             with timer.measure(steps=k):
                 fnote(phase="step_dispatch")
                 with tracer.span("train/step_dispatch", cat="train",
@@ -750,7 +821,8 @@ class Trainer:
                 with tracer.span("train/device_sync", cat="train"):
                     mstack = jax.device_get(mstack)
             executed = [(window[i][0], window[i][2],
-                         {key: v[i] for key, v in mstack.items()})
+                         {key: v[i] for key, v in mstack.items()},
+                         window[i][3])
                         for i in range(k)]
             window.clear()
             return state, executed
@@ -773,15 +845,23 @@ class Trainer:
             cursor + rng schedule that make a resumed run replay the
             exact batch/rng sequence (prefetched-but-unexecuted batches
             are dropped on every exit path, so the cursor IS the step)."""
-            spe = dataset.steps_per_epoch() if dataset is not None else 0
+            committed = cursor["committed"]
             return {
                 "format": 1,
                 "step": global_step,
-                "epoch": (global_step // spe) if spe else 0,
-                "step_in_epoch": (global_step % spe) if spe else 0,
+                # Data cursor: equals the step until the sentinel skips
+                # quarantined windows, after which it leads the step.
+                "data_pos": committed,
+                "epoch": (committed // spe) if spe else 0,
+                "step_in_epoch": (committed % spe) if spe else 0,
                 "samples_seen": samples_seen,
                 "seed": cfg.train.seed,
                 "rng_schedule": "fold_in_v1",
+                # Persistent data quarantine (dlti_tpu.training.sentinel):
+                # strike-counted windows; quarantined ones are skipped on
+                # this run and every resume.
+                "skip_list": skiplist.to_meta() if skiplist is not None
+                else [],
                 "dataset": {
                     "kind": type(dataset).__name__ if dataset is not None
                     else None,
@@ -801,11 +881,41 @@ class Trainer:
             eval_steps/save_steps need not divide steps_per_sync)."""
             nonlocal global_step, samples_seen
             step_before = global_step
-            for hb, r, m in executed:
+            window_anomalous = False
+            for hb, r, m, pos in executed:
                 global_step += 1
                 samples_seen += (cfg.train.micro_batch_size
                                  * cfg.train.grad_accum_steps)
                 losses.append(float(m["loss"]))
+                cursor["committed"] = pos + 1
+                step_pos[global_step] = pos
+                verdict = None
+                if sentinel is not None:
+                    # Anomaly verdict over the metrics this already-paid
+                    # host sync delivered: nonfinite, loss/grad spikes vs
+                    # the rolling window, streak accounting.
+                    verdict = sentinel.observe(
+                        global_step, float(m["loss"]),
+                        float(m["grad_norm"]),
+                        bool(float(m.get("skipped_update", 0.0))))
+                    if verdict["kind"]:
+                        window_anomalous = True
+                        self.logger.warning(
+                            "sentinel: %s anomaly at step %d (loss %.4g, "
+                            "grad_norm %.4g, data window %d, streak %d)",
+                            verdict["kind"], global_step, float(m["loss"]),
+                            float(m["grad_norm"]), pos,
+                            len(verdict["streak"]))
+                        fnote(sentinel_last_anomaly={
+                            "step": global_step, "kind": verdict["kind"],
+                            "data_pos": pos})
+                    if (verdict["rollback_due"] and rollback_allowed
+                            and self._rollback_due is None):
+                        self._rollback_due = {
+                            "streak": verdict["streak"],
+                            "positions": [step_pos[s]
+                                          for s, _ in verdict["streak"]
+                                          if s in step_pos]}
                 if recorder is not None:
                     # Record the pre-assembly host-local batch: the
                     # global array's shards span other hosts' devices
@@ -832,6 +942,11 @@ class Trainer:
                         peak_memory_gb=round(peak_gb, 4),
                         peak_memory_source=peak_src,
                         step_time_s=round(dt, 6),
+                        anomaly=(verdict or {}).get("kind", ""),
+                        skipped_update=int(bool(float(
+                            m.get("skipped_update", 0.0)))),
+                        rollbacks_total=(sentinel.rollbacks
+                                         if sentinel is not None else 0),
                     )
                 if global_step % cfg.train.logging_steps == 0 and is_main_process():
                     self.logger.info(
@@ -861,6 +976,59 @@ class Trainer:
                 _elastic.beat(global_step)
             fnote(step=global_step, last_completed_step=global_step,
                   phase="between_steps")
+            if len(step_pos) > 4096:
+                for s in sorted(step_pos)[:-2048]:
+                    del step_pos[s]
+            # Cross-rank SDC probe — BEFORE the collective heartbeat/
+            # eval/save below: on a mismatch the suspect rank exits and
+            # the survivors must stop without entering another
+            # collective (which would wedge on the dead peer).
+            if sdc_probe is not None and sdc_probe.due(step_before,
+                                                       global_step):
+                fnote(phase="sdc_probe")
+                with tracer.span("train/sdc_probe", cat="train",
+                                 step=global_step):
+                    res = sdc_probe.check(state.params, global_step)
+                if res["mismatch"]:
+                    suspect_self = res["rank"] in res["suspects"]
+                    alert = {
+                        "wall": time.time(), "rule": "sdc_mismatch",
+                        "message": (
+                            f"cross-rank param digest mismatch at step "
+                            f"{global_step}: suspect rank(s) "
+                            f"{res['suspects']} (digests {res['digests']})"),
+                        "step": global_step, "suspects": res["suspects"],
+                        "rank": res["rank"]}
+                    self.logger.error("sentinel: %s", alert["message"])
+                    from dlti_tpu.training.elastic import mirror_alert
+
+                    try:
+                        mirror_alert(alert)
+                    except Exception:
+                        pass
+                    if flight is not None:
+                        flight.dump(reason="sdc_mismatch", force=True,
+                                    extra={"alert": alert,
+                                           "suspect_self": suspect_self})
+                    if suspect_self:
+                        # This host's replicated params diverged from the
+                        # fleet: its memory/compute is untrustworthy. The
+                        # black box is written; exit with the distinctive
+                        # code so the elastic supervisor books THIS slot
+                        # failed, reshapes the survivors, and rejoins the
+                        # slot later with checkpoint-fresh params.
+                        self.logger.error(
+                            "sentinel: this rank (%d) is the SDC suspect; "
+                            "exiting %d for supervisor eviction",
+                            res["rank"], sentinel_mod.SDC_EXIT_CODE)
+                        os._exit(sentinel_mod.SDC_EXIT_CODE)
+                    # Healthy ranks: stop cleanly with NO further
+                    # collectives (no final save — its consolidation
+                    # would hang on the evicted peer); the relaunched
+                    # generation resumes from the last verified step.
+                    self._sdc_evict = True
+                    self._stop_requested = True
+                    return
             if heartbeat is not None and (
                     global_step // tcfg.heartbeat_interval_steps
                     > step_before // tcfg.heartbeat_interval_steps):
@@ -876,18 +1044,106 @@ class Trainer:
                     and (global_step // cfg.train.eval_steps
                          > step_before // cfg.train.eval_steps)):
                 self._run_eval(eval_fn, state, eval_dataset, global_step)
-            self._maybe_save(state, global_step, epoch_end=False,
-                             crossed_from=step_before, meta=sidecar_meta())
+            if window_anomalous:
+                # Never checkpoint a state produced by an anomalous step:
+                # a spike's update is exactly what rollback exists to
+                # discard, and saving it would make it the resume target.
+                ck = self.cfg.checkpoint
+                if (ck.save_strategy == "steps"
+                        and global_step // ck.save_steps
+                        > step_before // ck.save_steps):
+                    self.logger.warning(
+                        "sentinel: save suppressed at step %d (anomalous "
+                        "window)", global_step)
+            else:
+                self._maybe_save(state, global_step, epoch_end=False,
+                                 crossed_from=step_before,
+                                 meta=sidecar_meta())
             if self._fault is not None:
                 # Step-boundary chaos: fires after the step booked (and
                 # its save, if due, was issued) — the crash point real
                 # preemptions hit.
                 self._fault.maybe_fire_step(global_step)
 
+        def do_rollback(state, epoch):
+            """Automatic numeric-fault recovery: restore the last
+            digest-verified checkpoint, strike the data windows that fed
+            the anomalous streak (quarantining repeat offenders), rewind
+            the step counter and data cursor, and let the epoch loop
+            re-enter at the restored position. The lr/rng schedule is a
+            pure function of the step index, so the replayed steps are
+            bit-identical to a run that never went anomalous."""
+            nonlocal global_step
+            info = self._rollback_due
+            self._rollback_due = None
+            if sentinel.over_budget():
+                raise sentinel_mod.SentinelGiveUp(
+                    f"sentinel rollback budget exhausted "
+                    f"({sentinel.rollbacks} rollbacks, anomalies persist); "
+                    f"a human must look at the data/hardware")
+            from dlti_tpu.checkpoint import (
+                restore_latest_verified, wait_for_saves)
+
+            ckdir = cfg.checkpoint.output_dir
+            wait_for_saves(ckdir)
+            fnote(phase="sentinel_rollback")
+            with tracer.span("train/sentinel_rollback", cat="train",
+                             step=global_step):
+                restored = restore_latest_verified(ckdir, state)
+            sentinel.note_rollback()
+            if restored is None:
+                self.logger.error(
+                    "sentinel: rollback wanted after %d consecutive "
+                    "anomalies but no verified checkpoint exists; "
+                    "continuing in place (streak reset)",
+                    len(info["streak"]))
+                return state, epoch
+            new_state, step, meta = restored
+            ck_cursor = int((meta or {}).get("data_pos", step))
+            # Strike ONLY the windows that fed anomalous steps — the
+            # innocent windows since the checkpoint replay untouched.
+            positions = sorted({p for p in info["positions"]
+                                if p >= ck_cursor})
+            newly_q = skiplist.strike(positions, step=global_step)
+            if cfg.checkpoint.save_strategy != "no":
+                skiplist.save(ckdir)
+            if flight is not None:
+                flight.dump(reason="sentinel_rollback", force=True, extra={
+                    "streak": info["streak"], "restored_step": int(step),
+                    "struck_windows": positions, "quarantined": newly_q,
+                    "rollbacks": sentinel.rollbacks})
+            self.logger.warning(
+                "sentinel: ROLLBACK #%d after %d consecutive anomalies "
+                "(last: %s) — restored verified step %d, struck data "
+                "window(s) %s%s", sentinel.rollbacks, len(info["streak"]),
+                info["streak"][-1][1], step, positions,
+                f"; QUARANTINED {newly_q}" if newly_q else
+                " (replaying once)")
+            global_step = int(step)
+            cursor["committed"] = ck_cursor
+            cursor["fetch"] = ck_cursor
+            step_pos.clear()
+            self._live["train_step"] = global_step
+            # A re-reached save boundary must re-save (no committed dir
+            # newer than the restore target can exist — it would have
+            # been the restore target).
+            self._last_save_step = None
+            if dataset is not None and spe:
+                new_epoch = min(ck_cursor // spe, cfg.train.num_epochs)
+                resume_point["epoch"] = new_epoch
+                resume_point["skip"] = ck_cursor % spe
+                return new_state, new_epoch
+            return new_state, epoch
+
         _EPOCH_END = object()  # sentinel: a batch is never this object
         try:
-            for epoch in range(start_epoch, cfg.train.num_epochs):
+            epoch = start_epoch
+            while epoch < cfg.train.num_epochs:
                 batch_iter = make_batch_iter(epoch)
+                if dataset is not None and spe:
+                    cursor["fetch"] = epoch * spe + (
+                        resume_point["skip"]
+                        if epoch == resume_point["epoch"] else 0)
                 while True:
                     # Manual iteration so the data-pipeline wait is its
                     # own trace span (the phase MegaScale singles out:
@@ -900,6 +1156,22 @@ class Trainer:
                         batch = next(batch_iter, _EPOCH_END)
                     if batch is _EPOCH_END:
                         break
+                    # Data position of THIS batch in the global schedule
+                    # (epoch * steps_per_epoch + index): the key the
+                    # sentinel's quarantine list is kept in — optimizer
+                    # steps renumber once windows are skipped, positions
+                    # never do.
+                    pos = cursor["fetch"]
+                    cursor["fetch"] += 1
+                    if skiplist is not None and pos in skiplist.quarantined():
+                        skipped_windows += 1
+                        self._live["sentinel_windows_skipped"] = \
+                            skipped_windows
+                        if is_main_process():
+                            self.logger.warning(
+                                "sentinel: skipping quarantined data "
+                                "window %d", pos)
+                        continue
                     # A pending window always has len < take <= remaining
                     # step budget (it drains the moment it reaches take),
                     # so this check never skips queued-but-unrun steps.
@@ -925,6 +1197,15 @@ class Trainer:
                         host_batch, batch = batch
                     else:
                         host_batch = batch
+                    if self._fault is not None:
+                        # Numeric chaos (nan-grad / poison-batch): corrupt
+                        # the HOST batch before placement so the fault
+                        # flows through the genuine compiled step.
+                        corrupted = self._fault.maybe_corrupt_batch(
+                            pos, global_step + len(window) + 1, host_batch)
+                        if corrupted is not None:
+                            host_batch = corrupted
+                            batch = corrupted
                     if self.mesh is not None:
                         from dlti_tpu.parallel.sharding import make_global_batch
 
@@ -941,7 +1222,7 @@ class Trainer:
                         rng_base, global_step + len(window) + 1)
                     if multi_fn is None:
                         state, executed = exec_steps(
-                            state, [(host_batch, batch, step_rng)])
+                            state, [(host_batch, batch, step_rng, pos)])
                     else:
                         if window and not _batch_compatible(
                                 window[0][0], host_batch):
@@ -954,7 +1235,7 @@ class Trainer:
                             state, executed = drain_window(state)
                             if executed:
                                 bookkeep(state, executed)
-                        window.append((host_batch, batch, step_rng))
+                        window.append((host_batch, batch, step_rng, pos))
                         take = sync_k
                         if cfg.train.max_steps:
                             take = min(take,
@@ -972,26 +1253,43 @@ class Trainer:
                         else:  # max_steps-capped short window
                             state, executed = drain_window(state)
                     bookkeep(state, executed)
+                    if self._fault is not None:
+                        # param-flip chaos: corrupt a replicated leaf in
+                        # the LIVE state at the step boundary (rank-gated)
+                        # — the SDC probe's drill input.
+                        flipped = self._fault.maybe_corrupt_state(
+                            global_step, state)
+                        if flipped is not None:
+                            state = flipped
+                    if self._rollback_due is not None:
+                        break
                     if self._stop_requested:
                         break
-                # Epoch over (or preempted / max_steps): stop the worker
-                # and drop its buffered batches — they were never counted,
-                # so resume replays them.
+                # Epoch over (or preempted / max_steps / rollback): stop
+                # the worker and drop its buffered batches — they were
+                # never counted, so resume/rollback replays them.
                 close_prefetcher()
-                if window and not self._stop_requested:
+                if (window and not self._stop_requested
+                        and self._rollback_due is None):
                     # Epoch tail shorter than the window. On preemption the
                     # pending window is dropped instead — those steps never
                     # counted, so resume replays them.
                     state, executed = drain_window(state)
                     if executed:
                         bookkeep(state, executed)
+                if self._rollback_due is not None:
+                    window.clear()
+                    state, epoch = do_rollback(state, epoch)
+                    continue  # re-enter at the restored data position
                 self._maybe_save(state, global_step, epoch_end=True,
                                  meta=sidecar_meta())
                 if cfg.train.max_steps and global_step >= cfg.train.max_steps:
                     break
                 if self._stop_requested:
                     break
-            if self._stop_requested and cfg.checkpoint.save_strategy != "no":
+                epoch += 1
+            if (self._stop_requested and not self._sdc_evict
+                    and cfg.checkpoint.save_strategy != "no"):
                 from dlti_tpu.checkpoint import (
                     save_train_state, wait_for_saves)
 
@@ -1025,7 +1323,8 @@ class Trainer:
                 exc = sys.exc_info()[1]
                 if exc is not None:
                     flight.dump(reason="fatal_exception", exc=exc)
-                elif self._stop_requested:
+                elif self._stop_requested and not self._sdc_evict:
+                    # (an SDC eviction already dumped its own black box)
                     flight.dump(reason="preemption_stop")
             if watchdog is not None:
                 watchdog.stop()

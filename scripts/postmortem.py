@@ -45,7 +45,18 @@ _KEY_METRICS = (
     "requests", "generated_tokens", "active_seqs", "waiting", "free_blocks",
     "gateway_queue_depth", "gateway_inflight", "preemptions",
     "trace_dropped_events",
+    # Numeric-fault sentinel (dlti_tpu.training.sentinel).
+    "sentinel_nonfinite_steps", "sentinel_loss_spikes",
+    "sentinel_grad_spikes", "sentinel_skipped_updates",
+    "sentinel_rollbacks", "sentinel_quarantined_windows",
+    "sentinel_windows_skipped", "sdc_probes", "sdc_mismatches",
+    "numeric_faults",
 )
+
+# Sentinel dump reasons / context keys surfaced as their own report
+# section (a numeric incident reads differently from a crash: the
+# process is healthy, the NUMBERS died).
+_SENTINEL_REASONS = ("sentinel_rollback", "sdc_mismatch")
 
 
 def _resolve_dump(path: str) -> str:
@@ -84,6 +95,17 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
             e.get("name", "?"), 0) + 1
 
     exc = ctx_file.get("exception")
+    # Numeric-fault evidence: sentinel dumps carry their verdict in
+    # context.json's top level (rollback streak / SDC alert), and any
+    # dump may carry the last anomaly the trainer noted.
+    sentinel: dict = {}
+    if ctx_file.get("reason") in _SENTINEL_REASONS:
+        for k in ("streak", "restored_step", "struck_windows",
+                  "quarantined", "rollbacks", "alert", "suspect_self"):
+            if k in ctx_file:
+                sentinel[k] = ctx_file[k]
+    if context.get("sentinel_last_anomaly"):
+        sentinel["last_anomaly"] = context["sentinel_last_anomaly"]
     return {
         "dump": dump_dir,
         "reason": ctx_file.get("reason"),
@@ -98,6 +120,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
                                            context.get("step")),
         "phase_at_death": phase,
         "exception_tail": (exc.strip().splitlines()[-3:] if exc else None),
+        "sentinel": sentinel or None,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
         "tracer_enabled": spans.get("tracerEnabled"),
@@ -199,6 +222,23 @@ def render(summary: dict) -> str:
         w("exception:")
         for line in summary["exception_tail"]:
             w(f"    {line}")
+    if summary.get("sentinel"):
+        s = summary["sentinel"]
+        w("sentinel:       (numeric-fault evidence)")
+        if s.get("last_anomaly"):
+            la = s["last_anomaly"]
+            w(f"    last anomaly: {la.get('kind')} at step "
+              f"{la.get('step')} (data window {la.get('data_pos')})")
+        if s.get("streak") is not None:
+            w(f"    rollback #{s.get('rollbacks')}: streak "
+              f"{s['streak']} -> restored step {s.get('restored_step')}, "
+              f"struck windows {s.get('struck_windows')}"
+              + (f", QUARANTINED {s['quarantined']}"
+                 if s.get("quarantined") else ""))
+        if s.get("alert"):
+            w(f"    sdc: {s['alert'].get('message')}"
+              + ("  << THIS RANK IS THE SUSPECT"
+                 if s.get("suspect_self") else ""))
     if summary["watchdog_alerts"]:
         w(f"watchdog:      {len(summary['watchdog_alerts'])} alert(s) "
           f"before death:")

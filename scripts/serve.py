@@ -102,9 +102,22 @@ def parse_args():
                    help="failover resubmissions per request after a "
                         "replica step fault")
     p.add_argument("--fault-inject-step", default="",
-                   help="chaos hook 'REPLICA:STEP': kill that replica on "
-                        "its STEP-th step (also env "
+                   help="chaos hook 'REPLICA:STEP[:MODE]': kill that "
+                        "replica on its STEP-th step — MODE 'raise' "
+                        "(default) raises in place of a device fault; "
+                        "'nan-logits' poisons the replica's params so "
+                        "the engine's numeric output guard trips the "
+                        "same quarantine (also env "
                         "DLTI_GATEWAY_FAULT_INJECT)")
+    p.add_argument("--no-numeric-guard", action="store_true",
+                   help="disable the nonfinite decode-output guard "
+                        "(NumericFault -> replica quarantine; leaving it "
+                        "on is how a numerically-dead replica never "
+                        "streams garbage to users)")
+    p.add_argument("--guard-token-storm", type=int, default=0,
+                   help="quarantine a replica after N consecutive decode "
+                        "steps where every active slot sampled the same "
+                        "token (degenerate-output storm; 0 = off)")
     p.add_argument("--affinity", action="store_true",
                    help="cache-affinity routing: sticky rendezvous-hash a "
                         "session key (X-Session header, else hashed prompt "
@@ -254,6 +267,8 @@ def main() -> None:
         spec_cooldown=args.spec_cooldown,
         max_prefill_tokens_per_step=args.max_prefill_tokens,
         decode_state_cache=not args.no_decode_state_cache,
+        guard_nonfinite=not args.no_numeric_guard,
+        guard_token_storm=args.guard_token_storm,
     )
     if args.replicas > 1:
         from dlti_tpu.serving import ReplicatedEngine

@@ -136,11 +136,40 @@ def parse_args():
                    help="skip the verified scan-latest-and-resume pass")
     p.add_argument("--fault-inject-step", default="",
                    help="deterministic trainer chaos hook 'STEP[:MODE]' "
-                        "(MODE: raise | kill | save-raise | save-kill) — "
-                        "crash or SIGKILL the trainer at that optimizer "
-                        "step (or mid-async-save) to drill the verified "
-                        "checkpoint/resume path; also via env "
-                        "DLTI_TRAIN_FAULT_INJECT")
+                        "(MODE: raise | kill | save-raise | save-kill | "
+                        "nan-grad | poison-batch | param-flip[:RANK]) — "
+                        "crash/SIGKILL the trainer, or inject a numeric "
+                        "fault (NaN grads, a deterministically-poisoned "
+                        "data window, a silent param bit-flip) to drill "
+                        "the sentinel's skip/rollback/quarantine/SDC "
+                        "paths; also via env DLTI_TRAIN_FAULT_INJECT")
+    # Numeric-fault sentinel (dlti_tpu.training.sentinel).
+    p.add_argument("--no-sentinel", action="store_true",
+                   help="disable the numeric-fault sentinel (per-step "
+                        "nonfinite/spike detection + automatic rollback; "
+                        "the in-step nonfinite update gate stays — it is "
+                        "a correctness fix, not an option)")
+    p.add_argument("--sentinel-rollback-after", type=int, default=3,
+                   help="consecutive anomalous steps before automatic "
+                        "rollback to the last verified checkpoint (0 = "
+                        "detect only, never roll back)")
+    p.add_argument("--sentinel-window", type=int, default=32,
+                   help="rolling-median spike window (steps)")
+    p.add_argument("--sentinel-min-samples", type=int, default=8,
+                   help="normal steps required before spike detection "
+                        "arms (cold start)")
+    p.add_argument("--sentinel-loss-spike-factor", type=float, default=2.0,
+                   help="loss spike threshold: latest > factor x rolling "
+                        "median")
+    p.add_argument("--sentinel-quarantine-after", type=int, default=2,
+                   help="rollbacks implicating a data window before it is "
+                        "quarantined permanently (below that it replays)")
+    p.add_argument("--sdc-check-interval", type=int, default=0,
+                   help="cross-rank param-digest SDC probe cadence in "
+                        "optimizer steps (0 = off; multi-process runs "
+                        "only) — a mismatching rank is flagged as the "
+                        "suspect host, dumps a flight record, and exits "
+                        "87 for the elastic supervisor to evict")
     p.add_argument("--export-dir", default=None,
                    help="write a consolidated merged-LoRA export here after training")
     p.add_argument("--init-from-hf", default=None, metavar="DIR",
@@ -234,8 +263,8 @@ def build_config(args):
 
     from dlti_tpu.config import (
         CheckpointConfig, DataConfig, FlightRecorderConfig, LoRAConfig,
-        OptimizerConfig, TelemetryConfig, TrainConfig, WatchdogConfig,
-        ZeROStage, preset,
+        OptimizerConfig, SentinelConfig, TelemetryConfig, TrainConfig,
+        WatchdogConfig, ZeROStage, preset,
     )
 
     cfg = preset(args.preset, model=args.model)
@@ -348,7 +377,15 @@ def build_config(args):
                           eval_steps=args.eval_steps,
                           profile_dir=args.profile_dir,
                           profile_start_step=args.profile_start_step,
-                          profile_num_steps=args.profile_num_steps),
+                          profile_num_steps=args.profile_num_steps,
+                          sentinel=SentinelConfig(
+                              enabled=not args.no_sentinel,
+                              rollback_after=args.sentinel_rollback_after,
+                              window=args.sentinel_window,
+                              min_samples=args.sentinel_min_samples,
+                              loss_spike_factor=args.sentinel_loss_spike_factor,
+                              quarantine_after=args.sentinel_quarantine_after,
+                              sdc_check_interval=args.sdc_check_interval)),
         telemetry=TelemetryConfig(
             trace_dir=args.trace_dir,
             trace_capacity=args.trace_capacity,

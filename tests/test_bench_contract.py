@@ -196,7 +196,47 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
     assert watchdog.RULES == (
         "hung_step", "throughput_collapse", "queue_buildup",
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
+        "nonfinite_step", "loss_spike", "sdc_mismatch",
     )
+
+
+def test_sentinel_metric_names_are_schema_stable():
+    """Numeric-fault-sentinel telemetry names are a scrape contract like
+    the watchdog/ckpt sets: anomaly/skip/rollback/quarantine counters and
+    the cross-rank SDC probe counters, all registered by the server
+    registry for /dashboard."""
+    from dlti_tpu.training import sentinel
+
+    assert sentinel.SENTINEL_METRIC_NAMES == (
+        "dlti_sentinel_anomalies_total",
+        "dlti_sentinel_skipped_updates_total",
+        "dlti_sentinel_rollbacks_total",
+        "dlti_sentinel_quarantined_windows_total",
+    )
+    assert sentinel.SDC_METRIC_NAMES == (
+        "dlti_sdc_probes_total",
+        "dlti_sdc_mismatches_total",
+    )
+    assert sentinel.anomalies_total.name == sentinel.SENTINEL_METRIC_NAMES[0]
+    assert sentinel.skipped_updates_total.name == \
+        sentinel.SENTINEL_METRIC_NAMES[1]
+    assert sentinel.rollbacks_total.name == sentinel.SENTINEL_METRIC_NAMES[2]
+    assert sentinel.quarantined_windows_total.name == \
+        sentinel.SENTINEL_METRIC_NAMES[3]
+    assert sentinel.sdc_probes_total.name == sentinel.SDC_METRIC_NAMES[0]
+    assert sentinel.sdc_mismatches_total.name == sentinel.SDC_METRIC_NAMES[1]
+    # The suspect-rank exit code is a supervisor-attribution contract
+    # (clear of shell/signal codes and the watchdog's abort 86).
+    assert sentinel.SDC_EXIT_CODE == 87
+
+
+def test_steplog_sentinel_fields_are_schema_stable():
+    """The per-step JSONL stream's sentinel triple (what an incident
+    reader greps first) is part of the step-record contract."""
+    from dlti_tpu.telemetry.steplog import STEP_RECORD_FIELDS
+
+    assert {"anomaly", "skipped_update", "rollbacks_total"} <= set(
+        STEP_RECORD_FIELDS)
 
 
 def test_elastic_metric_names_are_schema_stable():

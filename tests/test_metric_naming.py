@@ -39,6 +39,9 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
     from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
     from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
+    from dlti_tpu.training.sentinel import (
+        SDC_METRIC_NAMES, SENTINEL_METRIC_NAMES,
+    )
 
     for tup, where in ((CKPT_METRIC_NAMES, "checkpoint"),
                        (PREFETCH_METRIC_NAMES, "prefetch"),
@@ -46,20 +49,25 @@ def test_pinned_name_tuples_follow_convention():
                        (PREFIX_CACHE_METRIC_NAMES, "prefix_cache"),
                        (WATCHDOG_METRIC_NAMES, "watchdog"),
                        (FLIGHT_METRIC_NAMES, "flightrecorder"),
-                       (ELASTIC_METRIC_NAMES, "elastic")):
+                       (ELASTIC_METRIC_NAMES, "elastic"),
+                       (SENTINEL_METRIC_NAMES, "sentinel"),
+                       (SDC_METRIC_NAMES, "sdc")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
     from dlti_tpu.telemetry import flightrecorder, watchdog
-    from dlti_tpu.training import elastic
+    from dlti_tpu.training import elastic, sentinel
 
     objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
             store.save_retries, store.last_verified_step,
             watchdog.alerts_total, flightrecorder.dumps_total,
             elastic.restarts_total, elastic.generation_gauge,
-            elastic.world_size_gauge)
+            elastic.world_size_gauge,
+            sentinel.anomalies_total, sentinel.skipped_updates_total,
+            sentinel.rollbacks_total, sentinel.quarantined_windows_total,
+            sentinel.sdc_probes_total, sentinel.sdc_mismatches_total)
     _assert_convention([m.name for m in objs], "module-level metrics")
 
 
@@ -120,7 +128,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_train_prefetch_queue_depth",
                      "dlti_prefix_cache_hits_total",
                      "dlti_prefix_cache_blocks",
-                     "dlti_prefix_cache_hit_rate"):
+                     "dlti_prefix_cache_hit_rate",
+                     "dlti_sentinel_rollbacks_total",
+                     "dlti_sdc_mismatches_total"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")
 

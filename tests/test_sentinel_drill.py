@@ -1,0 +1,281 @@
+"""Numeric-fault chaos drills against the real CLIs (slow tier).
+
+The honest versions of what ``tests/test_sentinel.py`` proves in-process,
+with no human in the loop anywhere:
+
+* ``nan-grad`` through ``scripts/train.py``: the injected NaN batch runs
+  the genuine compiled step, the in-step gate skips the update, the
+  steplog records the anomaly, and the run completes.
+* ``poison-batch``: a deterministically-corrupt data window spikes the
+  loss; the sentinel rolls back to the last verified checkpoint, replays
+  (the window re-poisons, like real bad data), rolls back again,
+  quarantines the window permanently — and the final loss trajectory is
+  step-for-step identical to a clean run over the surviving data.
+* ``param-flip`` on rank 1 of a REAL 2-process gloo run under the
+  elastic supervisor: the cross-rank digest probe flags rank 1 as the
+  SDC suspect, rank 1 writes a flight dump and exits with the
+  distinctive code, the supervisor evicts it, reshapes to the survivor,
+  resumes from the last verified step, rejoins at the next checkpoint
+  boundary — and the post-recovery losses match an uninterrupted run
+  step for step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, "scripts", "train.py")
+LAUNCH = os.path.join(REPO, "scripts", "launch.py")
+
+
+def _losses(steplog):
+    """{step: loss} with LAST occurrence winning (rollbacks/resumes
+    re-log replayed steps) + the raw step order."""
+    out, order = {}, []
+    with open(steplog) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "step":
+                out[rec["step"]] = rec["loss"]
+                order.append(rec["step"])
+    return out, order
+
+
+def _steplog_records(steplog):
+    return [json.loads(l) for l in open(steplog)
+            if json.loads(l).get("type") == "step"]
+
+
+# ----------------------------------------------------------------------
+# Single-process drills: nan-grad skip, poison-batch rollback+quarantine
+# ----------------------------------------------------------------------
+
+def _write_learnable_corpus(path, n=64):
+    # Identical rows of DISTINCT bytes: a full fine-tune at lr 1e-2
+    # learns the order within a few steps (loss -> ~0), so a permuted
+    # (poisoned) batch is a genuine, large relative loss spike — and
+    # permutation actually changes it (an all-'x' row would be
+    # permutation-invariant).
+    row = "abcdefghijklmnopqrstuvwxyz012345"
+    with open(path, "w") as f:
+        for _ in range(n):
+            f.write(row + "\n")
+
+
+def _run_train(tmp_path, tag, out_dir, extra, timeout=420):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_backend_optimization_level=0"
+    cmd = [
+        sys.executable, TRAIN,
+        "--preset", "baseline", "--model", "llama_tiny",
+        "--tokenizer", "byte",
+        "--dataset-path", str(tmp_path / "corpus.txt"),
+        "--output-dir", str(out_dir),
+        "--max-seq-len", "32", "--per-device-batch-size", "2",
+        "--gradient-accumulation-steps", "1",
+        "--lora-r", "0", "--learning-rate", "0.01",
+        "--warmup-steps", "2", "--max-steps", "14", "--save-steps", "2",
+        "--save-total-limit", "10", "--logging-steps", "1000",
+        "--sentinel-rollback-after", "1", "--sentinel-window", "4",
+        "--sentinel-min-samples", "4",
+        "--sentinel-loss-spike-factor", "1.5",
+        "--metrics-csv", str(tmp_path / f"{tag}.csv"),
+        "--step-log", str(tmp_path / f"{tag}.jsonl"),
+    ] + extra
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_nan_grad_then_poison_batch_full_recovery_loop(tmp_path):
+    _write_learnable_corpus(tmp_path / "corpus.txt")
+
+    # Phase 1 — nan-grad: a transient NaN batch skips its update (the
+    # bf16 gate), books one anomaly, and the run completes on its own.
+    nan = _run_train(tmp_path, "nan", tmp_path / "ck_nan",
+                     ["--fault-inject-step", "3:nan-grad"])
+    assert nan.returncode == 0, nan.stderr[-3000:]
+    recs = _steplog_records(tmp_path / "nan.jsonl")
+    by_step = {}
+    for r in recs:
+        by_step[r["step"]] = r  # last occurrence wins (rollback replays)
+    first_log = {}
+    for r in recs:
+        first_log.setdefault(r["step"], r)
+    assert first_log[3]["anomaly"] == "nonfinite"
+    assert first_log[3]["skipped_update"] == 1
+    # rollback_after=1: even the transient NaN triggers one rollback and
+    # a clean replay — the replayed step 3 is normal.
+    assert by_step[3]["anomaly"] == ""
+    assert by_step[14]["rollbacks_total"] >= 1
+
+    # Phase 2 — poison-batch at data position 10: the window re-poisons
+    # on replay (deterministic bad data), so rollback #1 replays it,
+    # rollback #2 quarantines it permanently.
+    poi = _run_train(tmp_path, "poi", tmp_path / "ck_poi",
+                     ["--fault-inject-step", "10:poison-batch"])
+    assert poi.returncode == 0, poi.stderr[-3000:]
+    poi_losses, poi_order = _losses(tmp_path / "poi.jsonl")
+    assert poi_losses, "poisoned run logged no steps"
+    assert max(poi_losses) == 14
+    # The rollbacks are visible in the steplog...
+    assert any(r["rollbacks_total"] >= 2
+               for r in _steplog_records(tmp_path / "poi.jsonl"))
+    # ...and the quarantine persisted.
+    skip = json.load(open(tmp_path / "ck_poi" / "sentinel_skiplist.json"))
+    quarantined = [w["pos"] for w in skip["windows"] if w["quarantined"]]
+    assert quarantined == [10], skip
+
+    # Phase 3 — the acceptance bar: the recovered trajectory equals a
+    # CLEAN run over the surviving data (same quarantine pre-seeded, no
+    # chaos), step for step, exactly.
+    ck_ref = tmp_path / "ck_ref"
+    ck_ref.mkdir()
+    (ck_ref / "sentinel_skiplist.json").write_text(json.dumps(skip))
+    ref = _run_train(tmp_path, "ref", ck_ref, [])
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    ref_losses, _ = _losses(tmp_path / "ref.jsonl")
+    assert set(ref_losses) == set(poi_losses)
+    for step, loss in ref_losses.items():
+        assert poi_losses[step] == loss, (step, poi_losses[step], loss)
+
+
+# ----------------------------------------------------------------------
+# 2-process gloo drill: param-flip SDC -> attribute -> evict -> resume
+# ----------------------------------------------------------------------
+
+def test_sdc_param_flip_attributed_evicted_and_recovered(tmp_path):
+    n_rows, seq = 128, 32
+    # Fixed-length rows (every line truncates to seq tokens): the same
+    # mesh/schedule shape as the PR-6 elastic drill, whose world-2 -> 1
+    # grad-accum regrouping is proven bit-identical — the trajectory
+    # assertion below needs that exactness through the post-evict
+    # replay. (Under ZeRO-3 llama_tiny's params all sit below the FSDP
+    # size floor, so every param leaf stays cross-process replicated and
+    # the digest probe covers the whole tree.)
+    data = tmp_path / "data.txt"
+    data.write_text("".join(
+        f"row {i:04d} " + "x" * 64 + "\n" for i in range(n_rows)))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+
+    def train_cmd(out_dir, steplog):
+        return [
+            sys.executable, TRAIN,
+            "--preset", "zero3", "--model", "llama_tiny",
+            "--tokenizer", "byte",
+            "--dataset-path", str(data), "--output-dir", str(out_dir),
+            "--max-seq-len", str(seq), "--per-device-batch-size", "1",
+            "--gradient-accumulation-steps", "2",
+            "--num-train-epochs", "1", "--save-steps", "2",
+            "--save-total-limit", "10", "--warmup-steps", "2",
+            "--logging-steps", "1", "--prefetch-depth", "0",
+            "--sdc-check-interval", "2",
+            "--step-log", str(steplog),
+            "--metrics-csv", str(tmp_path / "m.csv"),
+            "--flight-dir", str(tmp_path / "flight"),
+        ]
+
+    # Uninterrupted reference: ONE process, 8 virtual devices — the same
+    # global mesh extent and (world-size-invariant) batch schedule.
+    ref_env = dict(env)
+    ref_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    ref_log = tmp_path / "ref_steps.jsonl"
+    proc = subprocess.run(train_cmd(tmp_path / "ref_ckpt", ref_log),
+                          env=ref_env, capture_output=True, text=True,
+                          timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    ref_losses, _ = _losses(ref_log)
+    assert len(ref_losses) == n_rows // (8 * 2)  # 8 steps/epoch
+
+    # SDC run: 2 gloo processes x 4 devices under the elastic
+    # supervisor; rank 1 flips one mantissa bit in a replicated param at
+    # step 3; the digest probe (every 2 steps) must flag rank 1 at
+    # step 4.
+    el_env = dict(env)
+    el_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    el_env["DLTI_TRAIN_FAULT_INJECT"] = "3:param-flip:1"
+    ckpt = tmp_path / "ckpt"
+    el_log = tmp_path / "el_steps.jsonl"
+    elastic_dir = tmp_path / "elastic"
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "--num-processes", "2", "--elastic",
+         "--restart-budget", "4", "--backoff", "0.5",
+         "--ckpt-dir", str(ckpt), "--elastic-dir", str(elastic_dir),
+         "--log-dir", str(tmp_path / "logs"), "--term-grace", "30", "--",
+         *train_cmd(ckpt, el_log)],
+        env=el_env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.is_dir():
+        for p in sorted(logdir.iterdir()):
+            if p.suffix == ".err":
+                logs += f"--- {p.name} ---\n" + p.read_text()[-1500:]
+    assert proc.returncode == 0, (
+        f"supervisor rc={proc.returncode}\n{proc.stderr[-2000:]}\n{logs}")
+
+    events = [json.loads(line) for line in
+              open(elastic_dir / "elastic_events.jsonl")]
+    kinds = [e["event"] for e in events]
+    # The suspect rank exited with the SDC code and the supervisor
+    # booked exactly that slot as the failure (healthy ranks exit 0, so
+    # attribution is unambiguous).
+    from dlti_tpu.training.sentinel import SDC_EXIT_CODE
+
+    sdc_failures = [e for e in events if e["event"] == "failure"
+                    and e.get("rc") == SDC_EXIT_CODE]
+    assert sdc_failures, events
+    assert all(e["slot"] == 1 for e in sdc_failures), sdc_failures
+    # Evict -> reshape to the survivor -> resume -> rejoin full size.
+    first_fail = kinds.index("failure")
+    post = next(e for e in events[first_fail:] if e["event"] == "spawn")
+    assert post["world_size"] == 1, post
+    assert "rejoin" in kinds, kinds
+    spawns = [e for e in events if e["event"] == "spawn"]
+    assert spawns[-1]["world_size"] == 2, spawns
+
+    # The suspect wrote its black box before evicting itself, tagged
+    # with its rank, carrying the SDC verdict.
+    import glob
+
+    dumps = sorted(glob.glob(str(tmp_path / "flight" / "flight-*-r1*")))
+    assert dumps, os.listdir(tmp_path / "flight")
+    contexts = [json.load(open(os.path.join(d, "context.json")))
+                for d in dumps]
+    # The flip itself left the chaos pre-fire dump; the PROBE's verdict
+    # dump names this rank as the suspect.
+    ctx = next(c for c in contexts if c["reason"] == "sdc_mismatch")
+    assert ctx["suspect_self"] is True
+    assert ctx["alert"]["suspects"] == [1]
+
+    # And the recovered trajectory matches the uninterrupted run step
+    # for step (contaminated steps were re-executed from the verified
+    # checkpoint; the step log's final value per step is the replay's).
+    # Same tolerance as the PR-6 elastic drill: steps replayed by the
+    # SHRUNK world regroup the grad-accum reductions, which reorders
+    # floating-point sums (allclose, not bitwise); within a fixed world
+    # size the replay IS bit-exact (the single-process drills above
+    # assert strict equality).
+    import numpy as np
+
+    el_losses, order = _losses(el_log)
+    assert set(el_losses) == set(ref_losses)
+    for step in sorted(ref_losses):
+        np.testing.assert_allclose(
+            el_losses[step], ref_losses[step], rtol=2e-4,
+            err_msg=f"loss diverged at step {step} "
+                    f"(elastic {el_losses[step]} vs ref "
+                    f"{ref_losses[step]})")
+    restarts = [order[i] for i in range(1, len(order))
+                if order[i] <= order[i - 1]]
+    assert restarts, "step log shows no resume after eviction"
