@@ -382,14 +382,24 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
     state, loss_val = run(state, STEPS)
     state, loss_val = run(state, STEPS + 1)
 
+    # Goodput ledger over the measured loop (telemetry.ledger): books the
+    # dispatch+sync of each compiled call as productive step compute and
+    # everything between as host overhead, so the BENCH JSON records
+    # attribution (goodput_fraction + bucket totals), not just tok/s.
+    from dlti_tpu.telemetry import GoodputLedger
+
+    ledger = GoodputLedger()
     t0 = time.perf_counter()
     for i in range(STEPS):
+        ledger.enter("step_compute")
         state, loss_val = run(state, i)
+        ledger.enter("other")
         if _WATCHDOG is not None:
             _WATCHDOG.notify_step(i)
     dt = (time.perf_counter() - t0) / (STEPS * sync)
     tok_s = micro_bs * SEQ / dt
-    return tok_s, dt, trainable, total, loss_val
+    goodput = ledger.to_dict()
+    return tok_s, dt, trainable, total, loss_val, goodput
 
 
 def main() -> None:
@@ -456,13 +466,13 @@ def main() -> None:
             break
         _BEST["last_candidate"] = c
         try:
-            tok_s, dt, trainable, total, loss = _try_run(
+            tok_s, dt, trainable, total, loss, goodput = _try_run(
                 c["model"], c["bs"], quant=c.get("quant", ""),
                 remat_policy=c.get("remat_policy", ""),
                 remat_stride=c.get("remat_stride", 0),
                 loss_chunk=c.get("loss_chunk", 0),
                 sync=c.get("sync", 1))
-            result = (c, tok_s, dt, trainable, total, loss)
+            result = (c, tok_s, dt, trainable, total, loss, goodput)
             # Minimal best-so-far for the watchdog: if anything after the
             # loop stalls (e.g. a device query in MFU derivation), the
             # deadline still emits a real measurement, not an error.
@@ -485,7 +495,7 @@ def main() -> None:
                           f"first: {failures[0] if failures else None})"))
         sys.exit(5)
 
-    c, tok_s, dt, trainable, total, loss = result
+    c, tok_s, dt, trainable, total, loss, goodput = result
     model_name, bs = c["model"], c["bs"]
     peak = detect_chip_peak_flops()
     mfu = compute_mfu(tok_s, total, peak, trainable_params=trainable)
@@ -514,6 +524,12 @@ def main() -> None:
         "remat_policy": c.get("remat_policy", ""),
         "remat_stride": c.get("remat_stride", 0),
         "steps_per_sync": c.get("sync", 1),
+        # Goodput attribution over the measured loop (telemetry.ledger):
+        # the r06+ BENCH trajectory records where the wall clock went,
+        # not just the throughput headline.
+        "goodput_fraction": goodput.get("goodput_fraction", 0.0),
+        "goodput_buckets": {k: round(v, 4) for k, v in
+                            (goodput.get("buckets") or {}).items()},
         # Watchdog verdict: nonzero means the measured loop misbehaved
         # (hung step etc.) — regression tooling should distrust `value`.
         "watchdog_alerts": (sum(_WATCHDOG.alert_counts().values())

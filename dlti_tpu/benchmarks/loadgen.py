@@ -96,6 +96,11 @@ class RequestRecord:
     # session) vs a cold first-touch prompt.
     session: str = ""
     warm: bool = False
+    # Server-side critical-path breakdown (the response's "phases"
+    # object: gateway queue, engine queue, tier restore, prefill,
+    # failover, decode — telemetry.ledger); empty when the server
+    # predates it or the request failed.
+    phases: dict = field(default_factory=dict)
 
     @property
     def shed(self) -> bool:
@@ -157,6 +162,15 @@ class LoadReport:
     warm_ttft_p50_s: float = 0.0
     warm_ttft_p90_s: float = 0.0
     cache_hit_rate: float = 0.0
+    # Critical-path decomposition (goodput-ledger era): mean seconds per
+    # server-reported phase (gateway queue, engine queue, tier restore,
+    # prefill, failover, decode) over all ok requests, and the cold vs
+    # warm split — the "warm TTFT is lower BECAUSE restore replaced
+    # prefill" evidence, not just the headline percentiles. Empty when
+    # the server doesn't report phases.
+    phase_means: dict = field(default_factory=dict)
+    cold_phases: dict = field(default_factory=dict)
+    warm_phases: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -276,6 +290,8 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                     if obj.get("usage"):
                         usage_tokens = int(
                             obj["usage"].get("completion_tokens", 0))
+                    if obj.get("phases"):
+                        rec.phases = dict(obj["phases"])
             # Prefer the final chunk's usage (token-accurate; our server
             # always sends it — stream_options.include_usage semantics).
             # Fallback: SSE event count, the stream's visible progress
@@ -287,6 +303,8 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             obj = json.loads(raw)
             usage = obj.get("usage", {})
             rec.output_tokens = int(usage.get("completion_tokens", 0))
+            if obj.get("phases"):
+                rec.phases = dict(obj["phases"])
             rec.ok = True
     except Exception as e:  # noqa: BLE001 — one request's failure is a
         # recorded data point, never a crash of the whole load test.
@@ -449,6 +467,23 @@ def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
     if cfg.deadline_s and cfg.deadline_s > 0:
         body["deadline_s"] = cfg.deadline_s
     return path, body, headers, tenant, priority
+
+
+def _phase_means(recs: List[RequestRecord]) -> dict:
+    """Mean seconds per server-reported critical-path phase over the
+    records that carried one ({} when none did). "total_s"/"ttft_s" ride
+    along so the breakdown can be sanity-checked against the client-side
+    latency percentiles."""
+    agg: dict = {}
+    n = 0
+    for r in recs:
+        if not r.phases:
+            continue
+        n += 1
+        for k, v in r.phases.items():
+            if isinstance(v, (int, float)):
+                agg[k] = agg.get(k, 0.0) + float(v)
+    return {k: round(v / n, 4) for k, v in agg.items()} if n else {}
 
 
 def _class_summary(recs: List[RequestRecord]) -> dict:
@@ -623,6 +658,9 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         warm_ttft_p50_s=round(_percentile(warm_ttfts, 50), 4),
         warm_ttft_p90_s=round(_percentile(warm_ttfts, 90), 4),
         cache_hit_rate=cache_hit_rate,
+        phase_means=_phase_means(ok),
+        cold_phases=_phase_means(cold),
+        warm_phases=_phase_means(warm),
     )
 
 

@@ -425,6 +425,12 @@ class WatchdogConfig:
     heartbeat_stale_s: float = 0.0
     # ckpt_retry_storm: save retries accrued across the ring window.
     ckpt_retry_limit: int = 3
+    # goodput_collapse: the goodput ledger's productive fraction (the
+    # `goodput_fraction` ring series, telemetry.ledger) below
+    # goodput_floor_frac x its rolling median over at least
+    # goodput_min_samples samples (0 floor = rule off).
+    goodput_floor_frac: float = 0.5
+    goodput_min_samples: int = 8
 
 
 @dataclass(frozen=True)
@@ -466,6 +472,13 @@ class TelemetryConfig:
     # process reports its step (collective on multi-host meshes) and rank
     # 0 logs straggler lag.
     heartbeat_interval_steps: int = 0
+    # Goodput ledger (telemetry.ledger): book every wall-clock second of
+    # the run to one bucket (step compute, data wait, device sync, ckpt
+    # save/restore, rollback + replay, SDC probe, ...) and derive the
+    # goodput fraction + per-phase steplog fields. On by default — a
+    # transition is ~a clock read; False reduces every site to one
+    # attribute read (the tracer's disabled-path contract).
+    goodput_ledger: bool = True
     # Self-monitoring: anomaly watchdog rules + flight-recorder black box
     # (see the blocks' own docstrings). Both off by default.
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)

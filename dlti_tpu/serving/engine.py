@@ -211,6 +211,17 @@ class Request:
     # token-emission walk — the slot is released there, so a cancelled
     # request costs at most one decode window.
     cancel_requested: bool = False
+    # Critical-path attribution inputs (telemetry.ledger): when the
+    # request came through the admission gateway, its enqueue time (the
+    # client-observed t0); seconds spent restoring lower-tier prefix
+    # blocks at admission; requeue stalls by kind ("failover"/"preempt")
+    # with the pre-first-token portion split out; and the open requeue
+    # mark note_requeue/note_readmitted maintain.
+    gateway_enqueue_time: Optional[float] = None
+    restore_s: float = 0.0
+    stall_s: Dict[str, float] = field(default_factory=dict)
+    stall_prefill_s: float = 0.0
+    _requeue_mark: Optional[tuple] = None
 
     @property
     def done(self) -> bool:
@@ -1064,6 +1075,7 @@ class InferenceEngine:
                 break  # head-of-line blocking: FCFS, no starvation
             restored_by_tier: Dict[str, int] = {}
             n_restored = 0
+            t_restore = time.monotonic() if tier_keys else 0.0
             for j, key in enumerate(tier_keys):
                 # The alloc's own evictions may have demoted MORE blocks
                 # since the match, but never removed these keys (puts
@@ -1077,6 +1089,15 @@ class InferenceEngine:
                 self.prefix_cache.register_restored(key, blocks[j])
                 restored_by_tier[tier] = restored_by_tier.get(tier, 0) + 1
                 n_restored += 1
+            if n_restored:
+                # Charge the tier fetch + restore dispatch to THIS
+                # request's critical path (telemetry.ledger): a warm-tier
+                # admission's TTFT decomposes into restore vs prefill.
+                now = time.monotonic()
+                req.restore_s += now - t_restore
+                self._tracer.complete(
+                    "engine/tier_restore", t_restore, now, cat="engine",
+                    id=req.request_id, blocks=n_restored)
             if self.prefix_cache is not None:
                 self.stats["prefix_cached_tokens"] += n_cached
                 self.stats["prefix_restored_tokens"] += \

@@ -458,6 +458,10 @@ class AdmissionGateway:
             req.tenant = entry.tenant
             req.priority = entry.priority
             req.deadline = entry.deadline
+            # Critical-path t0 (telemetry.ledger): the client's latency
+            # clock started at gateway admission, not engine submit — the
+            # request's phase breakdown must sum from here.
+            req.gateway_enqueue_time = entry.enqueue_t
             entry.handle.bind(req)
             now = time.monotonic()
             self._tracer.complete("gateway/queued", entry.enqueue_t, now,

@@ -338,6 +338,12 @@ class ReplicatedEngine:
                 continue
             req.num_retries += 1
             self.failover["retries"] += 1
+            # Critical-path attribution: the wait from here to
+            # re-admission on the survivor books as "failover", not as
+            # inflated prefill/decode (telemetry.ledger.note_requeue).
+            from dlti_tpu.telemetry.ledger import note_requeue
+
+            note_requeue(req, "failover")
             target = min(live, key=self._load)
             target.resubmit(req)
             req.replica = self.engines.index(target)

@@ -41,7 +41,9 @@ from dlti_tpu.serving.sampling import SamplingParams
 from dlti_tpu.telemetry import (
     AnomalyWatchdog, FlightRecorder, MetricsRegistry, TimeSeriesSampler,
     get_recorder, get_tracer, install_recorder, render_dashboard_html,
+    request_breakdown,
 )
+from dlti_tpu.telemetry.ledger import REQUEST_PHASES as _REQUEST_PHASES
 from dlti_tpu.utils.logging import get_logger
 
 # /stats keys exposed as Prometheus gauges (point-in-time values); every
@@ -122,6 +124,18 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     registry.add_scalar_source(_prefix_hit_rate,
                                gauge_keys=("prefix_cache_hit_rate",),
                                prefix="dlti_")
+    # Goodput ledger + critical-path attribution (telemetry.ledger):
+    # module-level like the watchdog/flight counters — the per-request
+    # phase totals back the TTFT decomposition on /metrics, and an
+    # in-process trainer's goodput fraction/MFU ride the same registry.
+    from dlti_tpu.telemetry import ledger as _ledger
+
+    for metric in (_ledger.goodput_fraction_gauge,
+                   _ledger.goodput_seconds_total,
+                   _ledger.goodput_mfu_gauge,
+                   _ledger.phase_seconds_total,
+                   _ledger.phase_requests_total):
+        registry.register(metric)
     return registry
 
 
@@ -458,6 +472,24 @@ class _Handler(BaseHTTPRequestHandler):
                 except ValueError:
                     return self._error(400, "tail must be an integer")
             return self._json(200, self.sampler.snapshot(tail))
+        if path == "/debug/slow":
+            # Critical-path attribution (telemetry.ledger): the K worst
+            # requests retained with their full phase timelines — "why
+            # was this p99 request slow: queue, prefill, tier restore,
+            # or failover?" answered without a trace viewer.
+            cp = self.async_engine.engine.telemetry.critical_path
+            n = None
+            if query.startswith("n="):
+                try:
+                    n = max(1, int(query[2:]))
+                except ValueError:
+                    return self._error(400, "n must be an integer")
+            worst = cp.slow.worst(n)
+            return self._json(200, {
+                "k": cp.slow.k, "retained": len(cp.slow),
+                "phases": list(_REQUEST_PHASES),
+                "worst": worst,
+            })
         if path == "/dashboard":
             # Self-contained live dashboard: inline CSS/JS polling
             # /debug/vars — watching a run needs a browser, not a
@@ -741,6 +773,22 @@ class _Handler(BaseHTTPRequestHandler):
             text, finish = text[:cut], "stop"
         return (token_ids, logprobs, text, finish), None
 
+    @staticmethod
+    def _phases_of(req) -> Optional[dict]:
+        """Server-side critical-path breakdown of a finished request
+        (telemetry.ledger): ``{"total_s", "ttft_s", <phase>: s, ...}``.
+        None when the engine request isn't resolvable/finished (so a
+        refusal path never grows a bogus breakdown)."""
+        eng_req = getattr(req, "_req", None) or req
+        if getattr(eng_req, "finish_time", None) is None:
+            return None
+        try:
+            b = request_breakdown(eng_req)
+        except Exception:  # attribution must never fail a response
+            return None
+        return {"total_s": b["total_s"], "ttft_s": b["ttft_s"],
+                **b["phases"]}
+
     def _full_response(self, req: Request, q: queue.Queue, chat: bool,
                        created: int, stops: tuple = ()) -> None:
         got, err = self._collect_choice(req, q, stops)
@@ -762,10 +810,17 @@ class _Handler(BaseHTTPRequestHandler):
         if req.params.logprobs:
             choice["logprobs"] = {"token_logprobs": logprobs,
                                   "tokens": token_ids}
-        self._json(200, {
+        out = {
             "id": req.request_id, "object": obj, "created": created,
             "model": self.cfg.model_name, "choices": [choice], "usage": usage,
-        })
+        }
+        phases = self._phases_of(req)
+        if phases is not None:
+            # Server-side phase attribution (gateway queue, engine queue,
+            # tier restore, prefill, failover, decode): lets a client —
+            # and the loadgen — decompose the latency it observed.
+            out["phases"] = phases
+        self._json(200, out)
 
     def _multi_response(self, subs: list, rid: str, chat: bool,
                         created: int, stops: tuple = ()) -> None:
@@ -904,7 +959,7 @@ class _Handler(BaseHTTPRequestHandler):
             if finish is not None:
                 key = "delta" if chat else "text"
                 val = {} if chat else ""
-                chunk(json.dumps({
+                final = {
                     "id": req.request_id, "object": obj, "created": created,
                     "model": self.cfg.model_name,
                     "choices": [{"index": 0, key: val, "finish_reason": finish}],
@@ -919,7 +974,11 @@ class _Handler(BaseHTTPRequestHandler):
                         "completion_tokens": len(req.output_token_ids),
                         "total_tokens": len(req.prompt_token_ids)
                         + len(req.output_token_ids),
-                    }}))
+                    }}
+                phases = self._phases_of(req)
+                if phases is not None:
+                    final["phases"] = phases
+                chunk(json.dumps(final))
             chunk("[DONE]")
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()

@@ -25,6 +25,10 @@ reference-parity CSV in ``utils/metrics.py``, ``StepTimer`` in
 * :mod:`~dlti_tpu.telemetry.flightrecorder` — black-box ``flight-*/``
   dumps (span tail + metrics + time-series tail + live context) on
   faults, rendered by ``scripts/postmortem.py``.
+* :mod:`~dlti_tpu.telemetry.ledger` — goodput ledger (every training
+  second booked to one bucket, conservation-tested) + per-request
+  critical-path attribution (phase breakdowns summing to client-observed
+  latency, ``GET /debug/slow``), stitched across elastic restarts.
 """
 
 from dlti_tpu.telemetry.registry import (  # noqa: F401
@@ -61,4 +65,14 @@ from dlti_tpu.telemetry.flightrecorder import (  # noqa: F401
     FlightRecorder,
     get_recorder,
     install as install_recorder,
+)
+from dlti_tpu.telemetry.ledger import (  # noqa: F401
+    CriticalPathTracker,
+    GOODPUT_BUCKETS,
+    GoodputLedger,
+    LEDGER_METRIC_NAMES,
+    REQUEST_PHASE_METRIC_NAMES,
+    REQUEST_PHASES,
+    request_breakdown,
+    stitch_ledgers,
 )

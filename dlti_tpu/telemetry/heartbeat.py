@@ -17,6 +17,12 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional, Tuple
 
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+HEARTBEAT_METRIC_NAMES = (
+    "dlti_heartbeat_last_step",
+    "dlti_heartbeat_lag_steps",
+)
+
 
 class Heartbeat:
     def __init__(self, registry=None):
@@ -26,10 +32,15 @@ class Heartbeat:
             self.register(registry)
 
     def register(self, registry) -> None:
-        """Expose per-process last-seen steps as labeled gauges."""
+        """Expose per-process last-seen steps + straggler lag as labeled
+        gauges (``straggler_report`` was log-only before the lag gauge —
+        dashboards could not plot which rank trails by how much)."""
         self._gauge = registry.gauge(
-            "dlti_heartbeat_last_step",
+            HEARTBEAT_METRIC_NAMES[0],
             help="last training step each process reported (rank-0 view)")
+        self._lag_gauge = registry.gauge(
+            HEARTBEAT_METRIC_NAMES[1],
+            help="steps each process trails the fleet head (0 = lockstep)")
 
     def beat(self, step: int) -> Dict[int, Tuple[int, float]]:
         """Report this process's step; COLLECTIVE on multi-host meshes
@@ -53,6 +64,10 @@ class Heartbeat:
         if gauge is not None:
             for proc, (st, _) in self.last_seen.items():
                 gauge.labels(process=str(proc)).set(st)
+        lag_gauge = getattr(self, "_lag_gauge", None)
+        if lag_gauge is not None:
+            for proc, behind in self.lags().items():
+                lag_gauge.labels(process=str(proc)).set(behind)
         return self.last_seen
 
     def lag(self) -> int:
@@ -61,6 +76,14 @@ class Heartbeat:
             return 0
         steps = [st for st, _ in self.last_seen.values()]
         return max(steps) - min(steps)
+
+    def lags(self) -> Dict[int, int]:
+        """Per-process steps behind the fleet head (0 for the head) —
+        the gauge/``/debug/vars`` form of :meth:`straggler_report`."""
+        if not self.last_seen:
+            return {}
+        head = max(st for st, _ in self.last_seen.values())
+        return {p: head - st for p, (st, _) in self.last_seen.items()}
 
     def straggler_report(self) -> Optional[str]:
         """Human-readable lag summary, or None when in lockstep."""

@@ -30,6 +30,9 @@ import time
 import zlib
 from typing import Optional
 
+from dlti_tpu.telemetry.ledger import (
+    CriticalPathTracker, note_readmitted, note_requeue,
+)
 from dlti_tpu.telemetry.registry import (
     Histogram, HOST_PREP_BUCKETS, LATENCY_BUCKETS, TPOT_BUCKETS,
 )
@@ -45,8 +48,14 @@ def _req_tid(request_id: str) -> int:
 class RequestTelemetry:
     """Histograms + lifecycle span emission for engine requests."""
 
-    def __init__(self, tracer: Optional[SpanTracer] = None):
+    def __init__(self, tracer: Optional[SpanTracer] = None,
+                 slow_k: int = 32):
         self.tracer = tracer if tracer is not None else get_tracer()
+        # Critical-path attribution (telemetry.ledger): every finished
+        # request's phase breakdown feeds dlti_request_phase_* and the
+        # GET /debug/slow worst-K retention. Shared across replicas like
+        # the histograms, so the fleet attributes into one place.
+        self.critical_path = CriticalPathTracker(slow_k=slow_k)
         self.ttft = Histogram(
             "dlti_request_ttft_seconds", LATENCY_BUCKETS,
             help="time from request arrival to first generated token",
@@ -82,6 +91,9 @@ class RequestTelemetry:
         queued once — recompute is decode-side churn) and only marks the
         trace."""
         now = time.monotonic()
+        # Close any open requeue mark (preemption / failover wait books
+        # to its own phase in the request's critical-path breakdown).
+        note_readmitted(req)
         if req.admitted_time is None:
             req.admitted_time = now
             self.queue_time.observe(now - req.arrival_time)
@@ -116,7 +128,11 @@ class RequestTelemetry:
             cat="request", tid=_req_tid(req.request_id), id=req.request_id,
             output_tokens=n_out, finish_reason=req.finish_reason,
             preemptions=req.num_preemptions)
+        # Phase attribution last: the breakdown reads the timestamps the
+        # spans above were emitted from (per request, never per token).
+        self.critical_path.observe(req)
 
     def on_preempted(self, req) -> None:
+        note_requeue(req, "preempt")
         self.tracer.instant("request/preempted", cat="request",
                             tid=_req_tid(req.request_id), id=req.request_id)

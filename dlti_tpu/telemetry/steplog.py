@@ -35,12 +35,18 @@ from dlti_tpu.utils.metrics import MetricsRecord
 # kind (nonfinite | loss_spike | grad_spike), `skipped_update` marks
 # optimizer updates the in-step nonfinite gate skipped, and
 # `rollbacks_total` is the run's cumulative automatic-rollback count —
-# the triple an incident reader greps first.
+# the triple an incident reader greps first. The goodput-ledger fields
+# (PR 9, telemetry.ledger): per-phase wall clock accrued around this
+# step — data/prefetch stall, device sync, checkpoint save+restore, and
+# rollback+replay — divided evenly across a steps_per_sync window's
+# records (checkpoint time issued after a record books to the next one).
+# All 0.0 when the ledger is disabled.
 STEP_RECORD_FIELDS = (
     "type", "step", "loss", "grad_norm", "lr",
     "tokens_per_second_per_chip", "mfu_percent",
     "peak_memory_gb", "peak_memory_source", "step_time_s",
     "anomaly", "skipped_update", "rollbacks_total",
+    "data_wait_s", "sync_s", "ckpt_s", "rollback_s",
 )
 
 RUN_RECORD_FIELDS = ("type", "experiment", "num_gpus", "zero_stage",

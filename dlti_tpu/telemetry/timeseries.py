@@ -170,6 +170,7 @@ _DASH_PREFERRED = (
     "generated_tokens", "requests", "active_seqs", "waiting", "free_blocks",
     "gateway_queue_depth", "gateway_queued_tokens", "gateway_inflight",
     "train_step", "train_loss", "train_tokens_per_s", "train_step_time_s",
+    "goodput_fraction", "train_mfu_percent",
 )
 
 _DASHBOARD_HTML = """<!doctype html>

@@ -39,6 +39,10 @@ alert, not one per check interval):
   previous check: nonfinite loss/grads (update skipped in-step), a
   loss/grad-norm spike vs the rolling window, or a cross-rank parameter
   digest mismatch (suspected silent data corruption).
+* ``goodput_collapse``      — the goodput ledger's productive fraction
+  (``goodput_fraction`` in the ring, ``telemetry.ledger``) fell below
+  ``goodput_floor_frac`` x its rolling median: the run still steps, but
+  recovery work (rollbacks, restores, stalls) is eating the wall clock.
 """
 
 from __future__ import annotations
@@ -69,7 +73,8 @@ alerts_total = Counter(
 
 RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
-         "nonfinite_step", "loss_spike", "sdc_mismatch")
+         "nonfinite_step", "loss_spike", "sdc_mismatch",
+         "goodput_collapse")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -270,6 +275,28 @@ class AnomalyWatchdog:
                 else:
                     self._active.discard("ckpt_retry_storm")
                 break
+
+        # goodput_collapse ---------------------------------------------
+        floor_frac = getattr(self.cfg, "goodput_floor_frac", 0.0)
+        if floor_frac > 0:
+            vals = [v for _, v in self.sampler.series("goodput_fraction")]
+            min_n = max(2, getattr(self.cfg, "goodput_min_samples", 8))
+            if len(vals) >= min_n:
+                med = statistics.median(vals[:-1])
+                latest = vals[-1]
+                if med > 0 and latest < floor_frac * med:
+                    a = self._fire("goodput_collapse", "goodput_collapse",
+                                   f"goodput fraction collapsed to "
+                                   f"{latest:.3f} (rolling median "
+                                   f"{med:.3f}, floor "
+                                   f"{floor_frac * med:.3f}) — recovery "
+                                   f"work is eating the wall clock",
+                                   latest=round(latest, 4),
+                                   median=round(med, 4))
+                    if a:
+                        fired.append(a)
+                else:
+                    self._active.discard("goodput_collapse")
 
         # sentinel rules: nonfinite_step / loss_spike / sdc_mismatch ---
         latest = (self.sampler.latest() or {}).get("values", {})

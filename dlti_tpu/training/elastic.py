@@ -84,6 +84,11 @@ world_size_gauge = Gauge(
 _EVENTS_FILE = "elastic_events.jsonl"
 _HB_MIN_INTERVAL_S = 0.05
 
+# Stitched goodput ledger across restarts (telemetry.ledger): the
+# supervisor merges per-generation worker ledgers and adds the buckets
+# only it can see (restart downtime, shrunk-world degradation).
+STITCHED_LEDGER_FILE = "ledger_stitched.json"
+
 
 # ----------------------------------------------------------------------
 # Worker-side helpers (called from the trainer / watchdog; every one is a
@@ -128,6 +133,39 @@ def beat(step: int) -> None:
         os.replace(tmp, path)
     except OSError:
         pass
+
+
+_last_ledger_save = [0.0]
+
+
+def save_generation_ledger(ledger_dict: dict, step: Optional[int] = None,
+                           force: bool = False) -> Optional[str]:
+    """Persist this rank's goodput-ledger totals for the supervisor's
+    cross-generation stitching (``ledger_g<G>_r<R>.json`` in the elastic
+    dir; atomic write+rename; throttled like :func:`beat` because the
+    trainer refreshes it per step — a worker that dies by SIGKILL never
+    reaches its exit-path save, and the stitched ledger must still book
+    that generation's rollback/replay time; never raises). No-op outside
+    an elastic launch."""
+    info = elastic_info()
+    if info is None:
+        return None
+    now = time.monotonic()
+    if not force and now - _last_ledger_save[0] < _HB_MIN_INTERVAL_S:
+        return None
+    _last_ledger_save[0] = now
+    path = os.path.join(
+        info["dir"], f"ledger_g{info['generation']}_r{info['rank']}.json")
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({**ledger_dict, "generation": info["generation"],
+                       "rank": info["rank"], "step": step,
+                       "wall": time.time()}, f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
 
 
 def mirror_alert(alert: dict) -> None:
@@ -669,6 +707,29 @@ class ElasticLauncher:
         self._kill_target(workers, w.rank, reason)
         self._teardown(workers)
 
+    # -- stitched goodput ledger ----------------------------------------
+    def _write_stitched(self, timeline: List[dict]) -> None:
+        """Merge per-generation worker ledgers with the supervisor's own
+        timeline into ``ledger_stitched.json`` — the one place restart
+        downtime and shrunk-world degradation are booked (workers cannot
+        see either). Rewritten after every generation so a crashed
+        supervisor still leaves the story so far. Never raises."""
+        try:
+            from dlti_tpu.telemetry.ledger import (
+                load_generation_ledgers, stitch_ledgers,
+            )
+
+            stitched = stitch_ledgers(
+                load_generation_ledgers(self.elastic_dir), timeline,
+                self.num_processes)
+            path = os.path.join(self.elastic_dir, STITCHED_LEDGER_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(stitched, f, indent=1)
+            os.replace(tmp, path)
+        except Exception:
+            self.logger.debug("stitched-ledger write failed", exc_info=True)
+
     # -- the supervisor loop --------------------------------------------
     def run(self) -> int:
         slots = list(range(self.num_processes))
@@ -676,9 +737,18 @@ class ElasticLauncher:
         budget = self.restart_budget
         backoff = self.backoff_s
         pending_rejoin: List[int] = []
+        timeline: List[dict] = []
         while True:
+            gen_start = self.clock()
             outcome = self._run_generation(
                 world, rejoin_armed=bool(pending_rejoin))
+            timeline.append({
+                "generation": self.generation,
+                "world_size": len(world),
+                "start": gen_start, "end": self.clock(),
+                "outcome": outcome.kind,
+            })
+            self._write_stitched(timeline)
             if outcome.kind == "done":
                 self._event("supervisor_exit", rc=0,
                             restarts=self.restarts)

@@ -31,9 +31,12 @@ from dlti_tpu.models import LlamaForCausalLM, count_params
 from dlti_tpu.parallel.mesh import build_mesh
 from dlti_tpu.parallel.sharding import make_sharded_train_step, shard_train_state
 from dlti_tpu.telemetry import (
-    AnomalyWatchdog, FlightRecorder, Heartbeat, StepLogWriter,
-    TimeSeriesSampler, configure_tracer, get_recorder, get_tracer,
-    install_recorder, schedule_lr,
+    AnomalyWatchdog, FlightRecorder, GoodputLedger, Heartbeat,
+    StepLogWriter, TimeSeriesSampler, configure_tracer, get_recorder,
+    get_tracer, install_recorder, schedule_lr,
+)
+from dlti_tpu.telemetry.ledger import (
+    goodput_fraction_gauge, goodput_mfu_gauge, goodput_seconds_total,
 )
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
@@ -198,6 +201,11 @@ class Trainer:
         # dict-merge no-op until train() installs a recorder; methods
         # outside the loop (_run_eval, _maybe_save) call it too.
         self._fnote = lambda **kw: None
+        # Goodput ledger (telemetry.ledger): train() replaces this with a
+        # live phase clock when cfg.telemetry.goodput_ledger is on; the
+        # disabled placeholder keeps every enter() site a one-attribute-
+        # read no-op (methods outside the loop transition through it too).
+        self._ledger = GoodputLedger(enabled=False)
 
     # ------------------------------------------------------------------
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
@@ -367,6 +375,11 @@ class Trainer:
         loops (resume restores weights but not batch order).
         """
         cfg = self.cfg
+        # Goodput ledger: the phase clock starts before state init so
+        # compile/init time books as "startup" — every second of train()
+        # lands in exactly one bucket (conservation is tier-1-tested).
+        ledger = self._ledger = GoodputLedger(
+            enabled=cfg.telemetry.goodput_ledger)
         state = state or self.init_state()
         resume = cfg.checkpoint.resume if resume is None else resume
 
@@ -406,8 +419,10 @@ class Trainer:
             # Verified resume: digest-checks newest-first, quarantining
             # incomplete/corrupt checkpoints (kill mid-save, bit rot) and
             # falling back to the newest good one instead of crashing.
+            ledger.enter("checkpoint_restore")
             restored = restore_latest_verified(cfg.checkpoint.output_dir,
                                                state)
+            ledger.enter("startup")
             if restored is not None:
                 state, step, resume_meta = restored
                 start_step = int(step)
@@ -528,6 +543,15 @@ class Trainer:
                     self._skiplist.quarantined())
             if self._sdc_probe is not None:
                 d.update(self._sdc_probe.scalars())
+            # Goodput ledger: per-bucket seconds + the derived fraction
+            # ride the ring (the watchdog's goodput_collapse rule, the
+            # /dashboard sparkline, and every flight dump read these).
+            if ledger.enabled:
+                d.update(ledger.scalars())
+            if heartbeat is not None and heartbeat.last_seen:
+                # Straggler lag on /debug/vars (the gauge twin lives in
+                # Heartbeat.register; this is the ring-series form).
+                d["heartbeat_lag"] = heartbeat.lag()
             return d
 
         if wcfg.enabled or fcfg.enabled:
@@ -576,8 +600,10 @@ class Trainer:
         fnote = self._fnote
 
         # Constants for the per-step MFU/throughput fields (same terms
-        # _final_metrics uses for the run-level record).
-        peak_flops = detect_chip_peak_flops() if steplog is not None else 0.0
+        # _final_metrics uses for the run-level record). The ledger needs
+        # them too: its MFU gauge is the /metrics twin of the steplog's.
+        peak_flops = (detect_chip_peak_flops()
+                      if (steplog is not None or ledger.enabled) else 0.0)
         n_for_flops = (cfg.model.num_active_params()
                        if cfg.model.num_experts > 0 else total)
 
@@ -787,9 +813,11 @@ class Trainer:
                 if warm:
                     timer.start()
                 fnote(phase="step_dispatch")
+                ledger.enter("step_compute")
                 with tracer.span("train/step_dispatch", cat="train"):
                     state, m = step_fn(state, gb, r)
                 fnote(phase="device_sync")
+                ledger.enter("device_sync")
                 with tracer.span("train/device_sync", cat="train"):
                     m = jax.device_get(m)  # blocks: true step time
                 if warm:
@@ -814,10 +842,12 @@ class Trainer:
             rngs = jnp.stack([it[2] for it in window])
             with timer.measure(steps=k):
                 fnote(phase="step_dispatch")
+                ledger.enter("step_compute")
                 with tracer.span("train/step_dispatch", cat="train",
                                  window=k):
                     state, mstack = multi_fn(state, stacked, rngs)
                 fnote(phase="device_sync")
+                ledger.enter("device_sync")
                 with tracer.span("train/device_sync", cat="train"):
                     mstack = jax.device_get(mstack)
             executed = [(window[i][0], window[i][2],
@@ -882,6 +912,21 @@ class Trainer:
             nonlocal global_step, samples_seen
             step_before = global_step
             window_anomalous = False
+            # Goodput bookkeeping: host-side accounting books to "other";
+            # the deltas accrued since the previous bookkeep feed the
+            # steplog's per-phase fields and the /metrics counter (a
+            # checkpoint issued below lands in the NEXT bookkeep's
+            # deltas). Replay ends once the run passes its pre-rollback
+            # high-water step — from here on, progress is fresh.
+            ledger.enter("other")
+            deltas = ledger.take_deltas()
+            n_exec = max(1, len(executed))
+            if (ledger.replay_until is not None
+                    and global_step + len(executed)
+                    >= ledger.replay_until):
+                ledger.end_replay()
+            for k, v in deltas.items():
+                goodput_seconds_total.labels(bucket=k).inc(v)
             for hb, r, m, pos in executed:
                 global_step += 1
                 samples_seen += (cfg.train.micro_batch_size
@@ -947,6 +992,20 @@ class Trainer:
                             m.get("skipped_update", 0.0)))),
                         rollbacks_total=(sentinel.rollbacks
                                          if sentinel is not None else 0),
+                        # Goodput-ledger per-phase fields (steplog
+                        # schema): the window's accrual split evenly
+                        # across its records; 0.0 when the ledger is off.
+                        data_wait_s=round(
+                            deltas.get("data_wait", 0.0) / n_exec, 6),
+                        sync_s=round(
+                            deltas.get("device_sync", 0.0) / n_exec, 6),
+                        ckpt_s=round(
+                            (deltas.get("checkpoint_save", 0.0)
+                             + deltas.get("checkpoint_restore", 0.0))
+                            / n_exec, 6),
+                        rollback_s=round(
+                            (deltas.get("rollback", 0.0)
+                             + deltas.get("replay", 0.0)) / n_exec, 6),
                     )
                 if global_step % cfg.train.logging_steps == 0 and is_main_process():
                     self.logger.info(
@@ -965,6 +1024,17 @@ class Trainer:
                 train_step_time_s=dt,
                 train_tokens_per_s=(tokens_per_step / dt if dt > 0 else 0.0),
                 samples_seen=samples_seen)
+            if ledger.enabled:
+                # Goodput fraction + MFU as /metrics gauges (module-level
+                # like the ckpt-store counters) and a /debug/vars series.
+                goodput_fraction_gauge.set(ledger.goodput_fraction())
+                if peak_flops and dt > 0:
+                    mfu_now = compute_mfu(
+                        tokens_per_step / dt / max(jax.device_count(), 1),
+                        n_for_flops, peak_flops,
+                        trainable_params=trainable)
+                    self._live["train_mfu_percent"] = round(mfu_now, 4)
+                    goodput_mfu_gauge.set(round(mfu_now, 4))
             if losses:
                 self._live["train_loss"] = losses[-1]
             if watchdog is not None:
@@ -974,6 +1044,13 @@ class Trainer:
                 # (independent per process — unlike the collective
                 # Heartbeat below, it keeps reporting when a peer dies).
                 _elastic.beat(global_step)
+                if ledger.enabled:
+                    # Refresh this generation's ledger file (throttled):
+                    # a SIGKILLed worker never reaches its exit-path
+                    # save, and the supervisor's stitched ledger must
+                    # still book the generation's rollback/replay time.
+                    _elastic.save_generation_ledger(ledger.to_dict(),
+                                                    step=global_step)
             fnote(step=global_step, last_completed_step=global_step,
                   phase="between_steps")
             if len(step_pos) > 4096:
@@ -986,9 +1063,11 @@ class Trainer:
             if sdc_probe is not None and sdc_probe.due(step_before,
                                                        global_step):
                 fnote(phase="sdc_probe")
+                ledger.enter("sdc_probe")
                 with tracer.span("train/sdc_probe", cat="train",
                                  step=global_step):
                     res = sdc_probe.check(state.params, global_step)
+                ledger.enter("other")
                 if res["mismatch"]:
                     suspect_self = res["rank"] in res["suspects"]
                     alert = {
@@ -1085,6 +1164,8 @@ class Trainer:
                 restore_latest_verified, wait_for_saves)
 
             ckdir = cfg.checkpoint.output_dir
+            pre_rollback_step = global_step
+            ledger.enter("rollback")
             wait_for_saves(ckdir)
             fnote(phase="sentinel_rollback")
             with tracer.span("train/sentinel_rollback", cat="train",
@@ -1120,6 +1201,10 @@ class Trainer:
                 f"; QUARANTINED {newly_q}" if newly_q else
                 " (replaying once)")
             global_step = int(step)
+            # Until the run passes its pre-rollback high-water step, the
+            # re-executed steps are replay — recovery cost, not fresh
+            # progress (the ledger reclasses their step buckets).
+            ledger.begin_replay(pre_rollback_step)
             cursor["committed"] = ck_cursor
             cursor["fetch"] = ck_cursor
             step_pos.clear()
@@ -1128,6 +1213,11 @@ class Trainer:
             # newer than the restore target can exist — it would have
             # been the restore target).
             self._last_save_step = None
+            if einfo is not None:
+                # The rollback booking must reach the supervisor's
+                # stitched ledger even if this worker is killed mid-replay.
+                _elastic.save_generation_ledger(ledger.to_dict(),
+                                                step=global_step, force=True)
             if dataset is not None and spe:
                 new_epoch = min(ck_cursor // spe, cfg.train.num_epochs)
                 resume_point["epoch"] = new_epoch
@@ -1152,6 +1242,7 @@ class Trainer:
                     # the gather itself runs in the worker's
                     # train/prefetch spans.
                     fnote(phase="batch_fetch")
+                    ledger.enter("data_wait")
                     with tracer.span("train/batch_fetch", cat="train"):
                         batch = next(batch_iter, _EPOCH_END)
                     if batch is _EPOCH_END:
@@ -1209,6 +1300,7 @@ class Trainer:
                     if self.mesh is not None:
                         from dlti_tpu.parallel.sharding import make_global_batch
 
+                        ledger.enter("host_to_device")
                         with tracer.span("train/host_to_device",
                                          cat="train"):
                             # Single-process: pass-through (worker-placed
@@ -1312,6 +1404,7 @@ class Trainer:
                     self.logger.info(
                         "preemption checkpoint written at step %d", global_step)
         finally:
+            ledger.enter("shutdown")
             close_prefetcher()  # a mid-epoch exception must not leak the worker
             if flight is not None:
                 # The black box goes down with the ship: a fatal
@@ -1355,6 +1448,17 @@ class Trainer:
                 except Exception:
                     self.logger.exception(
                         "settling in-flight checkpoint saves failed")
+            if ledger.enabled:
+                # Settle the goodput accounting on EVERY exit path: flush
+                # the residual deltas into the /metrics counter, set the
+                # final fraction, and (under an elastic supervisor) save
+                # this generation's ledger for cross-restart stitching.
+                for k, v in ledger.take_deltas().items():
+                    goodput_seconds_total.labels(bucket=k).inc(v)
+                goodput_fraction_gauge.set(ledger.goodput_fraction())
+                if einfo is not None:
+                    _elastic.save_generation_ledger(
+                        ledger.to_dict(), step=global_step, force=True)
 
         wall = time.time() - t_start
         record = self._final_metrics(
@@ -1373,6 +1477,13 @@ class Trainer:
             self.logger.info(
                 "telemetry trace -> %s (open in https://ui.perfetto.dev)",
                 trace_path)
+        if ledger.enabled and is_main_process():
+            totals = ledger.totals()
+            top = sorted(totals.items(), key=lambda kv: -kv[1])[:6]
+            self.logger.info(
+                "goodput: %.1f%% productive over %.1fs booked — %s",
+                100 * ledger.goodput_fraction(totals), sum(totals.values()),
+                ", ".join(f"{k} {v:.1f}s" for k, v in top))
         if is_main_process():
             print_metrics_summary(record)
             save_training_metrics(record, csv_path=cfg.train.metrics_csv)
@@ -1394,6 +1505,7 @@ class Trainer:
                 params=jax.device_put(state.params, dev_sh))
         losses, toks = [], 0.0
         self._fnote(phase="eval")
+        self._ledger.enter("eval")
         with self._tracer.span("train/eval", cat="train", step=step):
             for batch in eval_dataset.epoch(0):
                 flat = {
@@ -1402,6 +1514,7 @@ class Trainer:
                 m = jax.device_get(eval_fn(state, flat))
                 losses.append(float(m["loss"]) * float(m["num_tokens"]))
                 toks += float(m["num_tokens"])
+        self._ledger.enter("other")
         eval_loss = sum(losses) / toks if toks else float("nan")
         if toks and is_main_process():
             self.logger.info("eval @ step %d | loss %.4f", step, eval_loss)
@@ -1439,6 +1552,7 @@ class Trainer:
         from dlti_tpu.checkpoint import save_train_state
 
         self._fnote(phase="checkpoint_save")
+        self._ledger.enter("checkpoint_save")
         with self._tracer.span("train/checkpoint_save", cat="train",
                                step=step):
             save_train_state(
@@ -1447,6 +1561,7 @@ class Trainer:
                 train_meta=meta, retries=cfg.save_retries,
                 retry_backoff_s=cfg.save_retry_backoff_s,
             )
+        self._ledger.enter("other")
         if self._fault is not None:
             # Mid-save chaos: with async_save the write is in flight right
             # now — a save-kill here is the honest torn-checkpoint case.

@@ -25,6 +25,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 # Source checkout wins over any installed copy; an installed dlti-tpu
 # serves scripts run from outside a checkout.
@@ -95,6 +96,23 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
             e.get("name", "?"), 0) + 1
 
     exc = ctx_file.get("exception")
+    # Goodput ledger (telemetry.ledger): the metrics snapshot carries the
+    # run's bucket totals at death — "where the time went" belongs in an
+    # incident summary, since recovery work is usually WHY a run that
+    # "still steps" is failing its throughput target.
+    goodput = None
+    buckets = {k[len("goodput_"):-len("_seconds")]: v
+               for k, v in metrics.items()
+               if k.startswith("goodput_") and k.endswith("_seconds")
+               and k != "goodput_wall_seconds"}
+    if buckets:
+        goodput = {
+            "fraction": metrics.get("goodput_fraction"),
+            "wall_s": metrics.get("goodput_wall_seconds",
+                                  round(sum(buckets.values()), 3)),
+            "buckets": dict(sorted(buckets.items(),
+                                   key=lambda kv: -kv[1])),
+        }
     # Numeric-fault evidence: sentinel dumps carry their verdict in
     # context.json's top level (rollback streak / SDC alert), and any
     # dump may carry the last anomaly the trainer noted.
@@ -121,6 +139,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "phase_at_death": phase,
         "exception_tail": (exc.strip().splitlines()[-3:] if exc else None),
         "sentinel": sentinel or None,
+        "goodput": goodput,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
         "tracer_enabled": spans.get("tracerEnabled"),
@@ -143,7 +162,35 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
     }
 
 
-def summarize_incident(dump_dirs: list, span_tail: int = 15) -> dict:
+def find_stitched_ledger(path: str) -> Optional[str]:
+    """Locate the elastic supervisor's stitched goodput ledger near a
+    dump path: the path itself, its parent, or an ``elastic/`` sibling
+    (the common --flight-dir / --elastic-dir layout)."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        return path
+    parent = os.path.dirname(path)
+    for cand in (os.path.join(path, "ledger_stitched.json"),
+                 os.path.join(parent, "ledger_stitched.json"),
+                 os.path.join(parent, "elastic", "ledger_stitched.json"),
+                 os.path.join(path, "elastic", "ledger_stitched.json")):
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def load_stitched_ledger(path: Optional[str]) -> Optional[dict]:
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def summarize_incident(dump_dirs: list, span_tail: int = 15,
+                       stitched: Optional[dict] = None) -> dict:
     """One incident summary over a *directory of per-rank dumps* (an
     elastic / multi-process job writes one black box per dying rank,
     tagged with ``process_id`` + ``generation``): per-dump digest lines
@@ -171,6 +218,7 @@ def summarize_incident(dump_dirs: list, span_tail: int = 15) -> dict:
         "generations": {str(g): v for g, v in sorted(
             by_gen.items(), key=lambda kv: (kv[0] is None, kv[0]))},
         "root_cause": root,
+        "stitched_ledger": stitched,
         "integrity_problems": sorted(
             {p for s in dumps for p in s["integrity_problems"]}),
     }
@@ -190,6 +238,22 @@ def render_incident(incident: dict) -> str:
               f"{(r['reason'] or '?'):24s} last step "
               f"{r['last_completed_step']!s:>6}  "
               f"phase {(r['phase_at_death'] or '?')}{dmg}")
+    st = incident.get("stitched_ledger")
+    if st:
+        w("")
+        w("where the time went (stitched across generations):")
+        buckets = st.get("buckets") or {}
+        wall = st.get("wall_s") or sum(buckets.values()) or 1.0
+        frac = st.get("goodput_fraction")
+        if frac is not None:
+            w(f"    goodput {100 * frac:.1f}% over {wall:.1f}s booked "
+              f"({st.get('num_generations', '?')} generation(s), "
+              f"restart downtime {st.get('restart_downtime_s', 0):.1f}s, "
+              f"shrunk-world {st.get('shrunk_world_s', 0):.1f}s ="
+              f" {st.get('shrunk_world_capacity_loss_s', 0):.1f}s of "
+              f"capacity)")
+        for k, v in sorted(buckets.items(), key=lambda kv: -kv[1])[:10]:
+            w(f"    {k:20s} {v:10.2f}s  {100 * v / wall:5.1f}%")
     root = incident["root_cause"]
     if root is not None:
         w("")
@@ -239,6 +303,14 @@ def render(summary: dict) -> str:
             w(f"    sdc: {s['alert'].get('message')}"
               + ("  << THIS RANK IS THE SUSPECT"
                  if s.get("suspect_self") else ""))
+    if summary.get("goodput"):
+        g = summary["goodput"]
+        wall = g.get("wall_s") or sum(g["buckets"].values()) or 1.0
+        frac = g.get("fraction")
+        w("where the time went:" + (
+            f"   (goodput {100 * frac:.1f}%)" if frac is not None else ""))
+        for k, v in list(g["buckets"].items())[:8]:
+            w(f"    {k:20s} {v:10.2f}s  {100 * v / wall:5.1f}%")
     if summary["watchdog_alerts"]:
         w(f"watchdog:      {len(summary['watchdog_alerts'])} alert(s) "
           f"before death:")
@@ -285,12 +357,20 @@ def main() -> None:
                    help="treat PATH as a directory of per-rank dumps "
                         "(elastic/multi-process job) and render ONE "
                         "incident summary across all of them")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="stitched goodput ledger (the elastic "
+                        "supervisor's ledger_stitched.json) for the "
+                        "'where the time went' section; auto-discovered "
+                        "near PATH when omitted")
     args = p.parse_args()
     if args.all:
         dumps = list_dumps(args.path)
         if not dumps:
             raise SystemExit(f"no flight-*/ dump under {args.path}")
-        incident = summarize_incident(dumps, span_tail=args.spans)
+        stitched = load_stitched_ledger(
+            args.ledger or find_stitched_ledger(args.path))
+        incident = summarize_incident(dumps, span_tail=args.spans,
+                                      stitched=stitched)
         if args.json:
             print(json.dumps(incident, indent=2, default=str))
         else:

@@ -200,6 +200,10 @@ def parse_args():
                         "dump a flight-*/ black box (span tail, metrics, "
                         "time-series tail) here; render with "
                         "scripts/postmortem.py")
+    p.add_argument("--slow-log-k", type=int, default=32,
+                   help="worst-latency requests retained with full "
+                        "critical-path timelines (queue, prefill, tier "
+                        "restore, failover, decode) for GET /debug/slow")
     return p.parse_args()
 
 
@@ -328,6 +332,9 @@ def main() -> None:
     sc = ServerConfig(host=args.host, port=args.port,
                       default_params=SamplingParams(max_tokens=args.max_tokens_default),
                       gateway=gw_cfg, telemetry=tel_cfg)
+    # Critical-path slow log sizing (telemetry.ledger): the engines share
+    # one RequestTelemetry, so one SlowLog serves the whole fleet.
+    engine.telemetry.critical_path.slow.k = max(1, args.slow_log_k)
     print("pre-compiling decode programs (single-step + multi-step ladder)...")
     t0 = time.time()
     engine.warmup_decode_ladder()

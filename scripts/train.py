@@ -226,6 +226,12 @@ def parse_args():
                         "preemption stops, chaos faults (even N:kill), "
                         "and watchdog escalations dump a flight-*/ black "
                         "box here; render with scripts/postmortem.py")
+    p.add_argument("--no-goodput-ledger", action="store_true",
+                   help="disable the goodput ledger (telemetry.ledger): "
+                        "no per-bucket wall-clock accounting, goodput "
+                        "fraction, per-phase steplog fields, or stitched "
+                        "elastic ledger — every site drops to one "
+                        "attribute read")
     return p.parse_args()
 
 
@@ -391,6 +397,7 @@ def build_config(args):
             trace_capacity=args.trace_capacity,
             step_log_path=args.step_log,
             heartbeat_interval_steps=args.heartbeat_interval,
+            goodput_ledger=not args.no_goodput_ledger,
             watchdog=WatchdogConfig(
                 enabled=args.watchdog,
                 action=args.watchdog_action,

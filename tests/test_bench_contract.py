@@ -197,6 +197,7 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "hung_step", "throughput_collapse", "queue_buildup",
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
+        "goodput_collapse",
     )
 
 
@@ -237,6 +238,64 @@ def test_steplog_sentinel_fields_are_schema_stable():
 
     assert {"anomaly", "skipped_update", "rollbacks_total"} <= set(
         STEP_RECORD_FIELDS)
+
+
+def test_steplog_goodput_fields_are_schema_stable():
+    """The goodput-ledger per-phase durations (data/prefetch stall,
+    device sync, checkpoint, rollback+replay) are part of the step-record
+    contract: trajectory tooling attributes slow steps by these keys."""
+    from dlti_tpu.telemetry.steplog import STEP_RECORD_FIELDS
+
+    assert {"data_wait_s", "sync_s", "ckpt_s", "rollback_s"} <= set(
+        STEP_RECORD_FIELDS)
+
+
+def test_ledger_metric_names_are_schema_stable():
+    """Goodput-ledger + critical-path attribution names are a scrape
+    contract like the watchdog/ckpt sets; the bucket and phase label
+    sets are parsing contracts (postmortem, steplog, /debug/slow)."""
+    from dlti_tpu.telemetry import ledger
+
+    assert ledger.LEDGER_METRIC_NAMES == (
+        "dlti_goodput_fraction",
+        "dlti_goodput_seconds_total",
+        "dlti_goodput_mfu_percent",
+    )
+    assert ledger.REQUEST_PHASE_METRIC_NAMES == (
+        "dlti_request_phase_seconds_total",
+        "dlti_request_phase_requests_total",
+    )
+    assert ledger.goodput_fraction_gauge.name == \
+        ledger.LEDGER_METRIC_NAMES[0]
+    assert ledger.goodput_seconds_total.name == \
+        ledger.LEDGER_METRIC_NAMES[1]
+    assert ledger.goodput_mfu_gauge.name == ledger.LEDGER_METRIC_NAMES[2]
+    assert ledger.phase_seconds_total.name == \
+        ledger.REQUEST_PHASE_METRIC_NAMES[0]
+    assert ledger.phase_requests_total.name == \
+        ledger.REQUEST_PHASE_METRIC_NAMES[1]
+    assert ledger.GOODPUT_BUCKETS == (
+        "startup", "step_compute", "device_sync", "data_wait",
+        "host_to_device", "eval", "checkpoint_save", "checkpoint_restore",
+        "rollback", "replay", "sdc_probe", "shutdown", "other",
+    )
+    assert ledger.SUPERVISOR_BUCKETS == ("restart_downtime",)
+    assert ledger.PRODUCTIVE_BUCKETS == ("step_compute", "device_sync")
+    assert ledger.REQUEST_PHASES == (
+        "gateway_queue", "queue", "tier_restore", "prefill",
+        "failover", "preempt", "decode", "other",
+    )
+
+
+def test_heartbeat_metric_names_are_schema_stable():
+    """The per-rank last-step and straggler-lag gauges are a scrape
+    contract (dashboards plot which rank trails by how much)."""
+    from dlti_tpu.telemetry.heartbeat import HEARTBEAT_METRIC_NAMES
+
+    assert HEARTBEAT_METRIC_NAMES == (
+        "dlti_heartbeat_last_step",
+        "dlti_heartbeat_lag_steps",
+    )
 
 
 def test_elastic_metric_names_are_schema_stable():
@@ -297,6 +356,9 @@ def test_load_report_schema_includes_gateway_fields():
         # split + the server-scraped cache hit rate.
         "num_cold", "num_warm", "cold_ttft_p50_s", "cold_ttft_p90_s",
         "warm_ttft_p50_s", "warm_ttft_p90_s", "cache_hit_rate",
+        # Goodput-ledger era: server-reported critical-path phase means,
+        # overall and decomposed cold-vs-warm (TTFT by phase).
+        "phase_means", "cold_phases", "warm_phases",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -37,7 +37,11 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
-    from dlti_tpu.telemetry import FLIGHT_METRIC_NAMES, WATCHDOG_METRIC_NAMES
+    from dlti_tpu.telemetry import (
+        FLIGHT_METRIC_NAMES, LEDGER_METRIC_NAMES,
+        REQUEST_PHASE_METRIC_NAMES, WATCHDOG_METRIC_NAMES,
+    )
+    from dlti_tpu.telemetry.heartbeat import HEARTBEAT_METRIC_NAMES
     from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
     from dlti_tpu.training.sentinel import (
         SDC_METRIC_NAMES, SENTINEL_METRIC_NAMES,
@@ -51,13 +55,16 @@ def test_pinned_name_tuples_follow_convention():
                        (FLIGHT_METRIC_NAMES, "flightrecorder"),
                        (ELASTIC_METRIC_NAMES, "elastic"),
                        (SENTINEL_METRIC_NAMES, "sentinel"),
-                       (SDC_METRIC_NAMES, "sdc")):
+                       (SDC_METRIC_NAMES, "sdc"),
+                       (LEDGER_METRIC_NAMES, "ledger"),
+                       (REQUEST_PHASE_METRIC_NAMES, "request_phase"),
+                       (HEARTBEAT_METRIC_NAMES, "heartbeat")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
-    from dlti_tpu.telemetry import flightrecorder, watchdog
+    from dlti_tpu.telemetry import flightrecorder, ledger, watchdog
     from dlti_tpu.training import elastic, sentinel
 
     objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
@@ -67,7 +74,10 @@ def test_module_level_metric_objects_follow_convention():
             elastic.world_size_gauge,
             sentinel.anomalies_total, sentinel.skipped_updates_total,
             sentinel.rollbacks_total, sentinel.quarantined_windows_total,
-            sentinel.sdc_probes_total, sentinel.sdc_mismatches_total)
+            sentinel.sdc_probes_total, sentinel.sdc_mismatches_total,
+            ledger.goodput_fraction_gauge, ledger.goodput_seconds_total,
+            ledger.goodput_mfu_gauge, ledger.phase_seconds_total,
+            ledger.phase_requests_total)
     _assert_convention([m.name for m in objs], "module-level metrics")
 
 
@@ -130,7 +140,11 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_prefix_cache_blocks",
                      "dlti_prefix_cache_hit_rate",
                      "dlti_sentinel_rollbacks_total",
-                     "dlti_sdc_mismatches_total"):
+                     "dlti_sdc_mismatches_total",
+                     "dlti_goodput_fraction",
+                     "dlti_goodput_seconds_total",
+                     "dlti_request_phase_seconds_total",
+                     "dlti_heartbeat_lag_steps"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")
 

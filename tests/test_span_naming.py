@@ -1,0 +1,166 @@
+"""Static guard: every ``tracer.span/complete/instant`` call-site name in
+the package is pinned here.
+
+The goodput ledger and the critical-path attribution parse span names
+("train/*" phases, "engine/*" step phases, "request/*" lifecycle,
+"gateway/*" admission); flight-record readers and ``scripts/postmortem.py``
+group by them too. Like ``test_metric_naming.py`` for the ``/metrics``
+exposition, this walk makes instrumentation names a *contract*: adding a
+span site means adding its name to the catalog (deliberate), and a rename
+fails here before it silently breaks attribution parsing or saved-trace
+tooling.
+
+The walk is an AST scan, not an import: a span behind a rarely-taken
+branch is still caught, and the guard costs no jax startup.
+"""
+
+import ast
+import os
+
+import pytest
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "dlti_tpu")
+
+# The catalog. Names group as "<plane>/<phase>"; every one is emitted via
+# the process-global SpanTracer (telemetry.tracer).
+SPAN_NAME_CATALOG = frozenset({
+    # Trainer per-step phases (also the goodput ledger's bucket sites).
+    "train/batch_fetch",
+    "train/host_to_device",
+    "train/step_dispatch",
+    "train/device_sync",
+    "train/eval",
+    "train/checkpoint_save",
+    "train/sdc_probe",
+    "train/sentinel_rollback",
+    "train/prefetch",
+    # Engine step phases + the prefix-tier restore charge.
+    "engine/admit",
+    "engine/decode_dispatch",
+    "engine/decode_sync",
+    "engine/prefill_chunks",
+    "engine/tier_restore",
+    # Request lifecycle (telemetry.lifecycle).
+    "request/submitted",
+    "request/queued",
+    "request/readmitted",
+    "request/prefill",
+    "request/decode",
+    "request/preempted",
+    # Admission gateway.
+    "gateway/enqueued",
+    "gateway/queued",
+    "gateway/rejected",
+    "gateway/shed",
+    # Watchdog alert instants.
+    "watchdog/alert",
+})
+
+_TRACER_METHODS = ("span", "complete", "instant")
+
+# Call sites whose first argument is not a string literal, allowed ONLY
+# because their name is a literal *default* elsewhere (asserted below):
+# (relative path, receiver attribute) -> the default-carrying symbol.
+_DYNAMIC_ALLOWED = {
+    # HostPrefetcher worker span: self._tracer.span(self._span_name, ...)
+    # with span_name="train/prefetch" in the constructor signature.
+    os.path.join("data", "prefetch.py"),
+}
+
+
+def _walk_calls():
+    """Yield (relpath, lineno, first_arg_node) for every
+    ``<obj>.span|complete|instant(...)`` call in the package."""
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, PKG)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in _TRACER_METHODS):
+                    continue
+                if not node.args:
+                    continue
+                yield rel, node.lineno, node.args[0]
+
+
+def _collected():
+    literals = {}
+    dynamic = []
+    for rel, lineno, arg in _walk_calls():
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            # Only slash-namespaced strings are span names; this keeps
+            # unrelated `.complete(x)`-shaped methods (none today) from
+            # polluting the walk if one ever appears.
+            if "/" in arg.value:
+                literals.setdefault(arg.value, []).append((rel, lineno))
+        else:
+            dynamic.append((rel, lineno))
+    return literals, dynamic
+
+
+def test_every_span_call_site_name_is_pinned():
+    literals, dynamic = _collected()
+    unknown = set(literals) - SPAN_NAME_CATALOG
+    assert not unknown, (
+        f"span names not in the pinned catalog: "
+        f"{ {n: literals[n] for n in unknown} } — ledger/attribution and "
+        f"postmortem tooling parse span names; add new ones to "
+        f"SPAN_NAME_CATALOG deliberately")
+    missing = SPAN_NAME_CATALOG - set(literals) - {"train/prefetch"}
+    assert not missing, (
+        f"catalog names with no remaining call site: {missing} — a "
+        f"renamed/removed span breaks attribution parsing; update the "
+        f"catalog with the rename")
+    for rel, lineno in dynamic:
+        assert rel in _DYNAMIC_ALLOWED, (
+            f"non-literal span name at dlti_tpu/{rel}:{lineno} — span "
+            f"names are a static contract; use a literal (or add an "
+            f"allowlist entry with its literal default pinned)")
+
+
+def test_dynamic_prefetch_span_default_is_pinned():
+    """The one allowed dynamic site (HostPrefetcher) must keep its
+    literal default in the constructor signature."""
+    import inspect
+
+    from dlti_tpu.data.prefetch import HostPrefetcher
+
+    sig = inspect.signature(HostPrefetcher.__init__)
+    assert sig.parameters["span_name"].default == "train/prefetch"
+    assert "train/prefetch" in SPAN_NAME_CATALOG
+
+
+def test_span_names_follow_plane_slash_phase_convention():
+    for name in SPAN_NAME_CATALOG:
+        plane, _, phase = name.partition("/")
+        assert plane and phase, name
+        assert plane in ("train", "engine", "request", "gateway",
+                         "watchdog"), name
+        assert phase == phase.lower().replace("-", "_"), name
+
+
+def test_walk_actually_sees_known_sites():
+    """Anti-vacuity: the AST walk finds the long-standing sites (an empty
+    walk would pass the guards above trivially)."""
+    literals, _ = _collected()
+    for expected in ("train/step_dispatch", "engine/admit",
+                     "request/queued", "gateway/enqueued",
+                     "watchdog/alert", "engine/tier_restore"):
+        assert expected in literals, f"walk missed {expected}"
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -310,6 +310,34 @@ def test_heartbeat_single_process_and_gauges():
     assert 'dlti_heartbeat_last_step{process="0"} 10' in text
 
 
+def test_heartbeat_straggler_report_and_lag_gauge():
+    """straggler_report() had no unit test (log-only until the lag
+    gauge); pin its text + the per-rank lags()/gauge surface."""
+    reg = MetricsRegistry()
+    hb = Heartbeat(registry=reg)
+    # Lockstep fleet: no report, zero lags.
+    now = time.time()
+    hb.last_seen = {0: (12, now), 1: (12, now)}
+    assert hb.straggler_report() is None
+    assert hb.lags() == {0: 0, 1: 0}
+    # Two stragglers at different depths: the report names each with its
+    # deficit, sorted by rank; lags() is the gauge form of the same view.
+    hb.last_seen = {0: (12, now), 1: (9, now), 2: (5, now)}
+    report = hb.straggler_report()
+    assert "behind step 12" in report
+    assert "proc 1: -3" in report and "proc 2: -7" in report
+    assert hb.lags() == {0: 0, 1: 3, 2: 7}
+    # beat() refreshes both gauges; per-rank lag is exposed for scrape.
+    hb.beat(12)
+    text = reg.render_prometheus()
+    assert 'dlti_heartbeat_lag_steps{process="0"} 0' in text
+    assert 'dlti_heartbeat_lag_steps{process="2"} 7' in text
+    # Empty map degrades cleanly.
+    hb.last_seen = {}
+    assert hb.lags() == {} and hb.lag() == 0
+    assert hb.straggler_report() is None
+
+
 # ----------------------------------------------------------------------
 # Per-step JSONL stream: schema superset of the reference CSV
 # ----------------------------------------------------------------------
