@@ -386,20 +386,39 @@ def _try_run(model_name: str, micro_bs: int, quant: str = "",
     # dispatch+sync of each compiled call as productive step compute and
     # everything between as host overhead, so the BENCH JSON records
     # attribution (goodput_fraction + bucket totals), not just tok/s.
-    from dlti_tpu.telemetry import GoodputLedger
+    from dlti_tpu.telemetry import GoodputLedger, MemoryLedger
+
+    # Memory ledger over the measured loop (telemetry.memledger): the
+    # BENCH JSON records where HBM went (params vs optimizer vs
+    # untracked) alongside where the wall clock went — an OOM'd candidate
+    # and a fit-with-headroom one must be distinguishable from the line.
+    memledger = MemoryLedger()
+    state_box = {"state": state}
+    memledger.register("params", lambda: state_box["state"].params)
+    memledger.register("optimizer_state",
+                       lambda: state_box["state"].opt_state)
 
     ledger = GoodputLedger()
     t0 = time.perf_counter()
     for i in range(STEPS):
         ledger.enter("step_compute")
         state, loss_val = run(state, i)
+        state_box["state"] = state
         ledger.enter("other")
         if _WATCHDOG is not None:
             _WATCHDOG.notify_step(i)
     dt = (time.perf_counter() - t0) / (STEPS * sync)
     tok_s = micro_bs * SEQ / dt
     goodput = ledger.to_dict()
-    return tok_s, dt, trainable, total, loss_val, goodput
+    snap = memledger.snapshot()
+    memory = {
+        "source": snap["source"],
+        "bytes_in_use": snap["bytes_in_use"],
+        "peak_bytes": snap["peak_bytes"],
+        "untracked_bytes": snap["untracked_bytes"],
+        "owners": {o: d["bytes"] for o, d in snap["owners"].items()},
+    }
+    return tok_s, dt, trainable, total, loss_val, goodput, memory
 
 
 def main() -> None:
@@ -466,13 +485,13 @@ def main() -> None:
             break
         _BEST["last_candidate"] = c
         try:
-            tok_s, dt, trainable, total, loss, goodput = _try_run(
+            tok_s, dt, trainable, total, loss, goodput, memory = _try_run(
                 c["model"], c["bs"], quant=c.get("quant", ""),
                 remat_policy=c.get("remat_policy", ""),
                 remat_stride=c.get("remat_stride", 0),
                 loss_chunk=c.get("loss_chunk", 0),
                 sync=c.get("sync", 1))
-            result = (c, tok_s, dt, trainable, total, loss, goodput)
+            result = (c, tok_s, dt, trainable, total, loss, goodput, memory)
             # Minimal best-so-far for the watchdog: if anything after the
             # loop stalls (e.g. a device query in MFU derivation), the
             # deadline still emits a real measurement, not an error.
@@ -495,7 +514,7 @@ def main() -> None:
                           f"first: {failures[0] if failures else None})"))
         sys.exit(5)
 
-    c, tok_s, dt, trainable, total, loss, goodput = result
+    c, tok_s, dt, trainable, total, loss, goodput, memory = result
     model_name, bs = c["model"], c["bs"]
     peak = detect_chip_peak_flops()
     mfu = compute_mfu(tok_s, total, peak, trainable_params=trainable)
@@ -530,6 +549,9 @@ def main() -> None:
         "goodput_fraction": goodput.get("goodput_fraction", 0.0),
         "goodput_buckets": {k: round(v, 4) for k, v in
                             (goodput.get("buckets") or {}).items()},
+        # HBM attribution at end of the measured loop
+        # (telemetry.memledger): params vs optimizer vs untracked bytes.
+        "memory": memory,
         # Watchdog verdict: nonzero means the measured loop misbehaved
         # (hung step etc.) — regression tooling should distrust `value`.
         "watchdog_alerts": (sum(_WATCHDOG.alert_counts().values())

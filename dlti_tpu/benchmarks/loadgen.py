@@ -171,6 +171,12 @@ class LoadReport:
     phase_means: dict = field(default_factory=dict)
     cold_phases: dict = field(default_factory=dict)
     warm_phases: dict = field(default_factory=dict)
+    # End-of-run HBM attribution scraped from the server's /debug/memory
+    # (telemetry.memledger): source, bytes_in_use, peak/untracked bytes
+    # and the per-owner map — "did this load level fit, and with how much
+    # headroom" alongside the latency numbers. Empty when the scrape is
+    # off, the route is absent, or the server's ledger is disabled.
+    memory: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -607,6 +613,21 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     watchdog_alerts, peak_queue = _watchdog_report(
         await _http_get_json(cfg.host, cfg.port, "/debug/vars")
         if cfg.scrape_debug_vars else None)
+    # End-of-run memory map (telemetry.memledger via /debug/memory) —
+    # best-effort like every other scrape; {} when absent/disabled.
+    mem_snap = (await _http_get_json(cfg.host, cfg.port, "/debug/memory")
+                if cfg.scrape_debug_vars else None)
+    memory = {}
+    if mem_snap:
+        memory = {
+            "source": mem_snap.get("source", ""),
+            "bytes_in_use": mem_snap.get("bytes_in_use", 0),
+            "peak_bytes": mem_snap.get("peak_bytes", 0),
+            "untracked_bytes": mem_snap.get("untracked_bytes", 0),
+            "headroom_bytes": mem_snap.get("headroom_bytes"),
+            "owners": {o: d.get("bytes", 0) for o, d in
+                       (mem_snap.get("owners") or {}).items()},
+        }
 
     ok = [r for r in records if r.ok]
     shed = [r for r in records if r.shed]
@@ -661,6 +682,7 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         phase_means=_phase_means(ok),
         cold_phases=_phase_means(cold),
         warm_phases=_phase_means(warm),
+        memory=memory,
     )
 
 

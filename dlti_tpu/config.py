@@ -380,7 +380,11 @@ class TrainConfig:
     # (re-fires after rollback — the bad-data simulation), and
     # "STEP:param-flip[:RANK]" flips one mantissa bit in a replicated
     # param leaf on rank RANK (the silent-data-corruption simulation the
-    # SDC probe must catch).
+    # SDC probe must catch). Memory chaos: "STEP:hbm-squeeze" inflates a
+    # balloon of device arrays (DLTI_CHAOS_BALLOON_BYTES, default 64 MiB)
+    # and raises a RESOURCE_EXHAUSTED-shaped fault, driving the OOM
+    # forensics path (flight dump with memory.json) deterministically on
+    # CPU.
     fault_inject_step: str = ""
     # Numeric-fault sentinel (dlti_tpu.training.sentinel): see the
     # block's own docstring.
@@ -431,6 +435,11 @@ class WatchdogConfig:
     # goodput_min_samples samples (0 floor = rule off).
     goodput_floor_frac: float = 0.5
     goodput_min_samples: int = 8
+    # hbm_pressure: the memory ledger's headroom fraction (the
+    # `hbm_headroom_frac` ring series, telemetry.memledger — only
+    # published when HBM capacity is known) dropped below this absolute
+    # floor (0 = rule off).
+    hbm_headroom_floor_frac: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -479,6 +488,17 @@ class TelemetryConfig:
     # transition is ~a clock read; False reduces every site to one
     # attribute read (the tracer's disabled-path contract).
     goodput_ledger: bool = True
+    # Memory ledger (telemetry.memledger): attribute device bytes to
+    # named owners (params, optimizer state, KV pool, ...), reconcile
+    # against jax.live_arrays()/memory_stats(), and feed the
+    # hbm_* steplog fields, /debug/memory, and memory.json OOM
+    # forensics. On by default; False reduces every site to one
+    # attribute read.
+    memory_ledger: bool = True
+    # HBM capacity budget in bytes for headroom accounting (0 =
+    # auto-detect from device memory_stats(); stays unknown on CPU,
+    # where headroom-dependent features simply stay off).
+    hbm_budget_bytes: int = 0
     # Self-monitoring: anomaly watchdog rules + flight-recorder black box
     # (see the blocks' own docstrings). Both off by default.
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)

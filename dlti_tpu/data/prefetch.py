@@ -161,6 +161,21 @@ class HostPrefetcher:
             self.stats["stalls"] += 1
         return payload
 
+    def buffered_batches(self) -> list:
+        """The *placed* batches currently buffered ahead of the step
+        thread — the memory ledger's ``prefetch_buffers`` owner handle
+        (device bytes only exist where place_fn issued a device_put; a
+        host-only buffer contributes nothing and that is correct).
+        Racy-by-design read of the queue's internal deque: the ledger
+        snapshot tolerates a batch popping mid-walk (deleted arrays are
+        skipped), and no lock is worth taking on the step thread's hot
+        producer/consumer path."""
+        try:
+            return [payload[1] for tag, payload in list(self._q.queue)
+                    if tag == _OK]
+        except Exception:
+            return []
+
     def close(self) -> None:
         """Stop the worker and drop buffered batches. Idempotent; safe to
         call with the worker blocked on a full queue (preemption path)."""

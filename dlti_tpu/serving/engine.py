@@ -40,6 +40,10 @@ from dlti_tpu.ops.kv_cache import init_paged_cache
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.sampling import SamplingParams, sample_tokens
 from dlti_tpu.telemetry import RequestTelemetry
+from dlti_tpu.telemetry.flightrecorder import get_recorder
+from dlti_tpu.telemetry.memledger import (
+    MemoryLedger, is_oom_error, tree_nbytes,
+)
 from dlti_tpu.utils.logging import get_logger
 
 
@@ -149,6 +153,19 @@ class EngineConfig:
     # produces) and raises NumericFault. 0 = off (legitimate decodes CAN
     # agree; enable with a window sized for your traffic).
     guard_token_storm: int = 0
+    # Memory ledger (telemetry.memledger): per-owner HBM attribution
+    # (params / kv_block_pool / prefix_cache_hbm / decode_state_cache),
+    # feeding /debug/memory, the hbm_* metric gauges, and memory.json in
+    # engine flight dumps.
+    memory_ledger: bool = True
+    # HBM capacity budget in bytes for headroom accounting (0 =
+    # auto-detect from device memory_stats(); unknown on CPU unless set).
+    hbm_budget_bytes: int = 0
+    # Headroom-aware admission: defer admitting new requests while ledger
+    # headroom is below this fraction of capacity (0 = gating off, and it
+    # is also off whenever capacity is unknown). Deferred requests stay
+    # queued — the degraded mode is latency, never a client error.
+    admit_min_headroom_frac: float = 0.0
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -496,7 +513,15 @@ class InferenceEngine:
                       # Numeric-guard trips (nonfinite decode outputs /
                       # token storms). Present (at 0) so the /metrics
                       # schema is stable.
-                      "numeric_faults": 0}
+                      "numeric_faults": 0,
+                      # Headroom-aware memory control (telemetry.
+                      # memledger): admission passes skipped for want of
+                      # HBM headroom, and decode windows shrunk to one
+                      # step when KV growth found the pool exhausted —
+                      # both defer work instead of faulting. Present (at
+                      # 0) so the /metrics schema is stable.
+                      "hbm_deferred_admissions": 0,
+                      "hbm_growth_deferrals": 0}
         # Token-storm guard run length (consecutive all-slots-identical
         # decode steps).
         self._storm_run = 0
@@ -512,6 +537,27 @@ class InferenceEngine:
             self._state_cache = DecodeStateCache(
                 ec.max_seqs, device=self._device, mesh=mesh,
                 stats=self.stats)
+
+        # Memory ledger (telemetry.memledger): the engine's owners. The
+        # params and cache handles are callables because both rebind
+        # (donated decode programs return a fresh cache list); prefix-
+        # cached blocks live INSIDE the pool arrays, so that owner is a
+        # carve — bytes move from kv_block_pool to prefix_cache_hbm
+        # without double counting.
+        self.memledger = MemoryLedger(
+            enabled=ec.memory_ledger, capacity_bytes=ec.hbm_budget_bytes)
+        self.memledger.register("params", lambda: self.params)
+        self.memledger.register("kv_block_pool", lambda: self.cache)
+        self.memledger.register(
+            "decode_state_cache",
+            lambda: (self._state_cache._dev
+                     if self._state_cache is not None else None))
+        if self.prefix_cache is not None:
+            kv_pool_bytes = tree_nbytes(self.cache)
+            per_block = kv_pool_bytes // max(1, ec.num_blocks)
+            self.memledger.register_carve(
+                "prefix_cache_hbm", "kv_block_pool",
+                lambda: self.prefix_cache.num_cached_blocks() * per_block)
 
     # ------------------------------------------------------------------
     def _shard_for_tp(self, mesh) -> None:
@@ -963,19 +1009,30 @@ class InferenceEngine:
         # they join the NEXT round's decode batch (their first token comes
         # from prefill sampling either way, so TTFT only improves).
         tr = self._tracer
-        pending = None
-        if any(not s.free and not s.prefilling for s in self.slots):
-            with tr.span("engine/decode_dispatch", cat="engine"):
-                pending = self._decode_dispatch()
-        with tr.span("engine/admit", cat="engine"):
-            self._admit()
-        if self.cfg.max_prefill_tokens_per_step > 0:
-            with tr.span("engine/prefill_chunks", cat="engine"):
-                self._prefill_work()
-        if pending is None:
-            return []
-        with tr.span("engine/decode_sync", cat="engine"):
-            return self._decode_complete(pending)
+        try:
+            pending = None
+            if any(not s.free and not s.prefilling for s in self.slots):
+                with tr.span("engine/decode_dispatch", cat="engine"):
+                    pending = self._decode_dispatch()
+            with tr.span("engine/admit", cat="engine"):
+                self._admit()
+            if self.cfg.max_prefill_tokens_per_step > 0:
+                with tr.span("engine/prefill_chunks", cat="engine"):
+                    self._prefill_work()
+            if pending is None:
+                return []
+            with tr.span("engine/decode_sync", cat="engine"):
+                return self._decode_complete(pending)
+        except Exception as e:
+            if is_oom_error(e):
+                # OOM forensics: file the black box as an OOM (with
+                # memory.json carrying the ownership map at death) before
+                # the fault propagates to the replica/server layer.
+                rec = get_recorder()
+                if rec is not None:
+                    rec.dump(reason="oom", force=True, exc=e,
+                             extra={"where": "engine_step"})
+            raise
 
     # ------------------------------------------------------------------
     # Scheduling internals
@@ -1039,6 +1096,23 @@ class InferenceEngine:
         admission stall is a handful of model calls instead of one per
         request — the dominant TTFT term once decode windows are long.
         """
+        # Headroom-aware admission (telemetry.memledger): under HBM
+        # pressure (a fragmented allocator, a co-tenant balloon, a tier
+        # restore burst), DEFER the whole admission pass rather than
+        # prefill into memory that is about to run out — the queue holds
+        # the requests, the next step retries, and the client sees
+        # latency, never an error. Gating needs a known capacity; when
+        # capacity is unknown (CPU without a budget) it stays off.
+        if (self.memledger.enabled
+                and self.cfg.admit_min_headroom_frac > 0 and self.waiting):
+            snap = self.memledger.snapshot()
+            cap = snap.get("capacity_bytes", 0)
+            headroom = snap.get("headroom_bytes")
+            if (cap and headroom is not None
+                    and headroom < self.cfg.admit_min_headroom_frac * cap):
+                self.stats["hbm_deferred_admissions"] += 1
+                return
+
         admissions: List[tuple] = []
         for slot in self.slots:
             # Cancelled while queued (disconnect before admission): finish
@@ -1362,32 +1436,52 @@ class InferenceEngine:
         # Grow block tables to cover the decode window; preempt the
         # youngest if the pool is exhausted. (Prefilling slots already own
         # blocks for prompt+1 from admission and are not decoding yet.)
-        for slot in sorted(
-            (s for s in self.slots if not s.free and not s.prefilling),
-            key=lambda s: s.request.arrival_time,
-        ):
-            if slot.free:  # preempted by an earlier iteration of this loop
-                continue
-            window = k_steps
-            if use_spec and slot.request.params.temperature != 0.0:
-                # Sampling slots advance exactly one real token per spec
-                # round; their draft-position writes past that land on the
-                # trash block (unallocated table entries are 0), so don't
-                # allocate — and possibly preempt for — the full window.
-                window = self._spec_rounds
-            need = self.block_manager.blocks_needed(slot.seq_len + window)
-            while need > len(slot.blocks):
-                got = self._alloc(1)
-                if got is None:
-                    if not self._preempt_youngest(exclude=slot):
-                        raise RuntimeError(
-                            "KV pool exhausted and nothing to preempt; "
-                            "increase num_blocks or lower max_seqs"
-                        )
+        def grow_tables(win_steps: int, spec: bool) -> bool:
+            for slot in sorted(
+                (s for s in self.slots if not s.free and not s.prefilling),
+                key=lambda s: s.request.arrival_time,
+            ):
+                if slot.free:  # preempted by an earlier iteration
                     continue
-                slot.blocks.extend(got)
-                self._block_tables[slot.slot_id, len(slot.blocks) - 1] = got[0]
-                self._mark_state_dirty(slot.slot_id)
+                window = win_steps
+                if spec and slot.request.params.temperature != 0.0:
+                    # Sampling slots advance exactly one real token per
+                    # spec round; their draft-position writes past that
+                    # land on the trash block (unallocated table entries
+                    # are 0), so don't allocate — and possibly preempt
+                    # for — the full window.
+                    window = self._spec_rounds
+                need = self.block_manager.blocks_needed(
+                    slot.seq_len + window)
+                while need > len(slot.blocks):
+                    got = self._alloc(1)
+                    if got is None:
+                        if not self._preempt_youngest(exclude=slot):
+                            return False
+                        continue
+                    slot.blocks.extend(got)
+                    self._block_tables[
+                        slot.slot_id, len(slot.blocks) - 1] = got[0]
+                    self._mark_state_dirty(slot.slot_id)
+            return True
+
+        if not grow_tables(k_steps, use_spec):
+            if k_steps > 1:
+                # Defer, don't fault: a multi-step window that cannot
+                # reserve its worst-case blocks shrinks to a single-step
+                # round (blocks already granted stay on their slots and
+                # carry over; table rows past the shrunk window are never
+                # read). One block per active slot is guaranteed by the
+                # admission-time max_blocks_per_seq check, so win=1 can
+                # only fail on genuine exhaustion.
+                self.stats["hbm_growth_deferrals"] += 1
+                use_spec = False
+                k_steps = 1
+            if not grow_tables(k_steps, use_spec):
+                raise RuntimeError(
+                    "KV pool exhausted and nothing to preempt; "
+                    "increase num_blocks or lower max_seqs"
+                )
 
         active = [s for s in self.slots
                   if not s.free and not s.prefilling]

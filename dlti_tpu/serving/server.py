@@ -136,6 +136,14 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
                    _ledger.phase_seconds_total,
                    _ledger.phase_requests_total):
         registry.register(metric)
+    # HBM memory ledger (telemetry.memledger): per-owner device-memory
+    # gauges — module-level like the watchdog/flight counters, so an
+    # in-process trainer's ledger and the engine's share one exposition.
+    from dlti_tpu.telemetry import memledger as _ml
+
+    for metric in (_ml.hbm_bytes_gauge, _ml.hbm_peak_gauge,
+                   _ml.hbm_headroom_gauge, _ml.hbm_untracked_gauge):
+        registry.register(metric)
     return registry
 
 
@@ -257,7 +265,9 @@ class AsyncEngine:
                     # Black box first, cleanup second: abort_all below
                     # rewrites the very state (slots, waiting, stats) the
                     # forensics need.
-                    rec.dump(reason="engine_step_fault", exc=e, force=True)
+                    from dlti_tpu.telemetry.memledger import is_oom_error
+                    rec.dump(reason="oom" if is_oom_error(e)
+                             else "engine_step_fault", exc=e, force=True)
                 with self._work:
                     # Fail fast: abort every request the engine holds
                     # (slots + waiting; KV is NOT prefix-cache-registered
@@ -490,6 +500,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "phases": list(_REQUEST_PHASES),
                 "worst": worst,
             })
+        if path == "/debug/memory":
+            # Full "where the memory lives" map (telemetry.memledger):
+            # per-owner bytes, untracked/residual buckets summing to
+            # bytes-in-use, activation-peak estimate, top untracked
+            # arrays — the JSON twin of the flight dump's memory.json.
+            ledger = getattr(self.async_engine.engine, "memledger", None)
+            if ledger is None or not ledger.enabled:
+                return self._error(404, "memory ledger disabled")
+            return self._json(200, ledger.to_dict(top_k=8))
         if path == "/dashboard":
             # Self-contained live dashboard: inline CSS/JS polling
             # /debug/vars — watching a run needs a browser, not a
@@ -1016,6 +1035,12 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     sampler = TimeSeriesSampler(
         interval_s=wcfg.interval_s if wcfg is not None else 1.0,
         registry=registry)
+    if getattr(engine, "memledger", None) is not None \
+            and engine.memledger.enabled:
+        # Ledger scalars into the ring: /debug/vars + /dashboard get the
+        # "where the memory lives" series, and the watchdog's
+        # hbm_pressure rule reads hbm_headroom_frac from here.
+        sampler.add_source(engine.memledger.scalars)
     sampler.start()
     recorder = None
     if tcfg is not None and tcfg.flight_recorder.enabled:
@@ -1033,6 +1058,9 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
             max_spans=fcfg.max_spans, timeseries_tail=fcfg.timeseries_tail,
             keep=fcfg.keep)
         recorder.add_metrics_source(registry.stats_dict)
+        if getattr(engine, "memledger", None) is not None \
+                and engine.memledger.enabled:
+            recorder.add_memory_source(engine.memledger.to_dict)
         recorder.note(role="serving", model=cfg.model_name)
         install_recorder(recorder)
     watchdog = None

@@ -29,6 +29,11 @@ reference-parity CSV in ``utils/metrics.py``, ``StepTimer`` in
   second booked to one bucket, conservation-tested) + per-request
   critical-path attribution (phase breakdowns summing to client-observed
   latency, ``GET /debug/slow``), stitched across elastic restarts.
+* :mod:`~dlti_tpu.telemetry.memledger` — HBM memory ledger (every
+  device byte attributed to a named owner, conservation-tested against
+  ``jax.live_arrays()``/``memory_stats()``), feeding ``GET
+  /debug/memory``, ``memory.json`` OOM forensics, the watchdog's
+  hbm_pressure rule, and the engine's headroom-aware admission.
 """
 
 from dlti_tpu.telemetry.registry import (  # noqa: F401
@@ -75,4 +80,13 @@ from dlti_tpu.telemetry.ledger import (  # noqa: F401
     REQUEST_PHASES,
     request_breakdown,
     stitch_ledgers,
+)
+from dlti_tpu.telemetry.memledger import (  # noqa: F401
+    MEMLEDGER_METRIC_NAMES,
+    MEMORY_OWNERS,
+    MemoryBalloon,
+    MemoryLedger,
+    executable_memory_analysis,
+    is_oom_error,
+    tree_nbytes,
 )

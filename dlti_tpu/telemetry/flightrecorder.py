@@ -18,6 +18,10 @@ atomically materializes a ``flight-<step|ts>/`` directory:
 * ``timeseries.json`` — the sampler ring tail (the minutes *leading up
   to* the event — the part a point-in-time snapshot can never give you).
 * ``config.json``     — the full run config.
+* ``memory.json``     — the memory ledger's full ownership map at death
+  (per owner per device + untracked/residual reconciliation): the "where
+  the memory went" evidence an OOM postmortem needs. Always present —
+  ``{}`` when no memory source is wired.
 * ``MANIFEST.json``   — per-file sizes + SHA-256, written last; the dump
   stages into a ``.tmp-`` dir and renames, so a dump directory that
   exists is complete (same discipline as the checkpoint store).
@@ -59,7 +63,7 @@ _PREFIX = "flight-"
 _TMP = ".tmp-"
 MANIFEST = "MANIFEST.json"
 DUMP_FILES = ("context.json", "spans.json", "metrics.json",
-              "timeseries.json", "config.json")
+              "timeseries.json", "config.json", "memory.json")
 
 
 def config_fingerprint(config) -> Optional[str]:
@@ -96,6 +100,7 @@ class FlightRecorder:
         self._context: dict = {}
         self._metrics_sources: List[Callable[[], dict]] = []
         self._context_sources: List[Callable[[], dict]] = []
+        self._memory_sources: List[Callable[[], dict]] = []
         self._last_dump_t = 0.0
         self.last_dump_path: Optional[str] = None
 
@@ -116,6 +121,11 @@ class FlightRecorder:
         """A callable merged into ``context.json`` at dump time (e.g. the
         watchdog's recent-alerts tail)."""
         self._context_sources.append(fn)
+
+    def add_memory_source(self, fn: Callable[[], dict]) -> None:
+        """A callable snapshotted into ``memory.json`` at dump time
+        (``MemoryLedger.to_dict`` — the full ownership map at death)."""
+        self._memory_sources.append(fn)
 
     # -- the dump -------------------------------------------------------
     def dump(self, reason: str, exc: Optional[BaseException] = None,
@@ -155,6 +165,17 @@ class FlightRecorder:
             except Exception:
                 metrics.setdefault("metrics_source_errors", 0)
                 metrics["metrics_source_errors"] += 1
+        # memory.json is ALWAYS written (verify_dump requires every
+        # DUMP_FILES entry); {} when no ledger is wired. A snapshot
+        # failure must not lose the dump — the OOM being dumped may be
+        # exactly what makes allocation-side introspection fragile.
+        memory: dict = {}
+        for fn in self._memory_sources:
+            try:
+                memory.update(fn())
+            except Exception:
+                memory.setdefault("memory_source_errors", 0)
+                memory["memory_source_errors"] += 1
 
         label = (f"step{int(context['step']):08d}" if "step" in context
                  else time.strftime("%Y%m%dT%H%M%S"))
@@ -205,6 +226,7 @@ class FlightRecorder:
             "config.json": (self.config.to_dict()
                             if hasattr(self.config, "to_dict")
                             else (self.config or {})),
+            "memory.json": memory,
         }
         manifest: dict = {"format": 1, "reason": reason,
                           "created": time.time(), "files": {}}

@@ -40,13 +40,19 @@ from dlti_tpu.utils.metrics import MetricsRecord
 # step — data/prefetch stall, device sync, checkpoint save+restore, and
 # rollback+replay — divided evenly across a steps_per_sync window's
 # records (checkpoint time issued after a record books to the next one).
-# All 0.0 when the ledger is disabled.
+# All 0.0 when the ledger is disabled. The memory-ledger fields (PR 11,
+# telemetry.memledger): device bytes in use and the remaining headroom at
+# this step's bookkeeping boundary — the per-step twins of the goodput
+# phase fields, on the bytes axis. hbm_headroom_bytes is -1 when
+# capacity is unknown (CPU runs without a configured budget); both are 0
+# when the memory ledger is disabled.
 STEP_RECORD_FIELDS = (
     "type", "step", "loss", "grad_norm", "lr",
     "tokens_per_second_per_chip", "mfu_percent",
     "peak_memory_gb", "peak_memory_source", "step_time_s",
     "anomaly", "skipped_update", "rollbacks_total",
     "data_wait_s", "sync_s", "ckpt_s", "rollback_s",
+    "hbm_bytes_in_use", "hbm_headroom_bytes",
 )
 
 RUN_RECORD_FIELDS = ("type", "experiment", "num_gpus", "zero_stage",

@@ -171,6 +171,9 @@ _DASH_PREFERRED = (
     "gateway_queue_depth", "gateway_queued_tokens", "gateway_inflight",
     "train_step", "train_loss", "train_tokens_per_s", "train_step_time_s",
     "goodput_fraction", "train_mfu_percent",
+    # "where the memory lives" panel (telemetry.memledger scalars).
+    "hbm_bytes_in_use", "hbm_headroom_bytes",
+    "hbm_tracked_bytes", "hbm_untracked_bytes",
 )
 
 _DASHBOARD_HTML = """<!doctype html>

@@ -43,6 +43,11 @@ alert, not one per check interval):
   (``goodput_fraction`` in the ring, ``telemetry.ledger``) fell below
   ``goodput_floor_frac`` x its rolling median: the run still steps, but
   recovery work (rollbacks, restores, stalls) is eating the wall clock.
+* ``hbm_pressure``          — the memory ledger's headroom fraction
+  (``hbm_headroom_frac`` in the ring, ``telemetry.memledger``) dropped
+  below ``hbm_headroom_floor_frac``: the next big allocation (a long
+  prefill, a KV growth burst) is likely to OOM — alert (and dump the
+  ownership map) while the process is still alive to tell the story.
 """
 
 from __future__ import annotations
@@ -74,7 +79,7 @@ alerts_total = Counter(
 RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
          "nonfinite_step", "loss_spike", "sdc_mismatch",
-         "goodput_collapse")
+         "goodput_collapse", "hbm_pressure")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -297,6 +302,25 @@ class AnomalyWatchdog:
                         fired.append(a)
                 else:
                     self._active.discard("goodput_collapse")
+
+        # hbm_pressure -------------------------------------------------
+        headroom_floor = getattr(self.cfg, "hbm_headroom_floor_frac", 0.0)
+        if headroom_floor > 0:
+            pts = [v for _, v in self.sampler.series("hbm_headroom_frac")]
+            if pts:
+                latest = pts[-1]
+                if latest < headroom_floor:
+                    a = self._fire("hbm_pressure", "hbm_pressure",
+                                   f"HBM headroom down to "
+                                   f"{latest * 100:.1f}% of capacity "
+                                   f"(floor {headroom_floor * 100:g}%) — "
+                                   f"the next large allocation may OOM",
+                                   headroom_frac=round(latest, 4),
+                                   floor_frac=headroom_floor)
+                    if a:
+                        fired.append(a)
+                else:
+                    self._active.discard("hbm_pressure")
 
         # sentinel rules: nonfinite_step / loss_spike / sdc_mismatch ---
         latest = (self.sampler.latest() or {}).get("values", {})

@@ -49,6 +49,18 @@ Numeric chaos (the sentinel drills, ``dlti_tpu.training.sentinel``):
                     on rank RANK (default 1) at step boundary STEP — the
                     silent-data-corruption simulation the cross-rank
                     digest probe must catch and attribute.
+
+Memory chaos (the OOM drill, ``dlti_tpu.telemetry.memledger``):
+
+* ``hbm-squeeze`` — at step boundary STEP, inflate a balloon of live
+                    device arrays (``DLTI_CHAOS_BALLOON_BYTES``, default
+                    64 MiB) registered under the ledger's
+                    ``chaos_balloon`` owner, then raise
+                    :class:`SimulatedOOM` (a RESOURCE_EXHAUSTED-shaped
+                    :class:`TrainFault`). The balloon stays live while
+                    the fault unwinds, so the flight dump's
+                    ``memory.json`` captures the squeezed state — the
+                    deterministic CPU stand-in for a real HBM OOM.
 """
 
 from __future__ import annotations
@@ -62,8 +74,18 @@ class TrainFault(RuntimeError):
     """Raised by the fault injector (``raise`` / ``save-raise`` modes)."""
 
 
+class SimulatedOOM(TrainFault):
+    """``hbm-squeeze``'s fault: its message carries RESOURCE_EXHAUSTED so
+    ``telemetry.memledger.is_oom_error`` classifies it exactly like a
+    real XlaRuntimeError OOM — the whole forensics path downstream of
+    the catch is the one a real OOM would take."""
+
+
 _MODES = ("raise", "kill", "save-raise", "save-kill",
-          "nan-grad", "poison-batch", "param-flip")
+          "nan-grad", "poison-batch", "param-flip", "hbm-squeeze")
+
+# hbm-squeeze balloon size (bytes); small enough for CI CPU hosts.
+_BALLOON_BYTES_DEFAULT = 64 << 20
 
 
 class TrainFaultInjector:
@@ -139,6 +161,30 @@ class TrainFaultInjector:
         if (not self.fired and self.mode in ("raise", "kill")
                 and step >= self.step):
             self._fire("at step boundary", step)
+        if (not self.fired and self.mode == "hbm-squeeze"
+                and step >= self.step):
+            self.fired = True
+            from dlti_tpu.telemetry import memledger as _ml
+
+            # Inflate BEFORE raising: the balloon's arrays are live while
+            # the fault unwinds, so the dump's memory.json shows the
+            # chaos_balloon owner holding the squeezed bytes.
+            nbytes = int(os.environ.get(
+                "DLTI_CHAOS_BALLOON_BYTES", _BALLOON_BYTES_DEFAULT))
+            balloon = _ml.MemoryBalloon(ledger=_ml.get_ledger())
+            try:
+                balloon.inflate(nbytes)
+            except Exception:
+                pass  # a balloon that itself OOMs still squeezed enough
+            if self.pre_fire is not None:
+                try:
+                    self.pre_fire(self.mode, "HBM squeezed (balloon "
+                                  f"{balloon.nbytes} bytes)", step)
+                except Exception:
+                    pass
+            raise SimulatedOOM(
+                f"RESOURCE_EXHAUSTED: injected HBM squeeze at step {step} "
+                f"(balloon {balloon.nbytes} bytes)")
 
     def maybe_fire_save(self, step: int) -> None:
         """Call right after a checkpoint save has been issued (async

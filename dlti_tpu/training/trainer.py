@@ -38,6 +38,10 @@ from dlti_tpu.telemetry import (
 from dlti_tpu.telemetry.ledger import (
     goodput_fraction_gauge, goodput_mfu_gauge, goodput_seconds_total,
 )
+from dlti_tpu.telemetry import memledger as memledger_mod
+from dlti_tpu.telemetry.memledger import (
+    MemoryLedger, executable_memory_analysis, is_oom_error,
+)
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
 from dlti_tpu.training.step import make_train_step
@@ -383,6 +387,25 @@ class Trainer:
         state = state or self.init_state()
         resume = cfg.checkpoint.resume if resume is None else resume
 
+        # Memory ledger (telemetry.memledger): owners registered as
+        # callables through a one-slot box because the functional state
+        # rebinds every step (donated buffers delete; the ledger skips
+        # deleted arrays, and the box is refreshed at every bookkeep /
+        # restore / rollback so snapshots track the live state).
+        memledger = self._memledger = MemoryLedger(
+            enabled=cfg.telemetry.memory_ledger,
+            capacity_bytes=cfg.telemetry.hbm_budget_bytes)
+        memledger_mod.install(memledger)
+        mem_state = {"state": state}
+        memledger.register("params", lambda: mem_state["state"].params)
+        memledger.register("optimizer_state",
+                           lambda: mem_state["state"].opt_state)
+        memledger.register(
+            "prefetch_buffers",
+            lambda: (self._prefetcher.buffered_batches()
+                     if getattr(self, "_prefetcher", None) is not None
+                     else None))
+
         # Preemption-aware checkpointing (SURVEY.md §5.3): the reference's
         # only resilience is frequent periodic saves; here SIGTERM (the
         # cluster-eviction signal) triggers one final checkpoint at the
@@ -425,6 +448,7 @@ class Trainer:
             ledger.enter("startup")
             if restored is not None:
                 state, step, resume_meta = restored
+                mem_state["state"] = state
                 start_step = int(step)
                 self.logger.info(
                     "resumed from verified checkpoint step %d", start_step)
@@ -548,6 +572,10 @@ class Trainer:
             # /dashboard sparkline, and every flight dump read these).
             if ledger.enabled:
                 d.update(ledger.scalars())
+            # Memory ledger: hbm_* series (the hbm_pressure rule, the
+            # dashboard's "where the memory lives" panel, flight dumps).
+            if memledger.enabled:
+                d.update(memledger.scalars())
             if heartbeat is not None and heartbeat.last_seen:
                 # Straggler lag on /debug/vars (the gauge twin lives in
                 # Heartbeat.register; this is the ring-series form).
@@ -573,6 +601,10 @@ class Trainer:
                 max_spans=fcfg.max_spans,
                 timeseries_tail=fcfg.timeseries_tail, keep=fcfg.keep)
             flight.add_metrics_source(_train_scalars)
+            if memledger.enabled:
+                # Every dump carries memory.json — the full ownership map
+                # at death, the OOM postmortem's primary evidence.
+                flight.add_memory_source(memledger.to_dict)
             flight.note(role="training", phase="init", step=start_step,
                         last_completed_step=start_step,
                         experiment=experiment_name_from_config(cfg))
@@ -805,10 +837,30 @@ class Trainer:
         # step-time samples.
         step_fn_warm = {"done": multi_fn is None}
 
+        # Activation-peak estimate: fold the compiled step's
+        # memory_analysis() (temp/argument/output bytes — the transient
+        # HBM a between-steps snapshot can never see) into the memory
+        # ledger, once. Opt-in via env: the jit wrapper exposes no handle
+        # to its cached executable, so this lowers+compiles a second time
+        # — free on the tiny CI models that assert on it, not on a 7B run.
+        mem_act = {"due": (memledger.enabled and os.environ.get(
+            "DLTI_HBM_ANALYZE_STEP", "0") != "0")}
+
+        def fold_step_memory_analysis(state, gb, r):
+            mem_act["due"] = False
+            try:
+                info = executable_memory_analysis(
+                    step_fn.lower(state, gb, r).compile())
+            except Exception:
+                return
+            memledger.note_activation_peak(info)
+
         def exec_steps(state, items):
             """Classic path: one compiled call + host sync per step."""
             executed = []
             for hb, gb, r, pos in items:
+                if mem_act["due"]:
+                    fold_step_memory_analysis(state, gb, r)
                 warm = step_fn_warm["done"]
                 if warm:
                     timer.start()
@@ -912,6 +964,12 @@ class Trainer:
             nonlocal global_step, samples_seen
             step_before = global_step
             window_anomalous = False
+            # Memory ledger: follow the state rebind, then one snapshot
+            # per bookkeep (not per step — live_arrays walks aren't free)
+            # feeding the window's steplog records and the /metrics
+            # gauges.
+            mem_state["state"] = state
+            mem_scalars = memledger.scalars() if memledger.enabled else {}
             # Goodput bookkeeping: host-side accounting books to "other";
             # the deltas accrued since the previous bookkeep feed the
             # steplog's per-phase fields and the /metrics counter (a
@@ -1006,6 +1064,16 @@ class Trainer:
                         rollback_s=round(
                             (deltas.get("rollback", 0.0)
                              + deltas.get("replay", 0.0)) / n_exec, 6),
+                        # Memory-ledger per-step fields (steplog schema):
+                        # headroom is -1 when capacity is unknown (CPU
+                        # without a budget); both 0 when the ledger is
+                        # off.
+                        hbm_bytes_in_use=int(
+                            mem_scalars.get("hbm_bytes_in_use", 0)),
+                        hbm_headroom_bytes=int(
+                            mem_scalars.get(
+                                "hbm_headroom_bytes",
+                                -1 if memledger.enabled else 0)),
                     )
                 if global_step % cfg.train.logging_steps == 0 and is_main_process():
                     self.logger.info(
@@ -1180,6 +1248,7 @@ class Trainer:
                     len(info["streak"]))
                 return state, epoch
             new_state, step, meta = restored
+            mem_state["state"] = new_state
             ck_cursor = int((meta or {}).get("data_pos", step))
             # Strike ONLY the windows that fed anomalous steps — the
             # innocent windows since the checkpoint replay untouched.
@@ -1415,7 +1484,11 @@ class Trainer:
                 # never masked.
                 exc = sys.exc_info()[1]
                 if exc is not None:
-                    flight.dump(reason="fatal_exception", exc=exc)
+                    # An OOM death is filed as such: the dump's
+                    # memory.json (add_memory_source above) is what
+                    # postmortem.py renders as "where the memory went".
+                    flight.dump(reason="oom" if is_oom_error(exc)
+                                else "fatal_exception", exc=exc)
                 elif self._stop_requested and not self._sdc_evict:
                     # (an SDC eviction already dumped its own black box)
                     flight.dump(reason="preemption_stop")
@@ -1427,6 +1500,8 @@ class Trainer:
                 if get_recorder() is flight:
                     install_recorder(None)
                 self._fnote = lambda **kw: None
+            if memledger_mod.get_ledger() is memledger:
+                memledger_mod.install(None)
             if sigterm_installed:
                 # signal.signal reports a non-Python-installed previous
                 # handler as None; SIG_DFL is the closest restorable state.

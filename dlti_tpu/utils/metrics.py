@@ -104,25 +104,46 @@ def detect_chip_peak_flops() -> float:
     return TPU_PEAK_FLOPS["cpu"]
 
 
+def device_memory_stats() -> dict:
+    """Per-device PJRT memory stats: ``{device_str: stats_dict}`` for every
+    local device that reports them (CPU backends and some plugins return
+    None — those devices are simply absent). The raw map behind
+    :func:`device_peak_memory` and the memory ledger's reconciliation
+    (``dlti_tpu.telemetry.memledger``)."""
+    import jax
+
+    out = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(dev)] = dict(stats)
+    return out
+
+
 def device_peak_memory() -> tuple:
     """Peak memory as ``(gb, source)`` (the
     ``torch.cuda.max_memory_allocated`` analog, reference
     ``train_baseline.py:253``).
 
-    ``source`` is ``"device"`` (PJRT memory stats — real HBM),
-    ``"host_rss"`` (process VmHWM fallback for CPU-simulated runs and PJRT
-    plugins that return no stats, like the remote relay), or ``"none"``.
-    Device HBM and host RSS are different quantities; consumers of the CSV
-    must be able to tell them apart, hence the explicit source.
+    Aggregates across ALL local devices — the per-process peak is the sum
+    of each chip's ``peak_bytes_in_use`` (a megacore host drives 4+ chips;
+    reading only device 0 under-reported by the chip count). ``source`` is
+    ``"device"`` (PJRT memory stats — real HBM), ``"host_rss"`` (process
+    VmHWM fallback for CPU-simulated runs and PJRT plugins that return no
+    stats, like the remote relay), or ``"none"``. Device HBM and host RSS
+    are different quantities; consumers of the CSV must be able to tell
+    them apart, hence the explicit source.
     """
-    import jax
-
     try:
-        stats = jax.local_devices()[0].memory_stats()
-        if stats:
-            peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
-            if peak:
-                return peak / 1024**3, "device"
+        total = 0
+        for stats in device_memory_stats().values():
+            total += stats.get("peak_bytes_in_use",
+                               stats.get("bytes_in_use", 0)) or 0
+        if total:
+            return total / 1024**3, "device"
     except Exception:
         pass
     try:  # host fallback: peak resident set (VmHWM), linux procfs

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Static HBM capacity planner — "will it fit?" answered BEFORE compiling.
+
+The paper-plan half of the memory ledger
+(``dlti_tpu/telemetry/memledger.py``): the ledger measures where device
+memory actually went at runtime; this script predicts the same owner
+buckets from the model/engine configs alone, so a 7B serving deployment
+(or a fine-tune) can be sized on paper — and the two are cross-checked
+against each other in ``tests/test_memledger.py`` on a tiny CPU model.
+
+Training plan (per chip, no sharding):
+    params      = num_params x sizeof(param_dtype)
+    optimizer   = 2 x trainable x 4        (AdamW m+v, always fp32)
+    grad_buffers = trainable x 4           (transient; peak-relevant)
+Serving plan:
+    params      = num_params x sizeof(param_dtype)
+    kv_pool     = 2 x layers x kv_heads x head_dim x sizeof(kv_dtype)
+                  x block_size x num_blocks
+    kv/token    = the same without the pool factors -> max resident
+                  tokens, and max concurrent seqs at max_model_len
+
+Usage:
+    python scripts/memory_plan.py --model llama2_7b --budget-gb 16
+    python scripts/memory_plan.py --model llama2_7b --serving \\
+        --num-blocks 2048 --kv-dtype int8 --budget-gb 16
+    python scripts/memory_plan.py ... --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+# Source checkout wins over any installed copy.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_repo_root, "dlti_tpu")):
+    sys.path.insert(0, _repo_root)
+del _repo_root
+
+from dlti_tpu.config import MODEL_PRESETS, ModelConfig  # noqa: E402
+
+# Storage bytes per element (matches dlti_tpu.utils.dtypes resolution).
+DTYPE_BYTES = {
+    "float32": 4, "fp32": 4, "f32": 4,
+    "bfloat16": 2, "bf16": 2, "float16": 2, "fp16": 2,
+    "int8": 1, "fp8": 1,
+}
+
+
+def _dtype_bytes(name: str) -> int:
+    try:
+        return DTYPE_BYTES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r}; one of "
+                         f"{sorted(DTYPE_BYTES)}") from None
+
+
+def lora_trainable_params(cfg: ModelConfig, r: int = 16,
+                          target_modules: tuple = ("q_proj", "k_proj",
+                                                   "v_proj", "o_proj"),
+                          ) -> int:
+    """Adapter parameter count for the reference LoRA graft: per layer and
+    per targeted projection, two factors of shape (in, r) and (r, out)."""
+    h = cfg.hidden_size
+    hd = cfg.resolved_head_dim
+    dims = {
+        "q_proj": (h, cfg.num_heads * hd),
+        "k_proj": (h, cfg.num_kv_heads * hd),
+        "v_proj": (h, cfg.num_kv_heads * hd),
+        "o_proj": (cfg.num_heads * hd, h),
+    }
+    per_layer = sum(r * (i + o) for m, (i, o) in dims.items()
+                    if m in target_modules)
+    return cfg.num_layers * per_layer
+
+
+def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str = "bfloat16") -> int:
+    """K + V bytes one token holds resident across all layers."""
+    return (2 * cfg.num_layers * cfg.num_kv_heads * cfg.resolved_head_dim
+            * _dtype_bytes(kv_dtype))
+
+
+def plan_training(cfg: ModelConfig, param_dtype: Optional[str] = None,
+                  trainable_params: Optional[int] = None,
+                  budget_bytes: int = 0) -> dict:
+    """Owner-bucket prediction for one training process (no sharding —
+    divide by the data/tensor-parallel factor externally)."""
+    pbytes = _dtype_bytes(param_dtype or cfg.param_dtype)
+    n = cfg.num_params()
+    trainable = n if trainable_params is None else trainable_params
+    owners = {
+        "params": n * pbytes,
+        # AdamW first/second moments, fp32 regardless of param dtype.
+        "optimizer_state": 2 * trainable * 4,
+        # Transient but peak-relevant: one fp32 grad per trainable param.
+        "grad_buffers": trainable * 4,
+    }
+    total = sum(owners.values())
+    out = {
+        "mode": "training",
+        "num_params": n,
+        "trainable_params": trainable,
+        "owners": owners,
+        "total_bytes": total,
+    }
+    if budget_bytes:
+        out["budget_bytes"] = budget_bytes
+        out["headroom_bytes"] = budget_bytes - total
+        out["fits"] = total <= budget_bytes
+    return out
+
+
+def plan_serving(cfg: ModelConfig, param_dtype: Optional[str] = None,
+                 kv_dtype: str = "bfloat16", num_blocks: int = 256,
+                 block_size: int = 16, max_model_len: int = 0,
+                 budget_bytes: int = 0) -> dict:
+    """Owner-bucket prediction for one engine replica: the KV pool is
+    pre-allocated at init (engine.py), so its full size is resident from
+    the first request."""
+    pbytes = _dtype_bytes(param_dtype or cfg.param_dtype)
+    n = cfg.num_params()
+    per_tok = kv_bytes_per_token(cfg, kv_dtype)
+    owners = {
+        "params": n * pbytes,
+        "kv_block_pool": per_tok * block_size * num_blocks,
+    }
+    total = sum(owners.values())
+    max_len = max_model_len or cfg.max_seq_len
+    out = {
+        "mode": "serving",
+        "num_params": n,
+        "owners": owners,
+        "total_bytes": total,
+        "kv_bytes_per_token": per_tok,
+        # Block 0 is the engine's reserved trash block.
+        "max_resident_tokens": (num_blocks - 1) * block_size,
+        "max_seqs_at_max_len": (num_blocks - 1) * block_size // max_len,
+    }
+    if budget_bytes:
+        out["budget_bytes"] = budget_bytes
+        out["headroom_bytes"] = budget_bytes - total
+        out["fits"] = total <= budget_bytes
+        # How large could the pool grow inside the budget?
+        kv_budget = budget_bytes - owners["params"]
+        per_block = per_tok * block_size
+        out["max_blocks_in_budget"] = max(0, kv_budget // per_block)
+    return out
+
+
+def render(p: dict) -> str:
+    gib = 1024.0 ** 3
+    out = [f"memory plan ({p['mode']}, {p['num_params'] / 1e6:.1f}M params)"]
+    total = p["total_bytes"] or 1
+    for k, v in sorted(p["owners"].items(), key=lambda kv: -kv[1]):
+        out.append(f"    {k:20s} {v / gib:9.3f} GiB  {100 * v / total:5.1f}%")
+    out.append(f"    {'total':20s} {total / gib:9.3f} GiB")
+    if "budget_bytes" in p:
+        verdict = "FITS" if p["fits"] else "DOES NOT FIT"
+        out.append(f"    budget {p['budget_bytes'] / gib:.2f} GiB -> "
+                   f"{verdict}, headroom {p['headroom_bytes'] / gib:.3f} GiB")
+    if p["mode"] == "serving":
+        out.append(f"    kv/token {p['kv_bytes_per_token']} B; max resident "
+                   f"tokens {p['max_resident_tokens']}; "
+                   f"max seqs @ max_len {p['max_seqs_at_max_len']}")
+        if "max_blocks_in_budget" in p:
+            out.append(f"    pool could grow to {p['max_blocks_in_budget']} "
+                       f"blocks inside the budget")
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="static HBM capacity plan from model/engine configs")
+    ap.add_argument("--model", default="llama2_7b",
+                    choices=sorted(MODEL_PRESETS))
+    ap.add_argument("--serving", action="store_true",
+                    help="plan a serving replica instead of a trainer")
+    ap.add_argument("--param-dtype", default=None,
+                    help="override the preset's param storage dtype")
+    ap.add_argument("--kv-dtype", default="bfloat16")
+    ap.add_argument("--num-blocks", type=int, default=256)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-model-len", type=int, default=0)
+    ap.add_argument("--lora-r", type=int, default=0,
+                    help="LoRA rank: trainable = adapters only "
+                         "(0 = full fine-tune)")
+    ap.add_argument("--budget-gb", type=float, default=0.0,
+                    help="HBM budget to check the plan against")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    cfg = MODEL_PRESETS[args.model]
+    budget = int(args.budget_gb * 1024 ** 3)
+    if args.serving:
+        p = plan_serving(cfg, param_dtype=args.param_dtype,
+                         kv_dtype=args.kv_dtype, num_blocks=args.num_blocks,
+                         block_size=args.block_size,
+                         max_model_len=args.max_model_len,
+                         budget_bytes=budget)
+    else:
+        trainable = (lora_trainable_params(cfg, r=args.lora_r)
+                     if args.lora_r else None)
+        p = plan_training(cfg, param_dtype=args.param_dtype,
+                          trainable_params=trainable, budget_bytes=budget)
+    if args.json:
+        print(json.dumps(p, indent=2))
+    else:
+        print(render(p))
+
+
+if __name__ == "__main__":
+    main()

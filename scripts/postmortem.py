@@ -113,6 +113,31 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
             "buckets": dict(sorted(buckets.items(),
                                    key=lambda kv: -kv[1])),
         }
+    # HBM memory ledger (telemetry.memledger): every dump carries
+    # memory.json — "where the memory went" is THE question after an OOM,
+    # and useful context for any other death. None when the dump predates
+    # the ledger or the source produced nothing.
+    mem = data.get("memory.json") or {}
+    memory = None
+    if mem.get("owners") or mem.get("bytes_in_use"):
+        in_use = mem.get("bytes_in_use", 0) or 0
+        buckets = {o: d.get("bytes", 0)
+                   for o, d in (mem.get("owners") or {}).items()}
+        for k in ("untracked", "residual"):
+            v = mem.get(f"{k}_bytes", 0)
+            if v:
+                buckets[k] = v
+        memory = {
+            "source": mem.get("source"),
+            "bytes_in_use": in_use,
+            "peak_bytes": mem.get("peak_bytes", 0),
+            "capacity_bytes": mem.get("capacity_bytes", 0),
+            "headroom_bytes": mem.get("headroom_bytes"),
+            "buckets": dict(sorted(buckets.items(), key=lambda kv: -kv[1])),
+            "activation_peak": mem.get("activation_peak"),
+            "top_untracked_arrays": (mem.get("top_untracked_arrays")
+                                     or [])[:5],
+        }
     # Numeric-fault evidence: sentinel dumps carry their verdict in
     # context.json's top level (rollback streak / SDC alert), and any
     # dump may carry the last anomaly the trainer noted.
@@ -140,6 +165,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "exception_tail": (exc.strip().splitlines()[-3:] if exc else None),
         "sentinel": sentinel or None,
         "goodput": goodput,
+        "memory": memory,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
         "tracer_enabled": spans.get("tracerEnabled"),
@@ -311,6 +337,27 @@ def render(summary: dict) -> str:
             f"   (goodput {100 * frac:.1f}%)" if frac is not None else ""))
         for k, v in list(g["buckets"].items())[:8]:
             w(f"    {k:20s} {v:10.2f}s  {100 * v / wall:5.1f}%")
+    if summary.get("memory"):
+        m = summary["memory"]
+        gib = 1024.0 ** 3
+        in_use = m.get("bytes_in_use", 0) or 1
+        line = f"where the memory went:   ({in_use / gib:.2f} GiB in use"
+        cap = m.get("capacity_bytes") or 0
+        if cap:
+            line += f" of {cap / gib:.2f} GiB"
+        hr = m.get("headroom_bytes")
+        if hr is not None:
+            line += f", headroom {hr / gib:.2f} GiB"
+        w(line + f", source {m.get('source')})")
+        for k, v in list(m["buckets"].items())[:10]:
+            w(f"    {k:20s} {v / gib:9.3f} GiB  {100 * v / in_use:5.1f}%")
+        act = m.get("activation_peak") or {}
+        if act.get("activation_peak_bytes"):
+            w(f"    (compiled-step activation peak estimate: "
+              f"{act['activation_peak_bytes'] / gib:.3f} GiB)")
+        for a in m.get("top_untracked_arrays") or []:
+            w(f"    untracked: {a.get('nbytes', 0) / gib:9.3f} GiB  "
+              f"{a.get('shape')} {a.get('dtype')}")
     if summary["watchdog_alerts"]:
         w(f"watchdog:      {len(summary['watchdog_alerts'])} alert(s) "
           f"before death:")

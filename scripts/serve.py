@@ -118,6 +118,20 @@ def parse_args():
                    help="quarantine a replica after N consecutive decode "
                         "steps where every active slot sampled the same "
                         "token (degenerate-output storm; 0 = off)")
+    p.add_argument("--no-memory-ledger", action="store_true",
+                   help="disable the HBM memory ledger "
+                        "(telemetry.memledger): no per-owner attribution, "
+                        "/debug/memory, hbm_* gauges, or memory.json in "
+                        "flight dumps")
+    p.add_argument("--hbm-budget-bytes", type=int, default=0,
+                   help="HBM capacity for headroom accounting (0 = "
+                        "auto-detect from device memory_stats(); stays "
+                        "unknown on CPU, keeping headroom features off)")
+    p.add_argument("--admit-min-headroom-frac", type=float, default=0.0,
+                   help="defer admitting new requests while ledger "
+                        "headroom is below this fraction of capacity "
+                        "(0 = off; deferred requests stay queued — "
+                        "latency, never a client error)")
     p.add_argument("--affinity", action="store_true",
                    help="cache-affinity routing: sticky rendezvous-hash a "
                         "session key (X-Session header, else hashed prompt "
@@ -273,6 +287,9 @@ def main() -> None:
         decode_state_cache=not args.no_decode_state_cache,
         guard_nonfinite=not args.no_numeric_guard,
         guard_token_storm=args.guard_token_storm,
+        memory_ledger=not args.no_memory_ledger,
+        hbm_budget_bytes=args.hbm_budget_bytes,
+        admit_min_headroom_frac=args.admit_min_headroom_frac,
     )
     if args.replicas > 1:
         from dlti_tpu.serving import ReplicatedEngine

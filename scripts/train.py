@@ -232,6 +232,16 @@ def parse_args():
                         "fraction, per-phase steplog fields, or stitched "
                         "elastic ledger — every site drops to one "
                         "attribute read")
+    p.add_argument("--no-memory-ledger", action="store_true",
+                   help="disable the HBM memory ledger "
+                        "(telemetry.memledger): no per-owner attribution, "
+                        "hbm_* steplog fields, or memory.json in flight "
+                        "dumps")
+    p.add_argument("--hbm-budget-bytes", type=int, default=0,
+                   help="HBM capacity for headroom accounting (0 = "
+                        "auto-detect from device memory_stats(); stays "
+                        "unknown on CPU, keeping the hbm_pressure rule "
+                        "and headroom fields off)")
     return p.parse_args()
 
 
@@ -398,6 +408,8 @@ def build_config(args):
             step_log_path=args.step_log,
             heartbeat_interval_steps=args.heartbeat_interval,
             goodput_ledger=not args.no_goodput_ledger,
+            memory_ledger=not args.no_memory_ledger,
+            hbm_budget_bytes=args.hbm_budget_bytes,
             watchdog=WatchdogConfig(
                 enabled=args.watchdog,
                 action=args.watchdog_action,

@@ -197,7 +197,7 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "hung_step", "throughput_collapse", "queue_buildup",
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
-        "goodput_collapse",
+        "goodput_collapse", "hbm_pressure",
     )
 
 
@@ -287,6 +287,44 @@ def test_ledger_metric_names_are_schema_stable():
     )
 
 
+def test_memledger_metric_names_are_schema_stable():
+    """HBM memory-ledger names are a scrape contract like the
+    watchdog/ckpt sets: the per-owner bytes gauge (label owner=...) plus
+    the peak / headroom / untracked gauges, all registered by the server
+    registry; the owner set is the attribution-label contract
+    (dashboards and scripts/memory_plan.py key on it)."""
+    from dlti_tpu.telemetry import memledger
+
+    assert memledger.MEMLEDGER_METRIC_NAMES == (
+        "dlti_hbm_bytes",
+        "dlti_hbm_peak_bytes",
+        "dlti_hbm_headroom_bytes",
+        "dlti_hbm_untracked_bytes",
+    )
+    assert memledger.hbm_bytes_gauge.name == \
+        memledger.MEMLEDGER_METRIC_NAMES[0]
+    assert memledger.hbm_peak_gauge.name == \
+        memledger.MEMLEDGER_METRIC_NAMES[1]
+    assert memledger.hbm_headroom_gauge.name == \
+        memledger.MEMLEDGER_METRIC_NAMES[2]
+    assert memledger.hbm_untracked_gauge.name == \
+        memledger.MEMLEDGER_METRIC_NAMES[3]
+    assert memledger.MEMORY_OWNERS == (
+        "params", "optimizer_state", "grad_buffers", "kv_block_pool",
+        "prefix_cache_hbm", "decode_state_cache", "prefetch_buffers",
+        "chaos_balloon",
+    )
+
+
+def test_steplog_hbm_fields_are_schema_stable():
+    """The per-step JSONL stream's memory pair (what an OOM incident
+    reader greps first) is part of the step-record contract."""
+    from dlti_tpu.telemetry.steplog import STEP_RECORD_FIELDS
+
+    assert {"hbm_bytes_in_use", "hbm_headroom_bytes"} <= set(
+        STEP_RECORD_FIELDS)
+
+
 def test_heartbeat_metric_names_are_schema_stable():
     """The per-rank last-step and straggler-lag gauges are a scrape
     contract (dashboards plot which rank trails by how much)."""
@@ -329,7 +367,7 @@ def test_debug_vars_and_dump_surface_contract():
     assert {"now", "interval_s", "capacity", "num_samples",
             "source_errors", "latest", "samples"} <= set(snap)
     assert DUMP_FILES == ("context.json", "spans.json", "metrics.json",
-                          "timeseries.json", "config.json")
+                          "timeseries.json", "config.json", "memory.json")
     assert MANIFEST == "MANIFEST.json"
 
 
@@ -359,6 +397,9 @@ def test_load_report_schema_includes_gateway_fields():
         # Goodput-ledger era: server-reported critical-path phase means,
         # overall and decomposed cold-vs-warm (TTFT by phase).
         "phase_means", "cold_phases", "warm_phases",
+        # Memory-ledger era: end-of-run /debug/memory scrape (owner
+        # attribution + headroom).
+        "memory",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -42,6 +42,7 @@ def test_pinned_name_tuples_follow_convention():
         REQUEST_PHASE_METRIC_NAMES, WATCHDOG_METRIC_NAMES,
     )
     from dlti_tpu.telemetry.heartbeat import HEARTBEAT_METRIC_NAMES
+    from dlti_tpu.telemetry.memledger import MEMLEDGER_METRIC_NAMES
     from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
     from dlti_tpu.training.sentinel import (
         SDC_METRIC_NAMES, SENTINEL_METRIC_NAMES,
@@ -58,13 +59,14 @@ def test_pinned_name_tuples_follow_convention():
                        (SDC_METRIC_NAMES, "sdc"),
                        (LEDGER_METRIC_NAMES, "ledger"),
                        (REQUEST_PHASE_METRIC_NAMES, "request_phase"),
+                       (MEMLEDGER_METRIC_NAMES, "memledger"),
                        (HEARTBEAT_METRIC_NAMES, "heartbeat")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
-    from dlti_tpu.telemetry import flightrecorder, ledger, watchdog
+    from dlti_tpu.telemetry import flightrecorder, ledger, memledger, watchdog
     from dlti_tpu.training import elastic, sentinel
 
     objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
@@ -77,7 +79,9 @@ def test_module_level_metric_objects_follow_convention():
             sentinel.sdc_probes_total, sentinel.sdc_mismatches_total,
             ledger.goodput_fraction_gauge, ledger.goodput_seconds_total,
             ledger.goodput_mfu_gauge, ledger.phase_seconds_total,
-            ledger.phase_requests_total)
+            ledger.phase_requests_total,
+            memledger.hbm_bytes_gauge, memledger.hbm_peak_gauge,
+            memledger.hbm_headroom_gauge, memledger.hbm_untracked_gauge)
     _assert_convention([m.name for m in objs], "module-level metrics")
 
 
@@ -144,6 +148,8 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_goodput_fraction",
                      "dlti_goodput_seconds_total",
                      "dlti_request_phase_seconds_total",
+                     "dlti_hbm_bytes",
+                     "dlti_hbm_headroom_bytes",
                      "dlti_heartbeat_lag_steps"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")
