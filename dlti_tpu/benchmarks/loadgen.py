@@ -78,6 +78,14 @@ class LoadGenConfig:
     sessions: int = 0
     turns: int = 4
     reuse_frac: float = 1.0
+    # Mixed-interference workload: this fraction of requests (seeded draw)
+    # carries a synthetic long prompt of ~long_prompt_tokens tokens instead
+    # of the normal prompt — the disaggregation stressor. The report then
+    # splits short-request decode TPOT by whether a long prefill was
+    # concurrently in flight (the `interference` section) and adds
+    # "long_prompt"/"short_prompt" per_class entries. 0.0 = off.
+    long_prompt_frac: float = 0.0
+    long_prompt_tokens: int = 512
 
 
 @dataclass
@@ -96,6 +104,9 @@ class RequestRecord:
     # session) vs a cold first-touch prompt.
     session: str = ""
     warm: bool = False
+    # Mixed-interference mode: this request carried the synthetic long
+    # prompt (its prefill is the interference source, not a victim).
+    long: bool = False
     # Server-side critical-path breakdown (the response's "phases"
     # object: gateway queue, engine queue, tier restore, prefill,
     # failover, decode — telemetry.ledger); empty when the server
@@ -162,6 +173,12 @@ class LoadReport:
     warm_ttft_p50_s: float = 0.0
     warm_ttft_p90_s: float = 0.0
     cache_hit_rate: float = 0.0
+    # Mixed-interference mode (long_prompt_frac > 0): decode-TPOT p99 of
+    # SHORT requests split by whether a long prompt's prefill window
+    # overlapped their decode window — the prefill→decode interference a
+    # disaggregated server is supposed to remove. Empty when the mode is
+    # off or one side has no samples.
+    interference: dict = field(default_factory=dict)
     # Critical-path decomposition (goodput-ledger era): mean seconds per
     # server-reported phase (gateway queue, engine queue, tier restore,
     # prefill, failover, decode) over all ok requests, and the cold vs
@@ -448,11 +465,25 @@ def parse_priority_mix(spec: str) -> List[Tuple[str, float]]:
     return out
 
 
+def _long_prompt(cfg: LoadGenConfig, idx: int) -> str:
+    """Synthetic long-document prompt sized to ~long_prompt_tokens tokens
+    (exact under the byte tokenizer: one char per token). A per-request
+    prefix keeps prompts distinct so a prefix cache can't collapse the
+    prefill work the interference measurement depends on."""
+    filler = f"[doc {idx}] long document segment under summarization. "
+    n = max(1, cfg.long_prompt_tokens)
+    return (filler * (n // len(filler) + 1))[:n]
+
+
 def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
                 mix: List[Tuple[str, float]],
-                ) -> Tuple[str, dict, dict, str, str]:
-    """-> (path, body, extra_headers, tenant, priority) for request idx."""
-    prompt = rng.choice(cfg.prompts) if cfg.prompts else cfg.prompt
+                ) -> Tuple[str, dict, dict, str, str, bool]:
+    """-> (path, body, extra_headers, tenant, priority, long) for request
+    idx."""
+    long = (cfg.long_prompt_frac > 0
+            and rng.random() < cfg.long_prompt_frac)
+    prompt = (_long_prompt(cfg, idx) if long
+              else rng.choice(cfg.prompts) if cfg.prompts else cfg.prompt)
     if cfg.chat:
         path = "/v1/chat/completions"
         body = {"messages": [{"role": "user", "content": prompt}]}
@@ -472,7 +503,7 @@ def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
         body["priority"] = priority
     if cfg.deadline_s and cfg.deadline_s > 0:
         body["deadline_s"] = cfg.deadline_s
-    return path, body, headers, tenant, priority
+    return path, body, headers, tenant, priority, long
 
 
 def _phase_means(recs: List[RequestRecord]) -> dict:
@@ -511,6 +542,39 @@ def _class_summary(recs: List[RequestRecord]) -> dict:
         "ttft_p99_s": round(_percentile(ttfts, 99), 4),
         "tpot_mean_ms": (round(sum(tpots_ms) / len(tpots_ms), 2)
                          if tpots_ms else 0.0),
+        "tpot_p99_ms": round(_percentile(tpots_ms, 99), 2),
+    }
+
+
+def _interference_summary(recs: List[RequestRecord]) -> dict:
+    """Decode-TPOT p99 of short requests, split by whether any long
+    request's prefill window [start, first_token] overlapped their decode
+    window [first_token, end]. The victim metric of prefill→decode
+    interference: a colocated engine's long chunks steal decode steps
+    from co-resident slots; a disaggregated one's don't."""
+    longs = [r for r in recs if r.long and r.ok and r.first_token is not None]
+    shorts = [r for r in recs if not r.long and r.ok
+              and r.first_token is not None and r.output_tokens > 1]
+    if not longs or not shorts:
+        return {}
+    windows = [(r.start, r.first_token) for r in longs]
+    with_ms: List[float] = []
+    without_ms: List[float] = []
+    for r in shorts:
+        tpot = (r.end - r.first_token) / (r.output_tokens - 1) * 1000
+        overlapped = any(ws < r.end and we > r.first_token
+                         for ws, we in windows)
+        (with_ms if overlapped else without_ms).append(tpot)
+    return {
+        "num_long": len(longs),
+        "num_with_long_prefill": len(with_ms),
+        "num_without_long_prefill": len(without_ms),
+        "tpot_p99_with_long_prefill_ms": round(_percentile(with_ms, 99), 2),
+        "tpot_p99_without_long_prefill_ms":
+            round(_percentile(without_ms, 99), 2),
+        "tpot_p50_with_long_prefill_ms": round(_percentile(with_ms, 50), 2),
+        "tpot_p50_without_long_prefill_ms":
+            round(_percentile(without_ms, 50), 2),
     }
 
 
@@ -536,10 +600,10 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
 
     async def one(idx: int) -> None:
         async with sem:
-            path, body, headers, tenant, priority = _build_body(
+            path, body, headers, tenant, priority, long = _build_body(
                 cfg, rng, idx, mix)
             rec = RequestRecord(start=time.monotonic(), tenant=tenant,
-                                priority=priority)
+                                priority=priority, long=long)
             records.append(rec)
             await _http_post_sse(cfg.host, cfg.port, path, body, rec,
                                  cfg.timeout_s, extra_headers=headers)
@@ -643,6 +707,11 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         for cls in {m[0] for m in mix}:
             per_class[cls] = _class_summary(
                 [r for r in records if r.priority == cls])
+    if cfg.long_prompt_frac > 0:
+        per_class["long_prompt"] = _class_summary(
+            [r for r in records if r.long])
+        per_class["short_prompt"] = _class_summary(
+            [r for r in records if not r.long])
     cold = [r for r in ok if not r.warm]
     warm = [r for r in ok if r.warm]
     cold_ttfts = [r.ttft for r in cold if r.ttft is not None]
@@ -679,6 +748,8 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         warm_ttft_p50_s=round(_percentile(warm_ttfts, 50), 4),
         warm_ttft_p90_s=round(_percentile(warm_ttfts, 90), 4),
         cache_hit_rate=cache_hit_rate,
+        interference=(_interference_summary(records)
+                      if cfg.long_prompt_frac > 0 else {}),
         phase_means=_phase_means(ok),
         cold_phases=_phase_means(cold),
         warm_phases=_phase_means(warm),

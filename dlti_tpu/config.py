@@ -579,12 +579,38 @@ class PrefixTierConfig:
 
 
 @dataclass(frozen=True)
+class DisaggConfig:
+    """Prefill/decode disaggregation (``dlti_tpu.serving.disagg``): split
+    the replica fleet into a prefill pool and a decode pool, migrating
+    each finished prefill's paged-KV blocks to a decode replica over the
+    tier-restore path. Off by default — colocated serving is untouched."""
+
+    enabled: bool = False
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    # Per-decode-replica bound on staged handoff snapshots: a full queue
+    # backpressures the prefill pool (finished prefills stay in their
+    # slots, which shrinks gateway dispatch room) instead of growing
+    # host memory without limit.
+    handoff_queue_depth: int = 8
+    # Staged snapshots older than this re-prefill on the decode side
+    # instead of waiting for a slot (0 = wait indefinitely; the request's
+    # own gateway deadline still cancels it).
+    handoff_deadline_s: float = 0.0
+    # Deterministic chaos hook: "POOL:REPLICA:STEP[:MODE]" with POOL in
+    # ("prefill", "decode") — same STEP/MODE semantics as
+    # GatewayConfig.fault_inject_step, scoped to one pool member.
+    fault_inject_step: str = ""
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
 
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     prefix_tiers: PrefixTierConfig = field(default_factory=PrefixTierConfig)
+    disagg: DisaggConfig = field(default_factory=DisaggConfig)
 
 
 @dataclass(frozen=True)
@@ -636,6 +662,7 @@ class Config:
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
+                    "disagg",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -647,6 +674,7 @@ class Config:
                         "flight_recorder": FlightRecorderConfig,
                         "prefix_tiers": PrefixTierConfig,
                         "sentinel": SentinelConfig,
+                        "disagg": DisaggConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -16,6 +16,8 @@ from scratch for TPU:
   per-tenant rate limits, priority/deadline scheduling, graceful drain
 * :mod:`dlti_tpu.serving.replicas` — data-parallel engine replicas with
   fault isolation and retry-capped failover
+* :mod:`dlti_tpu.serving.disagg` — prefill/decode disaggregation: split
+  engine pools with paged-KV handoff and phase-aware routing
 * :mod:`dlti_tpu.serving.server` — OpenAI-compatible HTTP server
 """
 
@@ -29,6 +31,7 @@ from dlti_tpu.serving.engine import (  # noqa: F401
     Request,
 )
 from dlti_tpu.serving.replicas import ReplicatedEngine  # noqa: F401
+from dlti_tpu.serving.disagg import DisaggController  # noqa: F401
 from dlti_tpu.serving.gateway import (  # noqa: F401
     AdmissionError,
     AdmissionGateway,

@@ -281,11 +281,20 @@ class _Slot:
         return self.request is not None and self.next_pos < self.prefill_end
 
 
-class InferenceEngine:
-    """Synchronous engine core: ``submit()`` requests, ``step()`` in a loop.
+class EngineExecutor:
+    """The device half of the engine: weights, paged-KV pools, and every
+    compiled program (bucketed prefill, the decode ladder, speculative
+    decode, fused sampling, the tier-restore scatter), plus the
+    device<->host block transport (:meth:`fetch_block_kv` /
+    :meth:`restore_block`).
 
-    The HTTP server wraps this in a background thread; ``generate()`` is the
-    offline batch entry point.
+    Holds NO scheduling state — slots, queues, block accounting,
+    admission, and retirement live in :class:`InferenceEngine`, which
+    assembles host-side batches and calls in. The split is what
+    disaggregated serving (``serving.disagg``) builds on: a prefill-only
+    engine's executor never runs (or warms) the decode ladder, and
+    paged-KV handoff between pools talks to the executor's block
+    transport directly.
     """
 
     def __init__(
@@ -296,15 +305,11 @@ class InferenceEngine:
         lora_cfg: Optional[LoRAConfig] = None,
         mesh=None,
         donate_params: bool = False,
-        telemetry: Optional[RequestTelemetry] = None,
     ):
-        # Request-lifecycle telemetry (dlti_tpu.telemetry.lifecycle):
-        # TTFT/TPOT/queue-time histograms observed on-engine + per-request
-        # Chrome-trace spans. A shared instance (ReplicatedEngine) makes
-        # the histograms aggregate across replicas.
-        self.telemetry = telemetry if telemetry is not None \
-            else RequestTelemetry()
-        self._tracer = self.telemetry.tracer
+        self.cfg = engine_cfg
+        self.model_cfg = model_cfg
+        self.logger = get_logger()
+        self.mesh = mesh
         if mesh is not None:
             # Tensor-parallel serving: weights and KV pools shard over the
             # 'tensor' axis (attention heads / MLP hidden / vocab); GSPMD
@@ -322,21 +327,6 @@ class InferenceEngine:
                     f"tensor={tp} must evenly divide num_heads="
                     f"{model_cfg.num_heads} and num_kv_heads="
                     f"{model_cfg.num_kv_heads}")
-        if engine_cfg.max_blocks_per_seq > engine_cfg.num_blocks - 1:
-            # Block 0 is the reserved trash block, so only num_blocks-1 are
-            # allocatable. A config where one max-length sequence can never
-            # fit would livelock _admit() at the FCFS head forever.
-            raise ValueError(
-                f"max_model_len={engine_cfg.max_model_len} needs "
-                f"{engine_cfg.max_blocks_per_seq} KV blocks but the pool has "
-                f"only {engine_cfg.num_blocks - 1} allocatable "
-                f"(num_blocks={engine_cfg.num_blocks} minus the reserved "
-                f"trash block); raise num_blocks or lower max_model_len"
-            )
-        self.cfg = engine_cfg
-        self.model_cfg = model_cfg
-        self.logger = get_logger()
-        self.mesh = mesh
         self.model = LlamaForCausalLM(model_cfg, lora_cfg, mesh)
         self._quantized = engine_cfg.quantization == "int8"
         if engine_cfg.quantization not in ("none", "int8"):
@@ -393,58 +383,23 @@ class InferenceEngine:
             # above): a replica off the default device otherwise starts
             # with a device-0 pool that only migrates on first dispatch.
             self.cache = jax.device_put(self.cache, self._device)
-        self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
-        self.prefix_cache = None
-        self._restore_fn = None  # lazily-jitted tier-restore scatter
-        self._demote_sharding = None  # pinned_host staging (if available)
-        if ec.enable_prefix_caching:
-            from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
 
-            tier_store = None
-            if ec.prefix_host_blocks > 0 or ec.prefix_disk_blocks > 0:
-                from dlti_tpu.serving.prefix_tiers import TieredBlockStore
+        self._restore_fn = None  # lazily-jitted tier/handoff restore scatter
+        # Block fetches stage device→host through pinned_host when the
+        # backend exposes it (TPU) — the ZeRO-3 offload path; CPU's
+        # default memory space is host already. Probed unconditionally:
+        # both prefix-tier demotion and disaggregated KV handoff use it.
+        self._demote_sharding = None
+        try:
+            dev = self._device or jax.devices()[0]
+            kinds = {m.kind for m in dev.addressable_memories()}
+            if "pinned_host" in kinds:
+                from jax.sharding import SingleDeviceSharding
 
-                tier_store = TieredBlockStore(
-                    host_blocks=ec.prefix_host_blocks,
-                    disk_dir=ec.prefix_disk_dir,
-                    disk_blocks=ec.prefix_disk_blocks)
-                # Demotion fetches stage device→host through pinned_host
-                # when the backend exposes it (TPU) — the ZeRO-3 offload
-                # path; CPU's default memory space is host already.
-                try:
-                    dev = self._device or jax.devices()[0]
-                    kinds = {m.kind for m in dev.addressable_memories()}
-                    if "pinned_host" in kinds:
-                        from jax.sharding import SingleDeviceSharding
-
-                        self._demote_sharding = SingleDeviceSharding(
-                            dev, memory_kind="pinned_host")
-                except Exception:  # noqa: BLE001 — staging is an optimization
-                    self._demote_sharding = None
-            self.prefix_cache = PrefixCachingAllocator(
-                self.block_manager, tier_store=tier_store,
-                kv_fetch=self._fetch_block_kv if tier_store is not None
-                else None)
-        self.slots = [_Slot(i) for i in range(ec.max_seqs)]
-        self.waiting: collections.deque[Request] = collections.deque()
-        # Recently-finished requests, for observability only (results are
-        # returned via step()/generate()); bounded so a long-lived server
-        # doesn't grow without limit.
-        self.finished: collections.deque[Request] = collections.deque(maxlen=256)
-        self._rng = jax.random.PRNGKey(0)
-        self._req_counter = itertools.count()
-
-        # Host mirrors of the per-slot device inputs.
-        S, MB = ec.max_seqs, ec.max_blocks_per_seq
-        self._block_tables = np.zeros((S, MB), np.int32)
-        self._temperature = np.ones((S,), np.float32)
-        self._top_k = np.zeros((S,), np.int32)
-        self._top_p = np.ones((S,), np.float32)
-        # Per-slot sampling key (uint32[2] threefry data) + tokens generated
-        # so far; decode folds key with the count, so a seeded request's
-        # draws don't depend on batch composition or admission order.
-        self._slot_keys = np.zeros((S, 2), np.uint32)
-        self._gen_counts = np.zeros((S,), np.int32)
+                self._demote_sharding = SingleDeviceSharding(
+                    dev, memory_kind="pinned_host")
+        except Exception:  # noqa: BLE001 — staging is an optimization
+            self._demote_sharding = None
 
         self._prefill_fns: Dict[int, callable] = {}
         self._decode_fn = self._build_decode_fn()
@@ -462,18 +417,6 @@ class InferenceEngine:
         self._spec_fn = (
             self._build_spec_decode_fn(ec.num_draft_tokens, self._spec_rounds)
             if ec.speculative == "ngram" else None)
-        # Host mirror of every slot's token history at its context
-        # positions, maintained incrementally at admission/append — the
-        # spec program's proposal input, without rebuilding O(context)
-        # arrays from Python lists every sync. Rows beyond a slot's
-        # seq_len are never read (proposal masks on seq_len), so stale
-        # tails from previous occupants need no zeroing.
-        self._spec_hist = (
-            np.zeros((ec.max_seqs, self._spec_hist_width), np.int32)
-            if ec.speculative == "ngram" else None)
-        self._spec_pause = 0      # decode rounds left in adaptive cooldown
-        self._spec_win_prop = 0   # proposals since last gate decision
-        self._spec_win_acc = 0    # acceptances since last gate decision
         if ec.speculative not in ("none", "ngram"):
             raise ValueError(f"unknown speculative mode {ec.speculative!r}")
         self._sample_fn = jax.jit(sample_tokens)
@@ -482,82 +425,6 @@ class InferenceEngine:
         # applies to raw uint32 key data): one async dispatch instead of a
         # synchronous device round trip per admitted row.
         self._fold_keys = jax.jit(jax.vmap(jax.random.fold_in))
-
-        # Aggregate stats for the /stats endpoint and load reports.
-        self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
-                      "preemptions": 0, "decode_steps": 0,
-                      # slot x step units CONSUMED (a slot that hits
-                      # EOS/limit mid-window stops counting, even though
-                      # the device still runs its dead steps — that waste
-                      # deliberately shows up as occupancy < 100%);
-                      # decode_slot_steps / (max_seqs * decode_steps) is
-                      # the mean slot occupancy — the first thing to look
-                      # at when throughput undershoots (synchronized
-                      # cohort retirement drains slots faster than
-                      # admission refills them; results/int8_kv_7b.json).
-                      "decode_slot_steps": 0,
-                      "prefix_cached_tokens": 0,
-                      # Tokens whose KV came back from a LOWER tier (host
-                      # or disk) via a restore scatter instead of either
-                      # an HBM hit or a re-prefill. Present (at 0) even
-                      # without tiering so the /metrics schema is stable.
-                      "prefix_restored_tokens": 0,
-                      "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_paused_rounds": 0,
-                      # Decode-state cache accounting (decode_state.py):
-                      # upload syncs / rows shipped / clean (zero-upload)
-                      # syncs. Present (at 0) even with the cache disabled
-                      # so the /metrics exposition schema is stable.
-                      "decode_state_uploads": 0, "decode_state_rows": 0,
-                      "decode_state_clean_syncs": 0,
-                      # Numeric-guard trips (nonfinite decode outputs /
-                      # token storms). Present (at 0) so the /metrics
-                      # schema is stable.
-                      "numeric_faults": 0,
-                      # Headroom-aware memory control (telemetry.
-                      # memledger): admission passes skipped for want of
-                      # HBM headroom, and decode windows shrunk to one
-                      # step when KV growth found the pool exhausted —
-                      # both defer work instead of faulting. Present (at
-                      # 0) so the /metrics schema is stable.
-                      "hbm_deferred_admissions": 0,
-                      "hbm_growth_deferrals": 0}
-        # Token-storm guard run length (consecutive all-slots-identical
-        # decode steps).
-        self._storm_run = 0
-
-        # Device-resident twins of the per-slot mirrors, maintained
-        # incrementally (per-slot dirty tracking; clean steps upload
-        # nothing). All cache interaction happens on the stepper thread —
-        # same thread-safety contract as the mirrors themselves.
-        self._state_cache = None
-        if ec.decode_state_cache:
-            from dlti_tpu.serving.decode_state import DecodeStateCache
-
-            self._state_cache = DecodeStateCache(
-                ec.max_seqs, device=self._device, mesh=mesh,
-                stats=self.stats)
-
-        # Memory ledger (telemetry.memledger): the engine's owners. The
-        # params and cache handles are callables because both rebind
-        # (donated decode programs return a fresh cache list); prefix-
-        # cached blocks live INSIDE the pool arrays, so that owner is a
-        # carve — bytes move from kv_block_pool to prefix_cache_hbm
-        # without double counting.
-        self.memledger = MemoryLedger(
-            enabled=ec.memory_ledger, capacity_bytes=ec.hbm_budget_bytes)
-        self.memledger.register("params", lambda: self.params)
-        self.memledger.register("kv_block_pool", lambda: self.cache)
-        self.memledger.register(
-            "decode_state_cache",
-            lambda: (self._state_cache._dev
-                     if self._state_cache is not None else None))
-        if self.prefix_cache is not None:
-            kv_pool_bytes = tree_nbytes(self.cache)
-            per_block = kv_pool_bytes // max(1, ec.num_blocks)
-            self.memledger.register_carve(
-                "prefix_cache_hbm", "kv_block_pool",
-                lambda: self.prefix_cache.num_cached_blocks() * per_block)
 
     # ------------------------------------------------------------------
     def _shard_for_tp(self, mesh) -> None:
@@ -604,6 +471,13 @@ class InferenceEngine:
         return logits, [{k: v for k, v in c.items() if k != "block_tables"}
                         for c in new_cache]
 
+    def prefill_fn(self, bucket: int):
+        """The compiled prefill program for a suffix bucket (lazily built)."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
+        return fn
+
     def _build_prefill_fn(self, bucket: int):
         @partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache_kv, input_ids, positions, block_table,
@@ -638,49 +512,6 @@ class InferenceEngine:
             return new_kv, tokens, logprobs
 
         return decode
-
-    def _window_steps(self, active: list) -> int:
-        """Budget-clamped multi-step window (the r03 occupancy lever).
-
-        A slot that exhausts its token budget at step j of a K-step window
-        idles for K-j device steps, and uniform workloads retire whole
-        cohorts inside one window — the measured 77.7% decode occupancy at
-        the r03 headline (results/serving_7b_report.json). So never run a
-        window longer than the smallest PREDICTABLE retirement among
-        active slots (max_tokens budget or model-length room; natural EOS
-        is unpredictable and still wastes its tail). Window lengths come
-        from the halving ladder K, K//2, ..., 1 so the compile surface
-        stays ~log2(K)+1 programs instead of one per distinct remainder.
-        Side effect: near max_model_len the old batch-wide fallback to
-        k=1 becomes a right-sized window instead.
-        """
-        ec = self.cfg
-        # Length retirement fires at prompt+output >= max_model_len
-        # (_append_token), which is one step EARLIER than KV room
-        # (output leads seq_len by one at dispatch): remaining decode
-        # steps until a length stop = max_model_len - (prompt + output).
-        min_rem = min(
-            min(s.request.params.max_tokens - len(s.request.output_token_ids),
-                ec.max_model_len - len(s.request.prompt_token_ids)
-                - len(s.request.output_token_ids))
-            for s in active)
-        # Round UP to the ladder: the smallest ladder length >= min_rem.
-        # Rounding down would fragment a 63-step tail into 32+16+8+4+2+1 —
-        # five extra host syncs (~0.5 s each on a relay link) to save a
-        # handful of dead device steps (~11 ms each). Round-up keeps one
-        # window with < k/2 dead steps, and still lands exact fits
-        # (min_rem a ladder value) at 100% occupancy.
-        k = ec.steps_per_sync
-        while k > 1 and k // 2 >= min_rem:
-            k //= 2
-        # ...but NEVER past hard KV room: dead steps past a budget stop are
-        # merely discarded samples, while steps past max_model_len would
-        # grow a slot's block table beyond max_blocks_per_seq (an
-        # out-of-bounds block-table write). Round DOWN under the room cap.
-        min_room = min(ec.max_model_len - s.seq_len for s in active)
-        while k > 1 and k > min_room:
-            k //= 2
-        return k
 
     @staticmethod
     def _aot_or_jit(compiled, jit_fn):
@@ -718,65 +549,6 @@ class InferenceEngine:
         call._aot_state = state  # test hook: did dispatch stay on the AOT path?
         call._jit_fn = jit_fn    # warmup idempotency: the lowerable fn
         return call
-
-    def warmup_decode_ladder(self) -> None:
-        """Pre-compile the decode programs (single-step + every multi-step
-        halving-ladder length) BEFORE traffic: a window length's first use
-        otherwise stalls the live decode loop on an XLA compile at an
-        unpredictable moment. AOT-lowers on abstract shapes (donation only
-        consumes avals here — no scratch KV pool is materialized), then
-        KEEPS the compiled executables and swaps them into the dispatch
-        path: relying on the persistent compilation cache alone silently
-        does nothing when the cache is disabled (DLTI_NO_COMPILE_CACHE=1)
-        or the compile finishes under its min-compile-time floor (r04
-        advisor finding)."""
-        def avals(tree):
-            # Carry each leaf's ACTUAL sharding: a ReplicatedEngine pins
-            # every replica's params/KV to its own device, and an aval
-            # without it lowers for the default device — an executable
-            # replica 1 can only reject at dispatch time. Host-mirror
-            # args (ids/positions/tables/keys) stay plain avals: they
-            # arrive uncommitted and follow the committed operands.
-            return jax.tree_util.tree_map(
-                lambda v: jax.ShapeDtypeStruct(
-                    v.shape, v.dtype,
-                    sharding=getattr(v, "sharding", None)), tree)
-
-        S = self.cfg.max_seqs
-        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
-        if self._state_cache is not None:
-            # The decode-state cache feeds COMMITTED device arrays into
-            # the compiled programs; lower with their actual shardings so
-            # the AOT executables accept them (same reason params/cache
-            # carry theirs). Syncing here is correct at any time — it just
-            # brings the resident copies up to date with the mirrors.
-            state_avals = avals(self._state_cache.sync(
-                self._state_mirrors(), self._masked_rows()))
-        else:
-            state_avals = (
-                jax.ShapeDtypeStruct(self._block_tables.shape, i32),
-                jax.ShapeDtypeStruct((S, 2), u32),
-                jax.ShapeDtypeStruct((S,), i32),
-                jax.ShapeDtypeStruct((S,), f32),
-                jax.ShapeDtypeStruct((S,), i32),
-                jax.ShapeDtypeStruct((S,), f32))
-        args = (avals(self.params), avals(self.cache),
-                jax.ShapeDtypeStruct((S, 1), i32),
-                jax.ShapeDtypeStruct((S, 1), i32),
-                *state_avals)
-        # Idempotent: a re-warm unwraps back to the raw jit fn (the
-        # _aot_or_jit wrapper has no .lower) and rebuilds the executable.
-        raw = getattr(self._decode_fn, "_jit_fn", self._decode_fn)
-        self._decode_fn = self._aot_or_jit(raw.lower(*args).compile(), raw)
-        k = self.cfg.steps_per_sync
-        while k > 1:
-            fn = self._multi_decode_fns.get(k)
-            if fn is None:
-                fn = self._build_multi_decode_fn(k)
-            raw = getattr(fn, "_jit_fn", fn)
-            self._multi_decode_fns[k] = self._aot_or_jit(
-                raw.lower(*args).compile(), raw)
-            k //= 2
 
     def _build_multi_decode_fn(self, num_steps: int):
         """K decode iterations in one program: the sampled token feeds the
@@ -911,6 +683,425 @@ class InferenceEngine:
 
         return spec_decode
 
+    # -- paged-KV block transport (tier demotion + disagg handoff) -----
+    def fetch_block_kv(self, block: int):
+        """One physical block's KV rows from every layer pool, fetched
+        device→host — the prefix-tier demotion path, reused verbatim as
+        the disaggregated-serving handoff transport. Runs on the stepper
+        thread; ``self.cache`` then holds the committed output of the
+        last dispatched program, so the read sees every write the block
+        ever received. Payload keys follow the disk format
+        ("l00000": {"k": ..., "v": ..., int8 scales if present})."""
+        try:
+            rows = [{name: arr[block] for name, arr in layer.items()}
+                    for layer in self.cache]
+            if self._demote_sharding is not None:
+                # Stage through pinned_host: the D2H DMA lands in pinned
+                # memory the host reads without a bounce (TPU path).
+                rows = jax.device_put(rows, self._demote_sharding)
+            host = jax.device_get(rows)
+        except Exception as e:  # noqa: BLE001 — the fetch is best-effort:
+            # a failure degrades to discard (demotion) or re-prefill
+            # (handoff), never faults the step loop that triggered it.
+            self.logger.warning("block KV fetch failed "
+                                "(%s: %s); block discarded",
+                                type(e).__name__, e)
+            return None
+        return {f"l{i:05d}": {k: np.asarray(v) for k, v in r.items()}
+                for i, r in enumerate(host)}
+
+    def restore_block(self, block: int, payload: dict) -> None:
+        """Scatter a fetched payload into physical ``block`` of every
+        layer pool. Dispatch is async (jit): the scatter overlaps host-side
+        admission work, and the following prefill/decode programs see the
+        restored rows through the ``self.cache`` data dependency."""
+        if self._restore_fn is None:
+            @partial(jax.jit, donate_argnums=(0,))
+            def restore(cache_kv, rows, bid):
+                return [
+                    {k: v.at[bid].set(r[k].astype(v.dtype)) for k, v in
+                     layer.items()}
+                    for layer, r in zip(cache_kv, rows)
+                ]
+
+            self._restore_fn = restore
+        rows = [payload[f"l{i:05d}"] for i in range(len(self.cache))]
+        self.cache = self._restore_fn(self.cache, rows,
+                                      jnp.asarray(block, jnp.int32))
+
+
+class InferenceEngine:
+    """Synchronous engine core: ``submit()`` requests, ``step()`` in a loop.
+
+    The HTTP server wraps this in a background thread; ``generate()`` is the
+    offline batch entry point.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params,
+        engine_cfg: EngineConfig = EngineConfig(),
+        lora_cfg: Optional[LoRAConfig] = None,
+        mesh=None,
+        donate_params: bool = False,
+        telemetry: Optional[RequestTelemetry] = None,
+    ):
+        # Request-lifecycle telemetry (dlti_tpu.telemetry.lifecycle):
+        # TTFT/TPOT/queue-time histograms observed on-engine + per-request
+        # Chrome-trace spans. A shared instance (ReplicatedEngine) makes
+        # the histograms aggregate across replicas.
+        self.telemetry = telemetry if telemetry is not None \
+            else RequestTelemetry()
+        self._tracer = self.telemetry.tracer
+        if engine_cfg.max_blocks_per_seq > engine_cfg.num_blocks - 1:
+            # Block 0 is the reserved trash block, so only num_blocks-1 are
+            # allocatable. A config where one max-length sequence can never
+            # fit would livelock _admit() at the FCFS head forever.
+            raise ValueError(
+                f"max_model_len={engine_cfg.max_model_len} needs "
+                f"{engine_cfg.max_blocks_per_seq} KV blocks but the pool has "
+                f"only {engine_cfg.num_blocks - 1} allocatable "
+                f"(num_blocks={engine_cfg.num_blocks} minus the reserved "
+                f"trash block); raise num_blocks or lower max_model_len"
+            )
+        self.cfg = engine_cfg
+        self.model_cfg = model_cfg
+        self.logger = get_logger()
+        self.mesh = mesh
+        # The device half (scheduler/executor split): weights, KV pools,
+        # and every compiled program live in the executor; this class
+        # keeps ONLY host-side scheduling state (slots, queues, block
+        # accounting, mirrors) and calls in with assembled batches.
+        self.executor = EngineExecutor(
+            model_cfg, params, engine_cfg, lora_cfg, mesh=mesh,
+            donate_params=donate_params)
+        del params  # the executor owns (a possibly quantized copy of) them
+        ec = engine_cfg
+        self.block_manager = BlockManager(ec.num_blocks, ec.block_size)
+        self.prefix_cache = None
+        if ec.enable_prefix_caching:
+            from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
+
+            tier_store = None
+            if ec.prefix_host_blocks > 0 or ec.prefix_disk_blocks > 0:
+                from dlti_tpu.serving.prefix_tiers import TieredBlockStore
+
+                tier_store = TieredBlockStore(
+                    host_blocks=ec.prefix_host_blocks,
+                    disk_dir=ec.prefix_disk_dir,
+                    disk_blocks=ec.prefix_disk_blocks)
+            self.prefix_cache = PrefixCachingAllocator(
+                self.block_manager, tier_store=tier_store,
+                kv_fetch=self._fetch_block_kv if tier_store is not None
+                else None)
+        self.slots = [_Slot(i) for i in range(ec.max_seqs)]
+        self.waiting: collections.deque[Request] = collections.deque()
+        # Recently-finished requests, for observability only (results are
+        # returned via step()/generate()); bounded so a long-lived server
+        # doesn't grow without limit.
+        self.finished: collections.deque[Request] = collections.deque(maxlen=256)
+        self._rng = jax.random.PRNGKey(0)
+        self._req_counter = itertools.count()
+
+        # Host mirrors of the per-slot device inputs.
+        S, MB = ec.max_seqs, ec.max_blocks_per_seq
+        self._block_tables = np.zeros((S, MB), np.int32)
+        self._temperature = np.ones((S,), np.float32)
+        self._top_k = np.zeros((S,), np.int32)
+        self._top_p = np.ones((S,), np.float32)
+        # Per-slot sampling key (uint32[2] threefry data) + tokens generated
+        # so far; decode folds key with the count, so a seeded request's
+        # draws don't depend on batch composition or admission order.
+        self._slot_keys = np.zeros((S, 2), np.uint32)
+        self._gen_counts = np.zeros((S,), np.int32)
+
+        # Host mirror of every slot's token history at its context
+        # positions, maintained incrementally at admission/append — the
+        # spec program's proposal input, without rebuilding O(context)
+        # arrays from Python lists every sync. Rows beyond a slot's
+        # seq_len are never read (proposal masks on seq_len), so stale
+        # tails from previous occupants need no zeroing.
+        self._spec_hist = (
+            np.zeros((ec.max_seqs, self._spec_hist_width), np.int32)
+            if ec.speculative == "ngram" else None)
+        self._spec_pause = 0      # decode rounds left in adaptive cooldown
+        self._spec_win_prop = 0   # proposals since last gate decision
+        self._spec_win_acc = 0    # acceptances since last gate decision
+
+        # Disaggregated serving (serving/disagg.py): a prefill-only engine
+        # runs admission and chunked prefill but never dispatches decode —
+        # finished prefills are harvested via export_handoff() and their
+        # KV migrated to a decode replica, which continues the stream via
+        # adopt_handoff(). Plain engines leave this False.
+        self.prefill_only = False
+
+        # Aggregate stats for the /stats endpoint and load reports.
+        self.stats = {"requests": 0, "generated_tokens": 0, "prefill_tokens": 0,
+                      "preemptions": 0, "decode_steps": 0,
+                      # slot x step units CONSUMED (a slot that hits
+                      # EOS/limit mid-window stops counting, even though
+                      # the device still runs its dead steps — that waste
+                      # deliberately shows up as occupancy < 100%);
+                      # decode_slot_steps / (max_seqs * decode_steps) is
+                      # the mean slot occupancy — the first thing to look
+                      # at when throughput undershoots (synchronized
+                      # cohort retirement drains slots faster than
+                      # admission refills them; results/int8_kv_7b.json).
+                      "decode_slot_steps": 0,
+                      "prefix_cached_tokens": 0,
+                      # Tokens whose KV came back from a LOWER tier (host
+                      # or disk) via a restore scatter instead of either
+                      # an HBM hit or a re-prefill. Present (at 0) even
+                      # without tiering so the /metrics schema is stable.
+                      "prefix_restored_tokens": 0,
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_paused_rounds": 0,
+                      # Decode-state cache accounting (decode_state.py):
+                      # upload syncs / rows shipped / clean (zero-upload)
+                      # syncs. Present (at 0) even with the cache disabled
+                      # so the /metrics exposition schema is stable.
+                      "decode_state_uploads": 0, "decode_state_rows": 0,
+                      "decode_state_clean_syncs": 0,
+                      # Numeric-guard trips (nonfinite decode outputs /
+                      # token storms). Present (at 0) so the /metrics
+                      # schema is stable.
+                      "numeric_faults": 0,
+                      # Headroom-aware memory control (telemetry.
+                      # memledger): admission passes skipped for want of
+                      # HBM headroom, and decode windows shrunk to one
+                      # step when KV growth found the pool exhausted —
+                      # both defer work instead of faulting. Present (at
+                      # 0) so the /metrics schema is stable.
+                      "hbm_deferred_admissions": 0,
+                      "hbm_growth_deferrals": 0}
+        # Token-storm guard run length (consecutive all-slots-identical
+        # decode steps).
+        self._storm_run = 0
+
+        # Device-resident twins of the per-slot mirrors, maintained
+        # incrementally (per-slot dirty tracking; clean steps upload
+        # nothing). All cache interaction happens on the stepper thread —
+        # same thread-safety contract as the mirrors themselves.
+        self._state_cache = None
+        if ec.decode_state_cache:
+            from dlti_tpu.serving.decode_state import DecodeStateCache
+
+            self._state_cache = DecodeStateCache(
+                ec.max_seqs, device=self._device, mesh=mesh,
+                stats=self.stats)
+
+        # Memory ledger (telemetry.memledger): the engine's owners. The
+        # params and cache handles are callables because both rebind
+        # (donated decode programs return a fresh cache list); prefix-
+        # cached blocks live INSIDE the pool arrays, so that owner is a
+        # carve — bytes move from kv_block_pool to prefix_cache_hbm
+        # without double counting.
+        self.memledger = MemoryLedger(
+            enabled=ec.memory_ledger, capacity_bytes=ec.hbm_budget_bytes)
+        self.memledger.register("params", lambda: self.params)
+        self.memledger.register("kv_block_pool", lambda: self.cache)
+        self.memledger.register(
+            "decode_state_cache",
+            lambda: (self._state_cache._dev
+                     if self._state_cache is not None else None))
+        if self.prefix_cache is not None:
+            kv_pool_bytes = tree_nbytes(self.cache)
+            per_block = kv_pool_bytes // max(1, ec.num_blocks)
+            self.memledger.register_carve(
+                "prefix_cache_hbm", "kv_block_pool",
+                lambda: self.prefix_cache.num_cached_blocks() * per_block)
+
+    # ------------------------------------------------------------------
+    # Executor delegation: scheduler code (and external callers — tests,
+    # replicas' NaN-poison fault injection, the memledger owner lambdas)
+    # keep addressing device state through the engine; the attributes
+    # live on the executor since the scheduler/executor split.
+    # ------------------------------------------------------------------
+    @property
+    def params(self):
+        return self.executor.params
+
+    @params.setter
+    def params(self, value):
+        self.executor.params = value
+
+    @property
+    def cache(self):
+        return self.executor.cache
+
+    @cache.setter
+    def cache(self, value):
+        self.executor.cache = value
+
+    @property
+    def model(self):
+        return self.executor.model
+
+    @property
+    def _device(self):
+        return self.executor._device
+
+    @property
+    def _demote_sharding(self):
+        return self.executor._demote_sharding
+
+    @property
+    def _quantized(self):
+        return self.executor._quantized
+
+    @property
+    def _prefill_fns(self):
+        return self.executor._prefill_fns
+
+    @property
+    def _decode_fn(self):
+        return self.executor._decode_fn
+
+    @_decode_fn.setter
+    def _decode_fn(self, value):
+        self.executor._decode_fn = value
+
+    @property
+    def _multi_decode_fns(self):
+        return self.executor._multi_decode_fns
+
+    @property
+    def _spec_fn(self):
+        return self.executor._spec_fn
+
+    @property
+    def _spec_rounds(self):
+        return self.executor._spec_rounds
+
+    @property
+    def _spec_hist_width(self):
+        return self.executor._spec_hist_width
+
+    @property
+    def _sample_fn(self):
+        return self.executor._sample_fn
+
+    @property
+    def _fold_keys(self):
+        return self.executor._fold_keys
+
+    def _build_prefill_fn(self, bucket: int):
+        return self.executor._build_prefill_fn(bucket)
+
+    def _build_multi_decode_fn(self, num_steps: int):
+        return self.executor._build_multi_decode_fn(num_steps)
+
+    def _fetch_block_kv(self, block: int):
+        return self.executor.fetch_block_kv(block)
+
+    def _restore_block(self, block: int, payload: dict) -> None:
+        self.executor.restore_block(block, payload)
+
+    _aot_or_jit = staticmethod(EngineExecutor._aot_or_jit)
+
+    def _window_steps(self, active: list) -> int:
+        """Budget-clamped multi-step window (the r03 occupancy lever).
+
+        A slot that exhausts its token budget at step j of a K-step window
+        idles for K-j device steps, and uniform workloads retire whole
+        cohorts inside one window — the measured 77.7% decode occupancy at
+        the r03 headline (results/serving_7b_report.json). So never run a
+        window longer than the smallest PREDICTABLE retirement among
+        active slots (max_tokens budget or model-length room; natural EOS
+        is unpredictable and still wastes its tail). Window lengths come
+        from the halving ladder K, K//2, ..., 1 so the compile surface
+        stays ~log2(K)+1 programs instead of one per distinct remainder.
+        Side effect: near max_model_len the old batch-wide fallback to
+        k=1 becomes a right-sized window instead.
+        """
+        ec = self.cfg
+        # Length retirement fires at prompt+output >= max_model_len
+        # (_append_token), which is one step EARLIER than KV room
+        # (output leads seq_len by one at dispatch): remaining decode
+        # steps until a length stop = max_model_len - (prompt + output).
+        min_rem = min(
+            min(s.request.params.max_tokens - len(s.request.output_token_ids),
+                ec.max_model_len - len(s.request.prompt_token_ids)
+                - len(s.request.output_token_ids))
+            for s in active)
+        # Round UP to the ladder: the smallest ladder length >= min_rem.
+        # Rounding down would fragment a 63-step tail into 32+16+8+4+2+1 —
+        # five extra host syncs (~0.5 s each on a relay link) to save a
+        # handful of dead device steps (~11 ms each). Round-up keeps one
+        # window with < k/2 dead steps, and still lands exact fits
+        # (min_rem a ladder value) at 100% occupancy.
+        k = ec.steps_per_sync
+        while k > 1 and k // 2 >= min_rem:
+            k //= 2
+        # ...but NEVER past hard KV room: dead steps past a budget stop are
+        # merely discarded samples, while steps past max_model_len would
+        # grow a slot's block table beyond max_blocks_per_seq (an
+        # out-of-bounds block-table write). Round DOWN under the room cap.
+        min_room = min(ec.max_model_len - s.seq_len for s in active)
+        while k > 1 and k > min_room:
+            k //= 2
+        return k
+
+    def warmup_decode_ladder(self) -> None:
+        """Pre-compile the decode programs (single-step + every multi-step
+        halving-ladder length) BEFORE traffic: a window length's first use
+        otherwise stalls the live decode loop on an XLA compile at an
+        unpredictable moment. AOT-lowers on abstract shapes (donation only
+        consumes avals here — no scratch KV pool is materialized), then
+        KEEPS the compiled executables and swaps them into the dispatch
+        path: relying on the persistent compilation cache alone silently
+        does nothing when the cache is disabled (DLTI_NO_COMPILE_CACHE=1)
+        or the compile finishes under its min-compile-time floor (r04
+        advisor finding)."""
+        def avals(tree):
+            # Carry each leaf's ACTUAL sharding: a ReplicatedEngine pins
+            # every replica's params/KV to its own device, and an aval
+            # without it lowers for the default device — an executable
+            # replica 1 can only reject at dispatch time. Host-mirror
+            # args (ids/positions/tables/keys) stay plain avals: they
+            # arrive uncommitted and follow the committed operands.
+            return jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=getattr(v, "sharding", None)), tree)
+
+        S = self.cfg.max_seqs
+        i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+        if self._state_cache is not None:
+            # The decode-state cache feeds COMMITTED device arrays into
+            # the compiled programs; lower with their actual shardings so
+            # the AOT executables accept them (same reason params/cache
+            # carry theirs). Syncing here is correct at any time — it just
+            # brings the resident copies up to date with the mirrors.
+            state_avals = avals(self._state_cache.sync(
+                self._state_mirrors(), self._masked_rows()))
+        else:
+            state_avals = (
+                jax.ShapeDtypeStruct(self._block_tables.shape, i32),
+                jax.ShapeDtypeStruct((S, 2), u32),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S,), f32),
+                jax.ShapeDtypeStruct((S,), i32),
+                jax.ShapeDtypeStruct((S,), f32))
+        args = (avals(self.params), avals(self.cache),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                jax.ShapeDtypeStruct((S, 1), i32),
+                *state_avals)
+        # Idempotent: a re-warm unwraps back to the raw jit fn (the
+        # _aot_or_jit wrapper has no .lower) and rebuilds the executable.
+        raw = getattr(self._decode_fn, "_jit_fn", self._decode_fn)
+        self._decode_fn = self._aot_or_jit(raw.lower(*args).compile(), raw)
+        k = self.cfg.steps_per_sync
+        while k > 1:
+            fn = self._multi_decode_fns.get(k)
+            if fn is None:
+                fn = self._build_multi_decode_fn(k)
+            raw = getattr(fn, "_jit_fn", fn)
+            self._multi_decode_fns[k] = self._aot_or_jit(
+                raw.lower(*args).compile(), raw)
+            k //= 2
+
     def _bucket_for(self, n: int) -> int:
         for b in self.cfg.buckets():
             if n <= b:
@@ -1011,7 +1202,8 @@ class InferenceEngine:
         tr = self._tracer
         try:
             pending = None
-            if any(not s.free and not s.prefilling for s in self.slots):
+            if not self.prefill_only and any(
+                    not s.free and not s.prefilling for s in self.slots):
                 with tr.span("engine/decode_dispatch", cat="engine"):
                     pending = self._decode_dispatch()
             with tr.span("engine/admit", cat="engine"):
@@ -1042,51 +1234,6 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             return self.prefix_cache.allocate(n)
         return self.block_manager.allocate(n)
-
-    # -- prefix-cache tiering (demote / restore) -----------------------
-    def _fetch_block_kv(self, block: int):
-        """One physical block's KV rows from every layer pool, fetched
-        device→host for demotion into a lower tier. Runs on the stepper
-        thread at eviction time; ``self.cache`` then holds the committed
-        output of the last dispatched program, so the read sees every
-        write the block ever received. Payload keys follow the disk
-        format ("l00000": {"k": ..., "v": ..., int8 scales if present})."""
-        try:
-            rows = [{name: arr[block] for name, arr in layer.items()}
-                    for layer in self.cache]
-            if self._demote_sharding is not None:
-                # Stage through pinned_host: the D2H DMA lands in pinned
-                # memory the host reads without a bounce (TPU path).
-                rows = jax.device_put(rows, self._demote_sharding)
-            host = jax.device_get(rows)
-        except Exception as e:  # noqa: BLE001 — demotion is best-effort:
-            # a fetch failure degrades to the legacy discard, never
-            # faults the step loop that triggered the eviction.
-            self.logger.warning("prefix-tier demotion fetch failed "
-                                "(%s: %s); block discarded",
-                                type(e).__name__, e)
-            return None
-        return {f"l{i:05d}": {k: np.asarray(v) for k, v in r.items()}
-                for i, r in enumerate(host)}
-
-    def _restore_block(self, block: int, payload: dict) -> None:
-        """Scatter a tier-fetched payload into physical ``block`` of every
-        layer pool. Dispatch is async (jit): the scatter overlaps host-side
-        admission work, and the following prefill/decode programs see the
-        restored rows through the ``self.cache`` data dependency."""
-        if self._restore_fn is None:
-            @partial(jax.jit, donate_argnums=(0,))
-            def restore(cache_kv, rows, bid):
-                return [
-                    {k: v.at[bid].set(r[k].astype(v.dtype)) for k, v in
-                     layer.items()}
-                    for layer, r in zip(cache_kv, rows)
-                ]
-
-            self._restore_fn = restore
-        rows = [payload[f"l{i:05d}"] for i in range(len(self.cache))]
-        self.cache = self._restore_fn(self.cache, rows,
-                                      jnp.asarray(block, jnp.int32))
 
     def _admit(self) -> None:
         """Admit waiting requests into free slots via bucketed prefill.
@@ -1766,6 +1913,86 @@ class InferenceEngine:
         self._slot_keys[slot.slot_id] = 0
         self._gen_counts[slot.slot_id] = 0
         self._mark_state_dirty(slot.slot_id)
+
+    # ------------------------------------------------------------------
+    # Disaggregated prefill/decode handoff (serving/disagg.py)
+    # ------------------------------------------------------------------
+    def export_handoff(self, slot: _Slot) -> Optional[dict]:
+        """Snapshot everything a decode replica needs to continue ``slot``'s
+        request byte-identically, then release the slot locally.
+
+        The KV leaves over the proven tier path (fetch_block_kv: device→
+        host, staged through pinned_host where the backend has it) — only
+        the blocks covering WRITTEN positions (0..seq_len-1) travel; the
+        decode side allocates its own chain and restores into it. The
+        snapshot carries the origin slot's actual rng key bytes: an
+        unseeded request's key came from the origin engine's private rng
+        split and cannot be re-derived elsewhere, and the decode program's
+        fold_in(key, gen_count) stream must continue exactly where prefill
+        sampling left it. Returns None (slot untouched) if any block fetch
+        fails — the caller falls back to a re-prefill elsewhere.
+        """
+        req = slot.request
+        n_blocks = self.block_manager.blocks_needed(slot.seq_len)
+        payloads = []
+        for b in slot.blocks[:n_blocks]:
+            p = self.executor.fetch_block_kv(b)
+            if p is None:
+                return None
+            payloads.append(p)
+        snap = {
+            "request": req,
+            "payloads": payloads,
+            "seq_len": slot.seq_len,
+            "last_token": slot.last_token,
+            "slot_key": self._slot_keys[slot.slot_id].copy(),
+            "gen_count": int(self._gen_counts[slot.slot_id]),
+        }
+        self._release(slot)
+        return snap
+
+    def adopt_handoff(self, snap: dict) -> bool:
+        """Admit a prefilled request whose KV arrives as host payloads
+        (:meth:`export_handoff` counterpart): take a free slot, allocate a
+        fresh block chain, scatter the payloads in via the tier-restore
+        path, and seed the slot so the next decode step samples exactly
+        the token the origin engine would have. Returns False (nothing
+        consumed) when no slot or not enough blocks are free — the caller
+        retries or degrades to a re-prefill."""
+        slot = next((s for s in self.slots if s.free), None)
+        if slot is None:
+            return False
+        req = snap["request"]
+        seq_len = snap["seq_len"]
+        # +1: the first decode step writes KV at position seq_len.
+        blocks = self._alloc(self.block_manager.blocks_needed(seq_len + 1))
+        if blocks is None:
+            return False
+        # Closes the kv_handoff stall mark (note_readmitted); the origin
+        # admission already stamped admitted_time, so queue-time samples
+        # are not double counted.
+        self.telemetry.on_admitted(req)
+        slot.request = req
+        slot.blocks = blocks
+        slot.seq_len = seq_len
+        slot.next_pos = seq_len
+        slot.prefill_end = seq_len
+        slot.last_token = snap["last_token"]
+        row = np.zeros((self.cfg.max_blocks_per_seq,), np.int32)
+        row[: len(blocks)] = blocks
+        self._block_tables[slot.slot_id] = row
+        self._temperature[slot.slot_id] = req.params.temperature
+        self._top_k[slot.slot_id] = req.params.top_k
+        self._top_p[slot.slot_id] = req.params.top_p
+        self._slot_keys[slot.slot_id] = snap["slot_key"]
+        self._gen_counts[slot.slot_id] = snap["gen_count"]
+        self._mark_state_dirty(slot.slot_id)
+        if self._spec_hist is not None:
+            ctx = req.prompt_token_ids + req.output_token_ids
+            self._spec_hist[slot.slot_id, : len(ctx)] = ctx
+        for b, payload in zip(blocks, snap["payloads"]):
+            self.executor.restore_block(b, payload)
+        return True
 
     def abort_all(self, reason: str = "abort") -> List[Request]:
         """Fail every in-flight and queued request and free their slots.

@@ -98,6 +98,7 @@ class ReplicatedEngine:
         max_retries: int = 2,
         fault_inject_step: str = "",
         affinity_spill_threshold: int = 4,
+        telemetry: Optional[RequestTelemetry] = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
         if replicas < 1 or tensor < 1:
@@ -112,8 +113,11 @@ class ReplicatedEngine:
 
         # One shared request-telemetry instance: every replica observes
         # into the same TTFT/TPOT/queue-time histograms, so the fleet's
-        # latency distributions aggregate without a merge step.
-        self.telemetry = RequestTelemetry()
+        # latency distributions aggregate without a merge step. An
+        # injected instance extends the sharing across pools (the disagg
+        # controller's prefill and decode fleets report as one).
+        self.telemetry = telemetry if telemetry is not None \
+            else RequestTelemetry()
         self.engines: List[InferenceEngine] = []
         for r in range(replicas):
             group = devices[r * tensor:(r + 1) * tensor]
@@ -163,6 +167,11 @@ class ReplicatedEngine:
         # goes least-loaded instead (latency beats cache warmth).
         self.affinity_spill_threshold = affinity_spill_threshold
         self.affinity = {"sticky": 0, "spill": 0}
+        # Last-resort rescue hook (disagg): when THIS pool has no live
+        # replicas left, a stranded request is offered to the callable
+        # (returning True = rehomed elsewhere) before erroring — the
+        # controller routes it to the other pool (degraded colocation).
+        self.failover_fallback = None
 
     # ------------------------------------------------------------------
     def _load(self, eng: InferenceEngine) -> int:
@@ -325,8 +334,17 @@ class ReplicatedEngine:
 
         errored: List[Request] = []
         live = self.live_engines()
+        from dlti_tpu.telemetry.ledger import note_requeue
+
         for req in stranded:
             if not live or req.num_retries >= self.max_retries:
+                if (not live and req.num_retries < self.max_retries
+                        and self.failover_fallback is not None):
+                    note_requeue(req, "failover")
+                    if self.failover_fallback(req):
+                        req.num_retries += 1
+                        self.failover["retries"] += 1
+                        continue
                 req.finish_reason = "error"
                 req.finish_time = time.monotonic()
                 self.failover["failover_errors"] += 1
@@ -341,8 +359,6 @@ class ReplicatedEngine:
             # Critical-path attribution: the wait from here to
             # re-admission on the survivor books as "failover", not as
             # inflated prefill/decode (telemetry.ledger.note_requeue).
-            from dlti_tpu.telemetry.ledger import note_requeue
-
             note_requeue(req, "failover")
             target = min(live, key=self._load)
             target.resubmit(req)

@@ -144,6 +144,16 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     for metric in (_ml.hbm_bytes_gauge, _ml.hbm_peak_gauge,
                    _ml.hbm_headroom_gauge, _ml.hbm_untracked_gauge):
         registry.register(metric)
+    # Disaggregated serving (serving.disagg): per-pool gauges + KV-handoff
+    # counters ride in via the controller's pool_scalars source, plus the
+    # module-level handoff-latency histogram.
+    if hasattr(async_engine.engine, "pool_scalars"):
+        from dlti_tpu.serving import disagg as _disagg
+
+        registry.add_scalar_source(async_engine.engine.pool_scalars,
+                                   gauge_keys=_disagg.POOL_GAUGE_KEYS,
+                                   prefix="dlti_")
+        registry.register(_disagg.handoff_seconds)
     return registry
 
 

@@ -99,6 +99,7 @@ REQUEST_PHASES = (
     "prefill",         # admission → first token, minus restore/stalls
     "failover",        # requeued after a replica fault, waiting again
     "preempt",         # preempted under memory pressure, waiting again
+    "kv_handoff",      # disagg: prefill→decode paged-KV block migration
     "decode",          # first token → finish, minus requeue stalls
     "other",           # residual (clamp slivers; sum stays exact)
 )
@@ -352,8 +353,16 @@ def stitch_ledgers(worker_ledgers: List[dict], timeline: List[dict],
 
 def note_requeue(req, kind: str) -> None:
     """Mark a request leaving a slot back to a waiting queue (``kind`` in
-    ``("failover", "preempt")``); the wait until re-admission books to
-    that phase instead of inflating prefill/decode."""
+    ``("failover", "preempt", "kv_handoff")``); the wait until
+    re-admission books to that phase instead of inflating prefill/decode.
+
+    A mark may already be open: a slot preempted mid-chunked-prefill whose
+    replica then dies is requeued AGAIN (failover) before the preempt wait
+    was ever closed by a re-admission. Fold the open window into its phase
+    first — overwriting the mark would silently drop the elapsed wait and
+    restart the charge window, and the lost time would book into prefill.
+    """
+    note_readmitted(req)
     req._requeue_mark = (kind, time.monotonic())
 
 

@@ -66,6 +66,7 @@ MEMORY_OWNERS = (
     "prefix_cache_hbm",
     "decode_state_cache",
     "prefetch_buffers",
+    "kv_handoff_staging",  # disagg: host-staged prefill→decode KV payloads
     "chaos_balloon",      # the hbm-squeeze injector, visible by design
 )
 

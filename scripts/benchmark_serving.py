@@ -71,6 +71,15 @@ def main() -> None:
     p.add_argument("--reuse-frac", type=float, default=1.0,
                    help="fraction of non-first turns that revisit their "
                         "session; the rest issue unrelated cold one-offs")
+    p.add_argument("--long-prompt-frac", type=float, default=0.0,
+                   help="mixed-interference mode: this fraction of "
+                        "requests carries a synthetic ~long-prompt-tokens "
+                        "prompt; the report splits short-request decode "
+                        "TPOT p99 by concurrent-long-prefill overlap (the "
+                        "disaggregation stressor)")
+    p.add_argument("--long-prompt-tokens", type=int, default=512,
+                   help="synthetic long-prompt length in tokens (exact "
+                        "under the byte tokenizer)")
     p.add_argument("--scrape-server-metrics", action="store_true",
                    help="attach the server's on-engine histogram "
                         "summaries (/metrics) to the report")
@@ -96,6 +105,8 @@ def main() -> None:
         scrape_server_metrics=args.scrape_server_metrics,
         sessions=args.sessions, turns=args.turns,
         reuse_frac=args.reuse_frac,
+        long_prompt_frac=args.long_prompt_frac,
+        long_prompt_tokens=args.long_prompt_tokens,
     )
     report = run_load_test(cfg)
     d = report.to_dict()

@@ -52,6 +52,12 @@ _KEY_METRICS = (
     "sentinel_rollbacks", "sentinel_quarantined_windows",
     "sentinel_windows_skipped", "sdc_probes", "sdc_mismatches",
     "numeric_faults",
+    # Disaggregated serving (dlti_tpu.serving.disagg).
+    "pool_prefill_replicas_alive", "pool_decode_replicas_alive",
+    "pool_prefill_waiting", "pool_decode_waiting",
+    "pool_prefill_active", "pool_decode_active",
+    "kv_handoff_total", "kv_handoff_staged",
+    "kv_handoff_fallbacks_total", "kv_handoff_sheds_total",
 )
 
 # Sentinel dump reasons / context keys surfaced as their own report
@@ -149,6 +155,30 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
                 sentinel[k] = ctx_file[k]
     if context.get("sentinel_last_anomaly"):
         sentinel["last_anomaly"] = context["sentinel_last_anomaly"]
+    # Disaggregated serving (serving.disagg): a controller-backed server's
+    # stats carry per-pool detail under "pools" and handoff counters under
+    # "kv_handoff" — a decode-pool slot famine or a handoff shed storm
+    # reads very differently from a colocated engine stall, so the
+    # incident summary surfaces the split. None for colocated dumps.
+    disagg = None
+    if isinstance(metrics.get("pools"), dict):
+        per_pool = {}
+        for pool, ps in metrics["pools"].items():
+            if isinstance(ps, dict):
+                per_pool[pool] = {
+                    k: ps[k] for k in ("requests", "generated_tokens",
+                                       "prefill_tokens", "preemptions",
+                                       "decode_steps")
+                    if k in ps}
+        disagg = {
+            "per_pool": per_pool,
+            "replicas_alive": {
+                p: metrics.get(f"pool_{p}_replicas_alive")
+                for p in ("prefill", "decode")},
+            "kv_handoff": (metrics.get("kv_handoff")
+                           if isinstance(metrics.get("kv_handoff"), dict)
+                           else None),
+        }
     return {
         "dump": dump_dir,
         "reason": ctx_file.get("reason"),
@@ -166,6 +196,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "sentinel": sentinel or None,
         "goodput": goodput,
         "memory": memory,
+        "disagg": disagg,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
         "tracer_enabled": spans.get("tracerEnabled"),
@@ -358,6 +389,22 @@ def render(summary: dict) -> str:
         for a in m.get("top_untracked_arrays") or []:
             w(f"    untracked: {a.get('nbytes', 0) / gib:9.3f} GiB  "
               f"{a.get('shape')} {a.get('dtype')}")
+    if summary.get("disagg"):
+        d = summary["disagg"]
+        alive = d.get("replicas_alive") or {}
+        w("disaggregated serving:   (prefill/decode split pools)")
+        for pool, ps in (d.get("per_pool") or {}).items():
+            counters = "  ".join(f"{k}={v}" for k, v in ps.items())
+            n = alive.get(pool)
+            w(f"    {pool:8s} pool"
+              + (f" ({n} replica(s) alive)" if n is not None else "")
+              + (f": {counters}" if counters else ""))
+        kh = d.get("kv_handoff") or {}
+        if kh:
+            w(f"    kv handoff: {kh.get('completed', 0)} completed "
+              f"({kh.get('bytes', 0)} bytes), {kh.get('staged', 0)} staged "
+              f"at death, {kh.get('fallbacks', 0)} fallback(s), "
+              f"{kh.get('sheds', 0)} shed(s)")
     if summary["watchdog_alerts"]:
         w(f"watchdog:      {len(summary['watchdog_alerts'])} alert(s) "
           f"before death:")

@@ -12,6 +12,10 @@ Usage:
 
     # hermetic smoke: random-weight tiny model + byte tokenizer
     python scripts/serve.py --random-init llama_tiny --tokenizer byte
+
+    # disaggregated: 2 prefill + 2 decode replicas, paged-KV handoff
+    python scripts/serve.py --random-init llama_tiny --tokenizer byte \
+        --disagg --prefill-replicas 2 --decode-replicas 2
 """
 
 from __future__ import annotations
@@ -74,6 +78,27 @@ def parse_args():
                    help="data-parallel engine replicas (each tensor-wide); "
                         "a replica whose step faults is excluded and its "
                         "requests fail over to survivors")
+    # -- prefill/decode disaggregation (dlti_tpu.serving.disagg) --------
+    p.add_argument("--disagg", action="store_true",
+                   help="prefill/decode disaggregation: prompts prefill on "
+                        "a dedicated pool, then their paged-KV blocks "
+                        "migrate to a decode pool — long prefills stop "
+                        "inflating neighbours' decode TPOT (overrides "
+                        "--replicas; pool sizes below)")
+    p.add_argument("--prefill-replicas", type=int, default=1,
+                   help="prefill-pool replicas (each tensor-wide; needs "
+                        "--disagg)")
+    p.add_argument("--decode-replicas", type=int, default=1,
+                   help="decode-pool replicas (each tensor-wide; needs "
+                        "--disagg)")
+    p.add_argument("--handoff-queue-depth", type=int, default=8,
+                   help="finished prefills staged per decode replica "
+                        "awaiting a free slot; full queues leave prefill "
+                        "slots occupied (admission backpressure)")
+    p.add_argument("--handoff-deadline-s", type=float, default=0.0,
+                   help="staged longer than this re-prefills on the decode "
+                        "replica instead of waiting for adoption (0 = "
+                        "wait indefinitely)")
     # -- admission gateway (dlti_tpu.serving.gateway) -------------------
     p.add_argument("--gateway", action="store_true",
                    help="enable the admission gateway: bounded queue with "
@@ -291,7 +316,21 @@ def main() -> None:
         hbm_budget_bytes=args.hbm_budget_bytes,
         admit_min_headroom_frac=args.admit_min_headroom_frac,
     )
-    if args.replicas > 1:
+    if args.disagg:
+        from dlti_tpu.serving import DisaggController
+
+        engine = DisaggController(
+            model_cfg, params, ec, lora_cfg,
+            prefill_replicas=args.prefill_replicas,
+            decode_replicas=args.decode_replicas,
+            tensor=args.tensor,
+            max_retries=args.max_retries,
+            # Pool-scoped here: "POOL:REPLICA:STEP[:MODE]".
+            fault_inject_step=args.fault_inject_step,
+            handoff_queue_depth=args.handoff_queue_depth,
+            handoff_deadline_s=args.handoff_deadline_s,
+            affinity_spill_threshold=args.affinity_spill_threshold)
+    elif args.replicas > 1:
         from dlti_tpu.serving import ReplicatedEngine
 
         engine = ReplicatedEngine(
@@ -356,6 +395,13 @@ def main() -> None:
     t0 = time.time()
     engine.warmup_decode_ladder()
     print(f"decode programs ready in {time.time() - t0:.0f}s")
+    if args.disagg:
+        # Concurrent pool stepping: long prefills overlap decode dispatch
+        # instead of serializing with it in the stepper thread.
+        engine.start()
+        print(f"disaggregated pools: {args.prefill_replicas} prefill + "
+              f"{args.decode_replicas} decode replicas "
+              f"(handoff queue depth {args.handoff_queue_depth})")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
     print(f"live dashboard: http://{args.host}:{args.port}/dashboard  "
@@ -363,6 +409,8 @@ def main() -> None:
     try:
         serve(engine, tok, sc)
     finally:
+        if args.disagg:
+            engine.stop()
         if tracer is not None:
             path = tracer.export(os.path.join(
                 args.trace_dir, f"trace_serve_{os.getpid()}.json"))

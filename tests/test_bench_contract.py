@@ -283,7 +283,7 @@ def test_ledger_metric_names_are_schema_stable():
     assert ledger.PRODUCTIVE_BUCKETS == ("step_compute", "device_sync")
     assert ledger.REQUEST_PHASES == (
         "gateway_queue", "queue", "tier_restore", "prefill",
-        "failover", "preempt", "decode", "other",
+        "failover", "preempt", "kv_handoff", "decode", "other",
     )
 
 
@@ -312,8 +312,41 @@ def test_memledger_metric_names_are_schema_stable():
     assert memledger.MEMORY_OWNERS == (
         "params", "optimizer_state", "grad_buffers", "kv_block_pool",
         "prefix_cache_hbm", "decode_state_cache", "prefetch_buffers",
-        "chaos_balloon",
+        "kv_handoff_staging", "chaos_balloon",
     )
+
+
+def test_disagg_metric_names_are_schema_stable():
+    """Disaggregated-serving names are a scrape contract like the gateway
+    set: per-pool liveness/queue/active gauges plus the KV-handoff
+    counters and latency histogram (registered by the server registry
+    when the engine is a DisaggController)."""
+    from dlti_tpu.serving import disagg
+
+    assert disagg.POOL_METRIC_NAMES == (
+        "dlti_pool_prefill_replicas_alive",
+        "dlti_pool_decode_replicas_alive",
+        "dlti_pool_prefill_waiting",
+        "dlti_pool_decode_waiting",
+        "dlti_pool_prefill_active",
+        "dlti_pool_decode_active",
+    )
+    assert disagg.KV_HANDOFF_METRIC_NAMES == (
+        "dlti_kv_handoff_total",
+        "dlti_kv_handoff_bytes_total",
+        "dlti_kv_handoff_staged",
+        "dlti_kv_handoff_fallbacks_total",
+        "dlti_kv_handoff_sheds_total",
+        "dlti_kv_handoff_seconds",
+    )
+    assert disagg.handoff_seconds.name == disagg.KV_HANDOFF_METRIC_NAMES[5]
+    # Every pool_scalars key must expose as one of the pinned names.
+    exposed = {f"dlti_{k}" for k in disagg.POOL_GAUGE_KEYS} | {
+        "dlti_kv_handoff_total", "dlti_kv_handoff_bytes_total",
+        "dlti_kv_handoff_fallbacks_total", "dlti_kv_handoff_sheds_total"}
+    assert exposed == set(disagg.POOL_METRIC_NAMES
+                          + disagg.KV_HANDOFF_METRIC_NAMES) - {
+        "dlti_kv_handoff_seconds"}
 
 
 def test_steplog_hbm_fields_are_schema_stable():
@@ -400,6 +433,9 @@ def test_load_report_schema_includes_gateway_fields():
         # Memory-ledger era: end-of-run /debug/memory scrape (owner
         # attribution + headroom).
         "memory",
+        # Disaggregation era: mixed-interference mode's decode-TPOT split
+        # by concurrent-long-prefill overlap.
+        "interference",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"
@@ -418,6 +454,7 @@ def test_per_class_summary_keys():
     assert set(summary) == {
         "count", "ok", "shed", "latency_p50_s", "latency_p99_s",
         "ttft_p50_s", "ttft_p90_s", "ttft_p99_s", "tpot_mean_ms",
+        "tpot_p99_ms",
     }
     assert summary["count"] == 2 and summary["ok"] == 1
     assert summary["shed"] == 1

@@ -35,6 +35,9 @@ def _assert_convention(names, where):
 def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
+    from dlti_tpu.serving.disagg import (
+        KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
+    )
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
     from dlti_tpu.telemetry import (
@@ -60,7 +63,9 @@ def test_pinned_name_tuples_follow_convention():
                        (LEDGER_METRIC_NAMES, "ledger"),
                        (REQUEST_PHASE_METRIC_NAMES, "request_phase"),
                        (MEMLEDGER_METRIC_NAMES, "memledger"),
-                       (HEARTBEAT_METRIC_NAMES, "heartbeat")):
+                       (HEARTBEAT_METRIC_NAMES, "heartbeat"),
+                       (POOL_METRIC_NAMES, "disagg-pools"),
+                       (KV_HANDOFF_METRIC_NAMES, "kv-handoff")):
         _assert_convention(tup, where)
 
 
