@@ -78,6 +78,14 @@ class LoadGenConfig:
     sessions: int = 0
     turns: int = 4
     reuse_frac: float = 1.0
+    # Multi-LoRA workload: > 0 tags each request with an X-Adapter header
+    # drawn from N synthetic adapter names "adapter-0".."adapter-N-1"
+    # (register them on the server first — scripts/serve.py --adapter or
+    # POST /v1/adapters). adapter_mix picks the draw: "zipf" (weight
+    # 1/(i+1) — the realistic skew that exercises pool eviction while the
+    # hot adapters stay resident) or "uniform". 0 = no adapter header.
+    adapters: int = 0
+    adapter_mix: str = "zipf"
     # Mixed-interference workload: this fraction of requests (seeded draw)
     # carries a synthetic long prompt of ~long_prompt_tokens tokens instead
     # of the normal prompt — the disaggregation stressor. The report then
@@ -107,6 +115,8 @@ class RequestRecord:
     # Mixed-interference mode: this request carried the synthetic long
     # prompt (its prefill is the interference source, not a victim).
     long: bool = False
+    # Multi-LoRA mode: the adapter name this request was tagged with.
+    adapter: str = ""
     # Server-side critical-path breakdown (the response's "phases"
     # object: gateway queue, engine queue, tier restore, prefill,
     # failover, decode — telemetry.ledger); empty when the server
@@ -173,6 +183,13 @@ class LoadReport:
     warm_ttft_p50_s: float = 0.0
     warm_ttft_p90_s: float = 0.0
     cache_hit_rate: float = 0.0
+    # Multi-LoRA mode (cfg.adapters > 0): per-adapter latency breakdown
+    # ({adapter: {count, ok, shed, ttft/latency percentiles, tpot}} — the
+    # _class_summary schema), plus the server's own adapter-pool hit rate
+    # scraped from /metrics at run end (pool_hits / (hits + misses); 0.0
+    # when the scrape fails or the server runs without a pool).
+    per_adapter: dict = field(default_factory=dict)
+    adapter_pool_hit_rate: float = 0.0
     # Mixed-interference mode (long_prompt_frac > 0): decode-TPOT p99 of
     # SHORT requests split by whether a long prompt's prefill window
     # overlapped their decode window — the prefill→decode interference a
@@ -475,11 +492,27 @@ def _long_prompt(cfg: LoadGenConfig, idx: int) -> str:
     return (filler * (n // len(filler) + 1))[:n]
 
 
+def _draw_adapter(cfg: LoadGenConfig, rng: random.Random) -> str:
+    """Draw an adapter name "adapter-i" under the configured mix: zipf
+    weights 1/(i+1) (adapter-0 hottest), uniform weighs all equally."""
+    if cfg.adapters <= 0:
+        return ""
+    if cfg.adapter_mix == "uniform":
+        i = rng.randrange(cfg.adapters)
+    elif cfg.adapter_mix == "zipf":
+        weights = [1.0 / (k + 1) for k in range(cfg.adapters)]
+        i = rng.choices(range(cfg.adapters), weights=weights)[0]
+    else:
+        raise ValueError(f"adapter_mix must be 'zipf' or 'uniform', "
+                         f"got {cfg.adapter_mix!r}")
+    return f"adapter-{i}"
+
+
 def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
                 mix: List[Tuple[str, float]],
-                ) -> Tuple[str, dict, dict, str, str, bool]:
-    """-> (path, body, extra_headers, tenant, priority, long) for request
-    idx."""
+                ) -> Tuple[str, dict, dict, str, str, bool, str]:
+    """-> (path, body, extra_headers, tenant, priority, long, adapter)
+    for request idx."""
     long = (cfg.long_prompt_frac > 0
             and rng.random() < cfg.long_prompt_frac)
     prompt = (_long_prompt(cfg, idx) if long
@@ -503,7 +536,10 @@ def _build_body(cfg: LoadGenConfig, rng: random.Random, idx: int,
         body["priority"] = priority
     if cfg.deadline_s and cfg.deadline_s > 0:
         body["deadline_s"] = cfg.deadline_s
-    return path, body, headers, tenant, priority, long
+    adapter = _draw_adapter(cfg, rng)
+    if adapter:
+        headers["X-Adapter"] = adapter
+    return path, body, headers, tenant, priority, long, adapter
 
 
 def _phase_means(recs: List[RequestRecord]) -> dict:
@@ -578,6 +614,46 @@ def _interference_summary(recs: List[RequestRecord]) -> dict:
     }
 
 
+async def _scrape_adapter_hit_rate(cfg: LoadGenConfig) -> float:
+    """Adapter-pool hit rate from the server's /metrics counters
+    (dlti_adapter_pool_{hits,misses}_total): hits / (hits + misses).
+    Best-effort like every scrape — 0.0 on any failure or a pool that
+    never resolved an adapter."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(cfg.host, cfg.port), 10.0)
+        req = (f"GET /metrics HTTP/1.1\r\nHost: {cfg.host}:{cfg.port}\r\n"
+               f"Connection: close\r\n\r\n").encode()
+        writer.write(req)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), 10.0)
+        if b" 200" not in status_line:
+            return 0.0
+        headers: dict = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = b"".join([c async for c in _iter_body(reader, headers, 10.0)])
+        writer.close()
+    except Exception:
+        return 0.0
+    vals = {}
+    for line in raw.decode(errors="replace").splitlines():
+        name, _, value = line.partition(" ")
+        if name in ("dlti_adapter_pool_hits_total",
+                    "dlti_adapter_pool_misses_total"):
+            try:
+                vals[name] = float(value)
+            except ValueError:
+                pass
+    hits = vals.get("dlti_adapter_pool_hits_total", 0.0)
+    misses = vals.get("dlti_adapter_pool_misses_total", 0.0)
+    return round(hits / (hits + misses), 4) if hits + misses else 0.0
+
+
 async def _scrape_cache_hit_rate(cfg: LoadGenConfig) -> float:
     """Prefix-cache hit rate from the server's own /stats counters:
     tokens served from cache (HBM hits + lower-tier restores) over all
@@ -600,10 +676,11 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
 
     async def one(idx: int) -> None:
         async with sem:
-            path, body, headers, tenant, priority, long = _build_body(
-                cfg, rng, idx, mix)
+            path, body, headers, tenant, priority, long, adapter = \
+                _build_body(cfg, rng, idx, mix)
             rec = RequestRecord(start=time.monotonic(), tenant=tenant,
-                                priority=priority, long=long)
+                                priority=priority, long=long,
+                                adapter=adapter)
             records.append(rec)
             await _http_post_sse(cfg.host, cfg.port, path, body, rec,
                                  cfg.timeout_s, extra_headers=headers)
@@ -712,6 +789,13 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
             [r for r in records if r.long])
         per_class["short_prompt"] = _class_summary(
             [r for r in records if not r.long])
+    per_adapter = {}
+    if cfg.adapters > 0:
+        for name in sorted({r.adapter for r in records if r.adapter}):
+            per_adapter[name] = _class_summary(
+                [r for r in records if r.adapter == name])
+    adapter_pool_hit_rate = (await _scrape_adapter_hit_rate(cfg)
+                             if cfg.adapters > 0 else 0.0)
     cold = [r for r in ok if not r.warm]
     warm = [r for r in ok if r.warm]
     cold_ttfts = [r.ttft for r in cold if r.ttft is not None]
@@ -748,6 +832,8 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         warm_ttft_p50_s=round(_percentile(warm_ttfts, 50), 4),
         warm_ttft_p90_s=round(_percentile(warm_ttfts, 90), 4),
         cache_hit_rate=cache_hit_rate,
+        per_adapter=per_adapter,
+        adapter_pool_hit_rate=adapter_pool_hit_rate,
         interference=(_interference_summary(records)
                       if cfg.long_prompt_frac > 0 else {}),
         phase_means=_phase_means(ok),

@@ -528,6 +528,12 @@ class GatewayConfig:
     # tenants weigh 1.
     tenant_weights: str = ""
     default_tenant: str = "default"
+    # Tenant → LoRA adapter routing for multi-LoRA serving
+    # (dlti_tpu.serving.adapters): "tenantA:ad1,tenantB:ad2" decodes
+    # tenantA's requests under registered adapter ad1 unless the request
+    # carries its own X-Adapter header. Unlisted tenants use the shared
+    # base ("" = no mapping).
+    adapter_map: str = ""
     # Retry-After value (seconds) for queue-bound rejections (rate-limit
     # rejections compute their own from the bucket deficit).
     retry_after_s: float = 1.0

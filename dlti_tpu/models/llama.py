@@ -108,6 +108,7 @@ class LlamaAttention(nn.Module):
         segment_ids: Optional[jnp.ndarray] = None,
         cache: Optional[dict] = None,
         deterministic: bool = True,
+        adapter_ids: Optional[jnp.ndarray] = None,
     ):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
@@ -123,9 +124,12 @@ class LlamaAttention(nn.Module):
 
         # Qwen2-style bias on q/k/v only, never o (config.attention_bias).
         qkv_bias = cfg.attention_bias
-        q = proj("q_proj", cfg.num_heads * hd, qkv_bias)(x, deterministic)
-        k = proj("k_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic)
-        v = proj("v_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic)
+        q = proj("q_proj", cfg.num_heads * hd, qkv_bias)(x, deterministic,
+                                                         adapter_ids)
+        k = proj("k_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic,
+                                                            adapter_ids)
+        v = proj("v_proj", cfg.num_kv_heads * hd, qkv_bias)(x, deterministic,
+                                                            adapter_ids)
 
         q = q.reshape(b, s, cfg.num_heads, hd)
         k = k.reshape(b, s, cfg.num_kv_heads, hd)
@@ -235,7 +239,7 @@ class LlamaAttention(nn.Module):
         # between nothing_saveable and dots_*.
         out = checkpoint_name(out.reshape(b, s, cfg.num_heads * hd),
                               "attn_out")
-        out = proj("o_proj", cfg.hidden_size)(out, deterministic)
+        out = proj("o_proj", cfg.hidden_size)(out, deterministic, adapter_ids)
         return out, new_cache
 
 
@@ -254,7 +258,8 @@ class LlamaMLP(nn.Module):
     lora: Optional[LoRAConfig] = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True,
+                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         pdtype = _dtype(cfg.param_dtype)
@@ -266,9 +271,12 @@ class LlamaMLP(nn.Module):
                 name=name, **_lora_kwargs(cfg, self.lora, name),
             )
 
-        gate = proj("gate_proj", cfg.intermediate_size)(x, deterministic)
-        up = proj("up_proj", cfg.intermediate_size)(x, deterministic)
-        return proj("down_proj", cfg.hidden_size)(act(gate) * up, deterministic)
+        gate = proj("gate_proj", cfg.intermediate_size)(x, deterministic,
+                                                        adapter_ids)
+        up = proj("up_proj", cfg.intermediate_size)(x, deterministic,
+                                                    adapter_ids)
+        return proj("down_proj", cfg.hidden_size)(act(gate) * up,
+                                                  deterministic, adapter_ids)
 
 
 class LlamaBlock(nn.Module):
@@ -278,11 +286,13 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, segment_ids=None, cache=None,
-                 deterministic: bool = True, token_mask=None):
+                 deterministic: bool = True, token_mask=None,
+                 adapter_ids=None):
         cfg = self.cfg
         attn_out, new_cache = LlamaAttention(cfg, self.lora, self.mesh, name="attn")(
             RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset, name="input_norm")(x),
             cos, sin, positions, segment_ids, cache, deterministic,
+            adapter_ids,
         )
         x = x + attn_out
         normed = RMSNorm(cfg.rms_norm_eps, offset=cfg.rmsnorm_offset, name="post_attn_norm")(x)
@@ -299,7 +309,8 @@ class LlamaBlock(nn.Module):
             mlp_out = MoEMLP(cfg, self.mesh, name="mlp")(
                 normed, deterministic, token_mask)
         else:
-            mlp_out = LlamaMLP(cfg, self.lora, name="mlp")(normed, deterministic)
+            mlp_out = LlamaMLP(cfg, self.lora, name="mlp")(
+                normed, deterministic, adapter_ids)
         return x + mlp_out, new_cache
 
 
@@ -327,7 +338,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
-                 deterministic: bool = True, token_mask=None):
+                 deterministic: bool = True, token_mask=None,
+                 adapter_ids=None):
         cfg = self.cfg
         dtype = _dtype(cfg.dtype)
         pdtype = _dtype(cfg.param_dtype)
@@ -404,7 +416,7 @@ class LlamaModel(nn.Module):
             layer_cache = cache[i] if cache is not None else None
             x, layer_new_cache = cls_i(cfg, self.lora, self.mesh, name=f"layers_{i}")(
                 x, cos, sin, positions, segment_ids, layer_cache, deterministic,
-                token_mask,
+                token_mask, adapter_ids,
             )
             if cache is not None:
                 new_caches.append(layer_new_cache)
@@ -443,11 +455,12 @@ class LlamaForCausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, cache=None,
                  deterministic: bool = True, token_mask=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, adapter_ids=None):
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         x, new_cache = LlamaModel(cfg, self.lora, self.mesh, name="model")(
-            input_ids, positions, segment_ids, cache, deterministic, token_mask
+            input_ids, positions, segment_ids, cache, deterministic, token_mask,
+            adapter_ids,
         )
         if return_hidden:
             # Skip the LM head: the caller computes a seq-chunked loss so

@@ -39,7 +39,8 @@ class LoRADense(nn.Module):
     kernel_init: Callable = nn.initializers.lecun_normal()
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True,
+                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         in_features = x.shape[-1]
         kernel = self.param(
             "kernel", self.kernel_init, (in_features, self.features), self.param_dtype
@@ -81,6 +82,27 @@ class LoRADense(nn.Module):
                 preferred_element_type=self.dtype,
             )
             y = y + scaling * delta
+
+        if adapter_ids is not None and self.has_variable("adapters", "a"):
+            # Batched multi-LoRA serving (dlti_tpu.serving.adapters): the
+            # stacked per-slot A/B pool rides in as an "adapters" variable
+            # collection; each batch row gathers ITS adapter's factors by
+            # id, so one compiled step serves heterogeneous adapters
+            # (S-LoRA/Punica BGMV). Row 0 is all-zero — base requests add
+            # exactly +0.0 and stay byte-identical to an adapter-free
+            # engine. The branch is Python-static: training and
+            # adapter-off serving never trace it.
+            pa = self.get_variable("adapters", "a")  # (P, in, r)
+            pb = self.get_variable("adapters", "b")  # (P, r, out)
+            ps = self.get_variable("adapters", "s")  # (P,)
+            a = jnp.take(pa, adapter_ids, axis=0).astype(self.dtype)
+            b = jnp.take(pb, adapter_ids, axis=0).astype(self.dtype)
+            s = jnp.take(ps, adapter_ids, axis=0).astype(self.dtype)
+            h = jnp.einsum("bsi,bir->bsr", x.astype(self.dtype), a,
+                           preferred_element_type=self.dtype)
+            delta = jnp.einsum("bsr,bro->bso", h, b,
+                               preferred_element_type=self.dtype)
+            y = y + s[:, None, None] * delta
         return y
 
 

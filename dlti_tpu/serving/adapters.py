@@ -1,0 +1,500 @@
+"""Multi-LoRA serving: hot-loadable adapter catalog + batched HBM pool.
+
+One merged-weights replica per fine-tune costs N full copies of the base
+model for N tenants. S-LoRA / Punica showed the alternative: keep ONE
+shared base resident and apply each request's low-rank adapter as a
+gathered per-slot A/B einsum inside the same compiled step, so a batch
+where every row wears a different adapter still runs as one program.
+This module is the host side of that design:
+
+* :class:`AdapterCatalog` — a process-global name → checkpoint-directory
+  registry. Registration verifies the checkpoint through the digest
+  store (``checkpoint/store.py``); a corrupt checkpoint is quarantined
+  at registration (or at a later reload) and the name stays/becomes
+  unknown, so routing layers 404 instead of the engine ever faulting.
+  Hot-register closes the train → serve loop: a LoRA checkpoint written
+  by the Trainer becomes servable with zero engine restart.
+* :class:`AdapterPool` — a bounded per-engine device pool of stacked
+  per-module A/B tensors: row 0 is the all-zero base adapter (the
+  batched einsum then contributes exactly +0.0, so base requests are
+  byte-identical to an adapter-free engine), rows 1..slots hold loaded
+  adapters under refcounted LRU. ``acquire`` returns a row index the
+  engine carries in its device-resident decode state; a miss loads from
+  the verified store and scatters one row (no pool rebuild, no
+  recompile).
+* :func:`save_adapter` / :func:`extract_adapter_weights` — the adapter
+  checkpoint format (nested numpy dicts, ``save_pytree``-compatible):
+  ``{"meta": {"alpha"}, "weights": {<params paths>: {"lora_a",
+  "lora_b"}}}``. Target modules are implicit in the tree structure and
+  the rank in the shapes, so the format needs no sidecar metadata.
+
+Metric names are a scrape contract (pinned in
+``tests/test_bench_contract.py`` / ``tests/test_metric_naming.py``);
+the pool registers as the ``lora_adapters`` memledger owner engine-side.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlti_tpu.checkpoint.store import (
+    CheckpointCorruptError, load_pytree, quarantine_step, save_pytree,
+)
+from dlti_tpu.telemetry.registry import Counter, Gauge
+from dlti_tpu.utils.logging import get_logger
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+ADAPTER_METRIC_NAMES = (
+    "dlti_adapter_loads_total",
+    "dlti_adapter_evictions_total",
+    "dlti_adapter_pool_hits_total",
+    "dlti_adapter_pool_misses_total",
+    "dlti_adapter_pool_slots",
+    "dlti_adapter_pool_bytes",
+)
+
+# Module-level metrics (the prefix-cache pattern: defined here, the
+# server registry registers them for /metrics, replicas aggregate into
+# one series).
+loads_total = Counter(
+    ADAPTER_METRIC_NAMES[0],
+    help="adapter checkpoints loaded from the store into the HBM pool")
+evictions_total = Counter(
+    ADAPTER_METRIC_NAMES[1],
+    help="idle adapters LRU-evicted from the HBM pool")
+pool_hits_total = Counter(
+    ADAPTER_METRIC_NAMES[2],
+    help="acquisitions served by an already-resident adapter")
+pool_misses_total = Counter(
+    ADAPTER_METRIC_NAMES[3],
+    help="acquisitions that had to load from the checkpoint store")
+pool_slots_gauge = Gauge(
+    ADAPTER_METRIC_NAMES[4],
+    help="adapter slots in the HBM pool (row 0, the base no-op, excluded)")
+pool_bytes_gauge = Gauge(
+    ADAPTER_METRIC_NAMES[5],
+    help="bytes of the stacked A/B adapter pool on device")
+
+
+class AdapterError(Exception):
+    """Unknown, corrupt, or incompatible adapter.
+
+    Always a *request*-scoped failure: the gateway/server map it to
+    HTTP 404 at admission, the engine fails the one request that named
+    it — it must never take the engine down.
+    """
+
+
+# ----------------------------------------------------------------------
+# Checkpoint format
+# ----------------------------------------------------------------------
+
+def extract_adapter_weights(params: Any) -> Dict[str, Any]:
+    """The LoRA factors of a trained params tree, at their params paths.
+
+    Walks nested dicts and keeps every ``{"lora_a", "lora_b"}`` pair
+    (the base ``kernel`` stays behind); the result is the ``weights``
+    subtree of the adapter checkpoint format.
+    """
+    out: Dict[str, Any] = {}
+    if not isinstance(params, dict):
+        return out
+    for k, v in params.items():
+        if not isinstance(v, dict):
+            continue
+        if "lora_a" in v and "lora_b" in v:
+            out[k] = {"lora_a": np.asarray(v["lora_a"]),
+                      "lora_b": np.asarray(v["lora_b"])}
+        else:
+            sub = extract_adapter_weights(v)
+            if sub:
+                out[k] = sub
+    return out
+
+
+def save_adapter(directory: str, params: Any, alpha: float = 32.0) -> str:
+    """Write an adapter checkpoint (digest-verified store format) from a
+    trained params tree; returns the directory. Raises ``ValueError``
+    when the tree holds no LoRA factors (nothing to serve)."""
+    weights = extract_adapter_weights(params)
+    if not weights:
+        raise ValueError("params tree holds no lora_a/lora_b factors; "
+                         "train with LoRAConfig.enabled first")
+    return save_pytree(directory, {
+        "meta": {"alpha": np.float32(alpha)},
+        "weights": weights,
+    })
+
+
+def _load_verified(name: str, directory: str) -> dict:
+    """Load + digest-verify one adapter checkpoint; corrupt checkpoints
+    are quarantined (``store.quarantine_step``) and surface as
+    :class:`AdapterError` so the caller 404s instead of faulting."""
+    try:
+        tree = load_pytree(directory, verify=True)
+    except CheckpointCorruptError as e:
+        parent, base = os.path.split(os.path.normpath(directory))
+        dst = quarantine_step(parent or ".", base,
+                              reason=f"adapter {name!r}: {e}")
+        raise AdapterError(
+            f"adapter {name!r} checkpoint is corrupt"
+            f"{' (quarantined to ' + dst + ')' if dst else ''}: {e}") from e
+    except (OSError, ValueError) as e:
+        raise AdapterError(
+            f"adapter {name!r} unreadable at {directory}: {e}") from e
+    if (not isinstance(tree, dict) or not isinstance(tree.get("weights"), dict)
+            or not tree["weights"] or "meta" not in tree
+            or "alpha" not in tree["meta"]):
+        raise AdapterError(
+            f"adapter {name!r} at {directory} is not an adapter checkpoint "
+            "(expected {'meta': {'alpha'}, 'weights': {...}})")
+    return tree
+
+
+def _flatten_lora(weights: dict, path: Tuple[str, ...] = ()
+                  ) -> Dict[Tuple[str, ...], dict]:
+    out: Dict[Tuple[str, ...], dict] = {}
+    for k, v in weights.items():
+        if not isinstance(v, dict):
+            continue
+        if "lora_a" in v and "lora_b" in v:
+            out[path + (k,)] = v
+        else:
+            out.update(_flatten_lora(v, path + (k,)))
+    return out
+
+
+# ----------------------------------------------------------------------
+# Process-global catalog
+# ----------------------------------------------------------------------
+
+class AdapterCatalog:
+    """Thread-safe name → verified-checkpoint-directory registry.
+
+    Process-global (see :func:`get_catalog`) so every engine — replicas,
+    disagg pools — resolves the same names without config threading; the
+    per-engine :class:`AdapterPool` loads lazily from here at admission.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._dirs: Dict[str, str] = {}
+
+    def register(self, name: str, directory: str) -> str:
+        """Verify + register; returns the name. Raises
+        :class:`AdapterError` on a bad name or a corrupt/unreadable
+        checkpoint (corrupt ones are quarantined) — the name is then NOT
+        registered, so routing keeps 404ing it."""
+        if not name or not isinstance(name, str) or any(
+                c in name for c in " \t\n/\\"):
+            raise AdapterError(f"invalid adapter name {name!r}")
+        directory = os.path.abspath(directory)
+        _load_verified(name, directory)  # verify before the name exists
+        with self._lock:
+            self._dirs[name] = directory
+        get_logger().info("adapter %r registered from %s", name, directory)
+        return name
+
+    def unregister(self, name: str) -> bool:
+        with self._lock:
+            return self._dirs.pop(name, None) is not None
+
+    def directory(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._dirs.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._dirs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dirs.clear()
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._dirs
+
+
+_CATALOG = AdapterCatalog()
+
+
+def get_catalog() -> AdapterCatalog:
+    return _CATALOG
+
+
+def register_adapter(name: str, directory: str) -> str:
+    """Hot-register an adapter checkpoint process-wide (every engine's
+    pool can load it from the next admission on — no restart)."""
+    return _CATALOG.register(name, directory)
+
+
+def unregister_adapter(name: str) -> bool:
+    return _CATALOG.unregister(name)
+
+
+# ----------------------------------------------------------------------
+# Device pool
+# ----------------------------------------------------------------------
+
+def _target_shapes(params: Any, targets: Sequence[str],
+                   path: Tuple[str, ...] = ()
+                   ) -> Dict[Tuple[str, ...], Tuple[int, int]]:
+    """``{params path: (in_features, out_features)}`` for every target
+    projection in the tree (int8 kernels keep the original shape in
+    their ``q`` component)."""
+    out: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+    if not isinstance(params, dict):
+        return out
+    for k, v in params.items():
+        if not isinstance(v, dict):
+            continue
+        if k in targets and "kernel" in v:
+            kern = v["kernel"]
+            shape = kern["q"].shape if isinstance(kern, dict) else kern.shape
+            out[path + (k,)] = (int(shape[0]), int(shape[1]))
+        else:
+            out.update(_target_shapes(v, targets, path + (k,)))
+    return out
+
+
+def plan_pool_bytes(model_cfg: Any, targets: Sequence[str], rank: int,
+                    num_slots: int) -> int:
+    """Analytic pool size (fp32 masters): ``(slots + 1) x sum over
+    layers/targets of (in*r + r*out + 1) x 4`` — the number
+    ``scripts/memory_plan.py`` cross-checks against the measured
+    ``lora_adapters`` memledger owner."""
+    h = model_cfg.hidden_size
+    hd = model_cfg.resolved_head_dim
+    m = model_cfg.intermediate_size
+    dims = {
+        "q_proj": (h, model_cfg.num_heads * hd),
+        "k_proj": (h, model_cfg.num_kv_heads * hd),
+        "v_proj": (h, model_cfg.num_kv_heads * hd),
+        "o_proj": (model_cfg.num_heads * hd, h),
+        "gate_proj": (h, m), "up_proj": (h, m), "down_proj": (m, h),
+    }
+    per_layer = 0
+    for t in targets:
+        if t not in dims:
+            raise ValueError(f"unknown adapter target {t!r}")
+        din, dout = dims[t]
+        per_layer += din * rank + rank * dout + 1
+    return (int(num_slots) + 1) * model_cfg.num_layers * per_layer * 4
+
+
+class AdapterPool:
+    """Bounded stacked A/B adapter pool resident on device.
+
+    The pool tree mirrors the params tree at the target projections:
+    each holds ``{"a": (P, in, r), "b": (P, r, out), "s": (P,)}`` with
+    ``P = num_slots + 1`` — applied inside the model as a Flax
+    ``adapters`` variable collection, gathered per batch row by adapter
+    id. Row 0 is all-zero (base). Loads scatter ONE row in place (a
+    jitted ``.at[i].set``), so a pool-miss never reshapes or recompiles
+    the serving programs. Refcounted LRU: rows pinned by in-flight
+    requests are never evicted; ``acquire`` on a full pinned pool
+    returns ``(-1, False)`` and the engine defers admission (the same
+    contract as KV-block exhaustion).
+    """
+
+    def __init__(self, params: Any, num_slots: int, rank: int,
+                 targets: Sequence[str], device: Any = None,
+                 mesh: Any = None, catalog: Optional[AdapterCatalog] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError("adapter pool needs at least 1 slot")
+        if rank < 1:
+            raise ValueError("adapter rank must be >= 1")
+        self.num_slots = int(num_slots)
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+        self._catalog = catalog if catalog is not None else get_catalog()
+        self._lock = threading.Lock()
+        self._shapes = _target_shapes(params, self.targets)
+        if not self._shapes:
+            raise ValueError(
+                f"no adapter targets {self.targets} found in the params "
+                "tree — wrong target names for this model?")
+        P = self.num_slots + 1
+        tree: Dict[str, Any] = {}
+        for path, (din, dout) in self._shapes.items():
+            node = tree
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = {
+                "a": np.zeros((P, din, self.rank), np.float32),
+                "b": np.zeros((P, self.rank, dout), np.float32),
+                "s": np.zeros((P,), np.float32),
+            }
+        self._device = device
+        self._mesh = mesh
+        self.tree = jax.tree_util.tree_map(self._place, tree)
+        # One-row in-place scatter; the OLD pool buffers are NOT donated
+        # (an in-flight async step may still be reading them).
+        self._scatter = jax.jit(lambda pool, rows, i: jax.tree_util.tree_map(
+            lambda p, r: p.at[i].set(r), pool, rows))
+        del jnp
+        # Slot bookkeeping: row 0 is the reserved base no-op.
+        self._names: List[Optional[str]] = [None] * P
+        self._refs = [0] * P
+        self._last_used = [0] * P
+        self._tick = 0
+        self._by_name: Dict[str, int] = {}
+        pool_slots_gauge.set(self.num_slots)
+        pool_bytes_gauge.set(self.nbytes)
+
+    def _place(self, x: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(x, NamedSharding(self._mesh,
+                                                   PartitionSpec()))
+        if self._device is not None:
+            return jax.device_put(x, self._device)
+        return jnp.asarray(x)
+
+    @property
+    def nbytes(self) -> int:
+        import jax
+
+        return jax.tree_util.tree_reduce(
+            lambda t, x: t + x.nbytes, self.tree, 0)
+
+    def resident(self, name: str) -> bool:
+        with self._lock:
+            return name in self._by_name
+
+    def loaded_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_name)
+
+    # -- acquire / release ---------------------------------------------
+    def acquire(self, name: str) -> Tuple[int, bool]:
+        """Pin ``name`` into a pool row; returns ``(row, loaded)``.
+
+        ``loaded`` is True when this call paid a checkpoint-store load
+        (the engine charges it to the request's restore phase). Returns
+        ``(-1, False)`` when every row is pinned by in-flight requests —
+        the caller defers, FCFS. Raises :class:`AdapterError` for an
+        unknown name or a checkpoint that fails verification at load
+        time (then also quarantined + unregistered, so later requests
+        404 at admission instead of retrying the load forever).
+        """
+        with self._lock:
+            self._tick += 1
+            idx = self._by_name.get(name)
+            if idx is not None:
+                self._refs[idx] += 1
+                self._last_used[idx] = self._tick
+                pool_hits_total.inc()
+                return idx, False
+            pool_misses_total.inc()
+            directory = self._catalog.directory(name)
+            if directory is None:
+                raise AdapterError(f"unknown adapter {name!r} "
+                                   "(register it first)")
+            idx = self._free_slot()
+            if idx is None:
+                return -1, False
+            try:
+                ckpt = _load_verified(name, directory)
+                rows = self._rows_from(name, ckpt)
+            except AdapterError:
+                # The registered checkpoint went bad on disk after
+                # registration: drop the name so admission 404s.
+                self._catalog.unregister(name)
+                raise
+            self.tree = self._scatter(self.tree, rows, idx)
+            self._names[idx] = name
+            self._refs[idx] = 1
+            self._last_used[idx] = self._tick
+            self._by_name[name] = idx
+            loads_total.inc()
+            return idx, True
+
+    def release(self, idx: int) -> None:
+        """Unpin one acquisition of row ``idx`` (0 / negative = no-op).
+        The row stays resident for cache hits until LRU eviction needs
+        it."""
+        if idx <= 0:
+            return
+        with self._lock:
+            if self._refs[idx] > 0:
+                self._refs[idx] -= 1
+
+    def _free_slot(self) -> Optional[int]:
+        # Never-used rows first (they are already zero), then the
+        # least-recently-used unpinned resident row.
+        for i in range(1, self.num_slots + 1):
+            if self._names[i] is None and self._refs[i] == 0:
+                return i
+        victim = None
+        for i in range(1, self.num_slots + 1):
+            if self._refs[i] == 0 and (
+                    victim is None
+                    or self._last_used[i] < self._last_used[victim]):
+                victim = i
+        if victim is None:
+            return None
+        evicted = self._names[victim]
+        if evicted is not None:
+            del self._by_name[evicted]
+            self._names[victim] = None
+            evictions_total.inc()
+        return victim
+
+    # -- row construction ----------------------------------------------
+    def _rows_from(self, name: str, ckpt: dict) -> dict:
+        """One pool row per target module: the adapter's A/B zero-padded
+        from its rank r to the pool rank (float-exact: padded columns
+        multiply padded zero rows), scale alpha/r per the merge
+        convention; targets the adapter did not train get zero rows
+        (exact no-op)."""
+        alpha = float(np.asarray(ckpt["meta"]["alpha"]))
+        flat = _flatten_lora(ckpt["weights"])
+        unknown = sorted(set(flat) - set(self._shapes))
+        if unknown:
+            raise AdapterError(
+                f"adapter {name!r} targets modules outside this pool "
+                f"(targets={self.targets}): {['/'.join(p) for p in unknown]}")
+        ranks = {int(np.asarray(w["lora_a"]).shape[-1]) for w in flat.values()}
+        if len(ranks) != 1:
+            raise AdapterError(
+                f"adapter {name!r} has mixed ranks {sorted(ranks)}")
+        r = ranks.pop()
+        if not 1 <= r <= self.rank:
+            raise AdapterError(
+                f"adapter {name!r} rank {r} exceeds the pool rank "
+                f"{self.rank}")
+        rows: Dict[str, Any] = {}
+        for path, (din, dout) in self._shapes.items():
+            w = flat.get(path)
+            a = np.zeros((din, self.rank), np.float32)
+            b = np.zeros((self.rank, dout), np.float32)
+            s = np.float32(0.0)
+            if w is not None:
+                la = np.asarray(w["lora_a"], np.float32)
+                lb = np.asarray(w["lora_b"], np.float32)
+                if la.shape != (din, r) or lb.shape != (r, dout):
+                    raise AdapterError(
+                        f"adapter {name!r} shape mismatch at "
+                        f"{'/'.join(path)}: a{la.shape} b{lb.shape} vs "
+                        f"module ({din}, {dout}) rank {r}")
+                a[:, :r] = la
+                b[:r, :] = lb
+                s = np.float32(alpha / r)
+            node = rows
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = {"a": a, "b": b, "s": s}
+        return rows

@@ -57,7 +57,14 @@ class DecodeStateCache:
     """Persistent device twins of the engine's per-slot host mirrors."""
 
     def __init__(self, num_slots: int, device=None, mesh=None,
-                 stats: Optional[dict] = None):
+                 stats: Optional[dict] = None,
+                 extra_fields: Sequence[str] = ()):
+        # Optional extra per-slot mirrors (e.g. the multi-LoRA path's
+        # "adapter_ids") ride APPENDED after the base six, so the
+        # positional invariants below — block_tables at index 0 (masked
+        # for prefilling rows), gen_counts at index 2 (bumped on device)
+        # — hold regardless.
+        self._fields = _FIELDS + tuple(extra_fields)
         self._num_slots = num_slots
         self._device = device
         self._mesh = mesh
@@ -108,7 +115,7 @@ class DecodeStateCache:
         """
         masked = set(masked_rows)
         if self._dev is None or self._all_dirty:
-            host = [np.asarray(mirrors[f]) for f in _FIELDS]
+            host = [np.asarray(mirrors[f]) for f in self._fields]
             if masked:
                 bt = host[0].copy()
                 bt[sorted(masked)] = 0
@@ -130,7 +137,7 @@ class DecodeStateCache:
             idx_arr = np.full((npad,), idx[0], np.int32)
             idx_arr[:n] = idx
             rows: List[np.ndarray] = []
-            for f in _FIELDS:
+            for f in self._fields:
                 r = np.ascontiguousarray(np.asarray(mirrors[f])[idx_arr])
                 if f == "block_tables" and masked:
                     for j, sid in enumerate(idx_arr):

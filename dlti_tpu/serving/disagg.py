@@ -234,13 +234,21 @@ class DisaggController:
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
-               affinity_key: Optional[str] = None) -> Request:
+               affinity_key: Optional[str] = None,
+               adapter: str = "") -> Request:
         """Admit into the prefill pool (least-loaded / affinity routing is
         ReplicatedEngine's); with the prefill pool extinct, degrade to
-        colocated admission on the decode pool rather than refusing."""
+        colocated admission on the decode pool rather than refusing.
+
+        ``adapter`` rides the Request through the KV handoff: the prefill
+        engine pins it from its own pool, ``export_handoff``'s release
+        drops that pin, and ``adopt_handoff`` re-acquires on the decode
+        replica's pool (adoption defers while that pool is pinned full).
+        """
         try:
             return self.prefill.submit(prompt_token_ids, params,
-                                       request_id, affinity_key)
+                                       request_id, affinity_key,
+                                       adapter=adapter)
         except RuntimeError:
             if self.decode.num_live == 0:
                 raise
@@ -248,7 +256,8 @@ class DisaggController:
                 "prefill pool has no live replicas; admitting colocated "
                 "on the decode pool")
             return self.decode.submit(prompt_token_ids, params,
-                                      request_id, affinity_key)
+                                      request_id, affinity_key,
+                                      adapter=adapter)
 
     def _rescue_to_decode(self, req: Request) -> bool:
         live = self.decode.live_engines()

@@ -37,6 +37,7 @@ import numpy as np
 from dlti_tpu.config import LoRAConfig, ModelConfig
 from dlti_tpu.models import LlamaForCausalLM
 from dlti_tpu.ops.kv_cache import init_paged_cache
+from dlti_tpu.serving.adapters import AdapterError
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.sampling import SamplingParams, sample_tokens
 from dlti_tpu.telemetry import RequestTelemetry
@@ -166,6 +167,20 @@ class EngineConfig:
     # is also off whenever capacity is unknown). Deferred requests stay
     # queued — the degraded mode is latency, never a client error.
     admit_min_headroom_frac: float = 0.0
+    # Multi-LoRA serving (dlti_tpu.serving.adapters): with adapter_slots
+    # > 0 the executor carries a stacked per-module A/B adapter pool
+    # ((slots+1, in, r) and (slots+1, r, out) per targeted projection;
+    # row 0 is the all-zero base no-op) and every compiled program
+    # gathers each batch row's factors by adapter id — one program
+    # serves a batch of heterogeneous adapters (S-LoRA/Punica's BGMV).
+    # 0 keeps every program signature byte-identical to an adapter-free
+    # engine. adapter_rank is the pool-wide max (smaller adapters
+    # zero-pad, which is float-exact); adapter_targets name the
+    # projections the pool covers.
+    adapter_slots: int = 0
+    adapter_rank: int = 16
+    adapter_targets: Sequence[str] = (
+        "q_proj", "k_proj", "v_proj", "o_proj")
 
     def buckets(self) -> List[int]:
         if self.prefill_buckets:
@@ -239,6 +254,13 @@ class Request:
     stall_s: Dict[str, float] = field(default_factory=dict)
     stall_prefill_s: float = 0.0
     _requeue_mark: Optional[tuple] = None
+    # Multi-LoRA serving: the registered adapter this request generates
+    # under ("" = base model). _adapter_slot is the resolved pool row
+    # (-1 = unresolved): acquisition happens at admission and the pin is
+    # dropped with the decode slot, so preemption and failover
+    # re-acquire — the row may have been evicted meanwhile.
+    adapter: str = ""
+    _adapter_slot: int = -1
 
     @property
     def done(self) -> bool:
@@ -365,6 +387,20 @@ class EngineExecutor:
                 else jax.device_put(x, dev), params)
         self.params = params
 
+        # Multi-LoRA adapter pool: stacked per-module A/B tensors the
+        # compiled programs gather per batch row (serving.adapters). Built
+        # AFTER quantization/placement so the target-shape walk sees the
+        # final param layout (int8 kernels keep their shape in "q") and
+        # the pool lands on the engine device alongside the weights.
+        self.adapter_pool = None
+        if engine_cfg.adapter_slots > 0:
+            from dlti_tpu.serving.adapters import AdapterPool
+
+            self.adapter_pool = AdapterPool(
+                self.params, engine_cfg.adapter_slots,
+                engine_cfg.adapter_rank, engine_cfg.adapter_targets,
+                device=self._device, mesh=mesh)
+
         ec = engine_cfg
         from dlti_tpu.utils.dtypes import resolve_dtype
 
@@ -454,19 +490,31 @@ class EngineExecutor:
     # ------------------------------------------------------------------
     # Compiled programs
     # ------------------------------------------------------------------
-    def _model_cache_call(self, params, cache_kv, block_tables, input_ids, positions):
+    def _model_cache_call(self, params, cache_kv, block_tables, input_ids,
+                          positions, adapter_ids=None, adapters=None):
         """Run the model over a paged cache; returns (logits, new k/v list).
 
         Quantized params pass through as-is — each module dequantizes its
         own weights at the consumer (``models.quantization.maybe_dequantize``),
         so only the executing layer holds a compute-dtype copy even inside
-        the multi-step decode scan."""
+        the multi-step decode scan.
+
+        With a multi-LoRA pool, ``adapters`` (the stacked A/B tree) rides
+        in as a Flax variable collection and ``adapter_ids`` (one pool row
+        per batch row) gathers each row's factors inside LoRADense; both
+        absent leaves the traced program identical to an adapter-free
+        engine (the branch is Python-static)."""
         cache = [
             {**layer, "block_tables": block_tables} for layer in cache_kv
         ]
+        variables = {"params": params}
+        kw = {}
+        if adapters is not None:
+            variables["adapters"] = adapters
+            kw["adapter_ids"] = adapter_ids
         logits, new_cache = self.model.apply(
-            {"params": params}, input_ids, positions=positions, cache=cache,
-            deterministic=True,
+            variables, input_ids, positions=positions, cache=cache,
+            deterministic=True, **kw,
         )
         return logits, [{k: v for k, v in c.items() if k != "block_tables"}
                         for c in new_cache]
@@ -481,15 +529,17 @@ class EngineExecutor:
     def _build_prefill_fn(self, bucket: int):
         @partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache_kv, input_ids, positions, block_table,
-                    last_idx):
+                    last_idx, *lora):
             # input_ids/positions: (B, bucket); block_table: (B, nblk) —
             # sliced so attention's gathered window is bucket-sized, not
             # max_model_len-sized. B > 1 batches several admissions into
             # one program call (padding rows carry position -1, whose
             # writes slot_mapping drops); last_idx (B,) selects each
-            # row's final real logit.
+            # row's final real logit. With a multi-LoRA pool, *lora is
+            # (adapter_ids, adapters) — per-row adapter gather; empty
+            # otherwise (the traced program is then unchanged).
             logits, new_kv = self._model_cache_call(
-                params, cache_kv, block_table, input_ids, positions
+                params, cache_kv, block_table, input_ids, positions, *lora
             )
             last = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1)[:, 0]
@@ -500,10 +550,13 @@ class EngineExecutor:
     def _build_decode_fn(self):
         @partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache_kv, input_ids, positions, block_tables,
-                   slot_keys, gen_counts, temperature, top_k, top_p):
+                   slot_keys, gen_counts, temperature, top_k, top_p, *lora):
             # input_ids/positions: (S, 1); block_tables: (S, max_blocks).
+            # *lora: (adapter_ids, adapters) when the multi-LoRA pool is
+            # on (adapter_ids rides in decode-state argument order, the
+            # pool tree LAST so state threading stays contiguous).
             logits, new_kv = self._model_cache_call(
-                params, cache_kv, block_tables, input_ids, positions
+                params, cache_kv, block_tables, input_ids, positions, *lora
             )
             rngs = jax.vmap(jax.random.fold_in)(slot_keys, gen_counts)
             tokens, logprobs = sample_tokens(
@@ -560,11 +613,12 @@ class EngineExecutor:
         """
         @partial(jax.jit, donate_argnums=(1,))
         def decode_multi(params, cache_kv, input_ids, positions, block_tables,
-                         slot_keys, gen_counts, temperature, top_k, top_p):
+                         slot_keys, gen_counts, temperature, top_k, top_p,
+                         *lora):
             def body(carry, _):
                 cache, tok, pos, cnt = carry
                 logits, new_kv = self._model_cache_call(
-                    params, cache, block_tables, tok, pos
+                    params, cache, block_tables, tok, pos, *lora
                 )
                 rngs = jax.vmap(jax.random.fold_in)(slot_keys, cnt)
                 nxt, lp = sample_tokens(
@@ -634,7 +688,8 @@ class EngineExecutor:
 
         @partial(jax.jit, donate_argnums=(1,))
         def spec_decode(params, cache_kv, hist, t_in, seq_len, block_tables,
-                        slot_keys, gen_counts, temperature, top_k, top_p):
+                        slot_keys, gen_counts, temperature, top_k, top_p,
+                        *lora):
             S = t_in.shape[0]
             rows = jnp.arange(S)
             is_greedy = temperature == 0.0
@@ -647,7 +702,7 @@ class EngineExecutor:
                     [t_in[:, None], jnp.maximum(drafts, 0)], axis=1)
                 pos = seq_len[:, None] + jnp.arange(k + 1)[None, :]
                 logits, new_kv = self._model_cache_call(
-                    params, cache, block_tables, ids, pos)
+                    params, cache, block_tables, ids, pos, *lora)
                 logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
                 g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, k+1)
                 g_lp = jnp.take_along_axis(
@@ -815,6 +870,10 @@ class InferenceEngine:
         # draws don't depend on batch composition or admission order.
         self._slot_keys = np.zeros((S, 2), np.uint32)
         self._gen_counts = np.zeros((S,), np.int32)
+        # Multi-LoRA: each slot's adapter-pool row (0 = the all-zero base
+        # row). Maintained unconditionally so _state_mirrors stays
+        # uniform; without a pool it is never shipped to the device.
+        self._adapter_ids = np.zeros((S,), np.int32)
 
         # Host mirror of every slot's token history at its context
         # positions, maintained incrementally at admission/append — the
@@ -889,7 +948,9 @@ class InferenceEngine:
 
             self._state_cache = DecodeStateCache(
                 ec.max_seqs, device=self._device, mesh=mesh,
-                stats=self.stats)
+                stats=self.stats,
+                extra_fields=(("adapter_ids",)
+                              if ec.adapter_slots > 0 else ()))
 
         # Memory ledger (telemetry.memledger): the engine's owners. The
         # params and cache handles are callables because both rebind
@@ -905,6 +966,10 @@ class InferenceEngine:
             "decode_state_cache",
             lambda: (self._state_cache._dev
                      if self._state_cache is not None else None))
+        self.memledger.register(
+            "lora_adapters",
+            lambda: (self.adapter_pool.tree
+                     if self.adapter_pool is not None else None))
         if self.prefix_cache is not None:
             kv_pool_bytes = tree_nbytes(self.cache)
             per_block = kv_pool_bytes // max(1, ec.num_blocks)
@@ -949,6 +1014,10 @@ class InferenceEngine:
     @property
     def _quantized(self):
         return self.executor._quantized
+
+    @property
+    def adapter_pool(self):
+        return self.executor.adapter_pool
 
     @property
     def _prefill_fns(self):
@@ -1084,10 +1153,14 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((S,), f32),
                 jax.ShapeDtypeStruct((S,), i32),
                 jax.ShapeDtypeStruct((S,), f32))
+            if self.adapter_pool is not None:
+                state_avals += (jax.ShapeDtypeStruct((S,), i32),)
         args = (avals(self.params), avals(self.cache),
                 jax.ShapeDtypeStruct((S, 1), i32),
                 jax.ShapeDtypeStruct((S, 1), i32),
                 *state_avals)
+        if self.adapter_pool is not None:
+            args = args + (avals(self.adapter_pool.tree),)
         # Idempotent: a re-warm unwraps back to the raw jit fn (the
         # _aot_or_jit wrapper has no .lower) and rebuilds the executable.
         raw = getattr(self._decode_fn, "_jit_fn", self._decode_fn)
@@ -1114,13 +1187,19 @@ class InferenceEngine:
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
-               affinity_key: Optional[str] = None) -> Request:
+               affinity_key: Optional[str] = None,
+               adapter: str = "") -> Request:
         """Enqueue a request. Returns immediately; tokens arrive via step().
 
         ``affinity_key`` is a replica-routing concern (session/prefix
         stickiness — :meth:`ReplicatedEngine.submit`); a single engine
         has nowhere to route, so it is accepted and ignored here to keep
         the two submit surfaces interchangeable.
+
+        ``adapter`` names a catalog-registered LoRA adapter ("" = base
+        model); resolution to a pool row — including any checkpoint-store
+        load — happens at admission on the stepper thread, keeping this
+        method's thread-safety contract intact.
 
         THREAD-SAFETY CONTRACT (load-bearing): AsyncEngine runs step() on
         its stepper thread *without* holding a lock while HTTP handlers
@@ -1145,6 +1224,7 @@ class InferenceEngine:
             request_id=request_id or f"req-{next(self._req_counter)}",
             prompt_token_ids=list(prompt_token_ids),
             params=params or SamplingParams(),
+            adapter=adapter,
         )
         self.waiting.append(req)
         self.stats["requests"] += 1
@@ -1160,7 +1240,14 @@ class InferenceEngine:
         admission recomputes prompt+output exactly like re-admission after
         preemption. Same thread-safety contract as :meth:`submit` (one
         GIL-atomic deque append); ``stats["requests"]`` is NOT incremented
-        — the request was already counted at first submission."""
+        — the request was already counted at first submission.
+
+        The adapter-pool pin does NOT survive failover (the dead
+        replica's pool is gone; this engine's pool may not even hold the
+        adapter): reset to unresolved so admission re-acquires here —
+        ``req.adapter`` itself rides along, so the request finishes
+        under the same adapter it started with."""
+        req._adapter_slot = -1
         self.waiting.append(req)
 
     @property
@@ -1266,6 +1353,9 @@ class InferenceEngine:
             # without ever taking a slot or prefilling.
             while self.waiting and self.waiting[0].cancel_requested:
                 req = self.waiting.popleft()
+                # A queue-head request may hold an adapter pin from an
+                # earlier pass that then broke on block exhaustion.
+                self._release_adapter(req)
                 req.finish_reason = "stop"
                 req.finish_time = time.monotonic()
                 self.finished.append(req)
@@ -1273,12 +1363,54 @@ class InferenceEngine:
             if not self.waiting or not slot.free:
                 continue
             req = self.waiting[0]
+            # Resolve the request's adapter to a pool row BEFORE any
+            # block work: a pool-full miss leaves the request queued
+            # (FCFS, the KV-exhaustion contract), a load failure fails
+            # THIS request without touching engine state, and a hit/load
+            # pins the row until the slot releases. Idempotent across
+            # passes via the -1 sentinel (a pass that pinned the row but
+            # broke on blocks does not re-acquire).
+            if req._adapter_slot < 0:
+                if not req.adapter:
+                    req._adapter_slot = 0
+                elif self.adapter_pool is None:
+                    self.waiting.popleft()
+                    self._fail_waiting(
+                        req, f"request names adapter {req.adapter!r} but "
+                        "the engine has no adapter pool "
+                        "(adapter_slots=0)")
+                    continue
+                else:
+                    t_ad = time.monotonic()
+                    try:
+                        row, loaded = self.adapter_pool.acquire(req.adapter)
+                    except AdapterError as e:
+                        self.waiting.popleft()
+                        self._fail_waiting(req, str(e))
+                        continue
+                    if row < 0:
+                        break  # every row pinned: FCFS, retry next step
+                    req._adapter_slot = row
+                    if loaded:
+                        # A pool-miss load is restore work on THIS
+                        # request's critical path (telemetry.ledger) —
+                        # same phase as a tier restore, and visibly NOT
+                        # queueing or prefill.
+                        now = time.monotonic()
+                        req.restore_s += now - t_ad
+                        self._tracer.complete(
+                            "engine/adapter_load", t_ad, now, cat="engine",
+                            id=req.request_id, adapter=req.adapter)
             tokens = req.prompt_token_ids + req.output_token_ids
             cached_blocks: List[int] = []
             n_cached = 0
             tier_keys: List[tuple] = []
             if self.prefix_cache is not None:
-                cached_blocks, n_cached = self.prefix_cache.match_prefix(tokens)
+                # Chain keys are namespaced by the request's adapter: the
+                # same prompt under two adapters produces different KV,
+                # so cross-adapter block reuse would be silent corruption.
+                cached_blocks, n_cached = self.prefix_cache.match_prefix(
+                    tokens, ns=req.adapter or None)
                 # Pin the matched blocks BEFORE allocating the suffix —
                 # otherwise the allocation's own eviction could reclaim them.
                 self.prefix_cache.acquire(cached_blocks)
@@ -1286,7 +1418,7 @@ class InferenceEngine:
                 # payloads restore into freshly allocated blocks below
                 # (a restore scatter instead of a re-prefill).
                 tier_keys = self.prefix_cache.match_tiers(
-                    tokens, len(cached_blocks))
+                    tokens, len(cached_blocks), ns=req.adapter or None)
             need = (self.block_manager.blocks_needed(len(tokens) + 1)
                     - len(cached_blocks))
             blocks = self._alloc(need)
@@ -1408,6 +1540,10 @@ class InferenceEngine:
         # Count of tokens generated so far (nonzero on re-admission after
         # preemption, so the seeded draw stream continues where it left off).
         self._gen_counts[slot.slot_id] = len(req.output_token_ids)
+        # max(.., 0): requests that never resolved a pool row (no pool,
+        # handoff adoption of a base request) decode under row 0, the
+        # all-zero base adapter.
+        self._adapter_ids[slot.slot_id] = max(req._adapter_slot, 0)
         self._mark_state_dirty(slot.slot_id)
         if self._spec_hist is not None:
             ctx = req.prompt_token_ids + req.output_token_ids
@@ -1479,9 +1615,15 @@ class InferenceEngine:
 
         if bucket not in self._prefill_fns:
             self._prefill_fns[bucket] = self._build_prefill_fn(bucket)
+        lora_args = ()
+        if self.adapter_pool is not None:
+            ad = np.zeros((B,), np.int32)
+            for r, (slot, *_rest) in enumerate(chunks):
+                ad[r] = self._adapter_ids[slot.slot_id]
+            lora_args = (jnp.asarray(ad), self.adapter_pool.tree)
         self.cache, last_logits = self._prefill_fns[bucket](
             self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
-            jnp.asarray(bt), jnp.asarray(last_idx),
+            jnp.asarray(bt), jnp.asarray(last_idx), *lora_args,
         )
         if not any(is_last for *_, is_last in chunks):
             return  # mid-prompt chunks: KV writes only, nothing to sample
@@ -1527,7 +1669,8 @@ class InferenceEngine:
                 "slot_keys": self._slot_keys,
                 "gen_counts": self._gen_counts,
                 "temperature": self._temperature,
-                "top_k": self._top_k, "top_p": self._top_p}
+                "top_k": self._top_k, "top_p": self._top_p,
+                "adapter_ids": self._adapter_ids}
 
     def _masked_rows(self) -> list:
         return [s.slot_id for s in self.slots if s.prefilling]
@@ -1657,8 +1800,15 @@ class InferenceEngine:
                 jnp.asarray(self._temperature), jnp.asarray(self._top_k),
                 jnp.asarray(self._top_p),
             )
+            if self.adapter_pool is not None:
+                state_args += (jnp.asarray(self._adapter_ids),)
         args = (self.params, self.cache, jnp.asarray(ids), jnp.asarray(pos),
                 *state_args)
+        if self.adapter_pool is not None:
+            # The pool tree rides LAST; NOT donated — an in-flight async
+            # window may still read the previous buffers, and a one-row
+            # scatter (acquire miss) rebinds pool.tree between windows.
+            args = args + (self.adapter_pool.tree,)
         # Host prep cost of this dispatch (batch assembly + state sync) —
         # the term dirty tracking is meant to hold flat as max_seqs grows.
         self.telemetry.host_prep.observe(time.perf_counter() - t_prep)
@@ -1780,13 +1930,17 @@ class InferenceEngine:
         while width < nblk:
             width *= 2
         width = min(width, ec.max_blocks_per_seq)
+        lora_args = ()
+        if self.adapter_pool is not None:
+            lora_args = (jnp.asarray(self._adapter_ids),
+                         self.adapter_pool.tree)
         self.cache, toks, lps, emit, prop, acc = self._spec_fn(
             self.params, self.cache, jnp.asarray(self._spec_hist), jnp.asarray(t_in),
             jnp.asarray(seq_len),
             jnp.asarray(self._decode_block_tables()[:, :width]),
             jnp.asarray(self._slot_keys), jnp.asarray(self._gen_counts),
             jnp.asarray(self._temperature), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
+            jnp.asarray(self._top_p), *lora_args,
         )
         return ("spec", active, toks, lps, emit, prop, acc)
 
@@ -1884,6 +2038,26 @@ class InferenceEngine:
             return True
         return False
 
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's adapter-pool pin and reset it to unresolved
+        (idempotent). Preemption and failover re-acquire at re-admission
+        — the row may legitimately be LRU-evicted in between."""
+        if self.adapter_pool is not None and req._adapter_slot > 0:
+            self.adapter_pool.release(req._adapter_slot)
+        req._adapter_slot = -1
+
+    def _fail_waiting(self, req: Request, msg: str) -> None:
+        """Finish a not-yet-admitted request as an error (unknown or
+        corrupt adapter): strictly request-scoped — the engine, its
+        slots, and the rest of the queue are untouched."""
+        self.logger.warning("request %s failed at admission: %s",
+                            req.request_id, msg)
+        self._release_adapter(req)
+        req.finish_reason = "error"
+        req.finish_time = time.monotonic()
+        self.finished.append(req)
+        self.telemetry.on_finished(req)
+
     def _release(self, slot: _Slot, register: bool = True) -> None:
         if self.prefix_cache is not None and slot.request is not None:
             # Register the written full blocks for reuse (shared blocks get
@@ -1898,9 +2072,12 @@ class InferenceEngine:
             n_written = slot.next_pos if slot.prefilling else slot.seq_len
             written = ((req.prompt_token_ids + req.output_token_ids)[:n_written]
                        if register else [])
-            self.prefix_cache.release_sequence(written, slot.blocks)
+            self.prefix_cache.release_sequence(written, slot.blocks,
+                                               ns=req.adapter or None)
         else:
             self.block_manager.free(slot.blocks)
+        if slot.request is not None:
+            self._release_adapter(slot.request)
         slot.request = None
         slot.blocks = []
         slot.seq_len = 0
@@ -1912,6 +2089,7 @@ class InferenceEngine:
         self._top_p[slot.slot_id] = 1.0
         self._slot_keys[slot.slot_id] = 0
         self._gen_counts[slot.slot_id] = 0
+        self._adapter_ids[slot.slot_id] = 0
         self._mark_state_dirty(slot.slot_id)
 
     # ------------------------------------------------------------------
@@ -1963,6 +2141,21 @@ class InferenceEngine:
         if slot is None:
             return False
         req = snap["request"]
+        # Re-pin the request's adapter on THIS engine's pool before
+        # consuming anything: the origin pin died with the origin slot.
+        # Busy pool or load failure → False, nothing consumed — the
+        # caller retries or degrades to a re-prefill, where _admit's
+        # resolution path owns failing the request properly.
+        if req.adapter and req._adapter_slot < 0:
+            if self.adapter_pool is None:
+                return False
+            try:
+                row, _ = self.adapter_pool.acquire(req.adapter)
+            except AdapterError:
+                return False
+            if row < 0:
+                return False
+            req._adapter_slot = row
         seq_len = snap["seq_len"]
         # +1: the first decode step writes KV at position seq_len.
         blocks = self._alloc(self.block_manager.blocks_needed(seq_len + 1))
@@ -1986,6 +2179,7 @@ class InferenceEngine:
         self._top_p[slot.slot_id] = req.params.top_p
         self._slot_keys[slot.slot_id] = snap["slot_key"]
         self._gen_counts[slot.slot_id] = snap["gen_count"]
+        self._adapter_ids[slot.slot_id] = max(req._adapter_slot, 0)
         self._mark_state_dirty(slot.slot_id)
         if self._spec_hist is not None:
             ctx = req.prompt_token_ids + req.output_token_ids
@@ -2017,6 +2211,9 @@ class InferenceEngine:
                 self._release(slot, register=False)
         while self.waiting:
             req = self.waiting.popleft()
+            # A queue-head request may hold an adapter pin (resolution
+            # happened, block allocation then broke the pass).
+            self._release_adapter(req)
             req.finish_reason = reason
             req.finish_time = time.monotonic()
             aborted.append(req)

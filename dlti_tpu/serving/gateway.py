@@ -139,6 +139,9 @@ class _Pending:
     # Session/prefix stickiness key for cache-affinity replica routing
     # (None = least-loaded dispatch, the legacy behavior).
     affinity_key: Optional[str] = None
+    # Resolved LoRA adapter name ("" = base model) — X-Adapter header,
+    # else the tenant's --gateway-adapter-map entry.
+    adapter: str = ""
     enqueue_t: float = field(default_factory=time.monotonic)
 
 
@@ -177,18 +180,43 @@ def tenant_from_headers(headers, default: str = "default") -> str:
 
 
 def affinity_key_from(headers, prompt_token_ids,
-                      prefix_tokens: int = 32) -> str:
+                      prefix_tokens: int = 32, adapter: str = "") -> str:
     """Session/prefix key for cache-affinity replica routing.
 
     ``X-Session`` wins (a chat client naming its conversation); else a
     stable digest of the prompt's first ``prefix_tokens`` token ids — so
     even session-less clients sharing a system prompt land on the replica
-    whose prefix cache already holds it."""
+    whose prefix cache already holds it.
+
+    ``adapter`` is mixed into BOTH branches: prefix-cache block chains
+    are namespaced per adapter (the same prompt under different adapters
+    produces different KV), so routing two adapters' identical prompts
+    to one replica's cache would never hit anyway — better to land each
+    adapter where ITS blocks (and its pool row) already live. Empty
+    adapter keeps the legacy keys byte-identical."""
     sess = headers.get("X-Session") if headers is not None else None
+    tag = f"@{adapter}" if adapter else ""
     if sess:
-        return "sess-" + sess.strip()
+        return "sess-" + sess.strip() + tag
     ids = list(prompt_token_ids[:max(1, prefix_tokens)])
-    return "pfx-" + hashlib.sha256(repr(ids).encode()).hexdigest()[:16]
+    return "pfx-" + hashlib.sha256(
+        (repr(ids) + tag).encode()).hexdigest()[:16]
+
+
+def parse_adapter_map(spec: str) -> Dict[str, str]:
+    """"tenantA:ad1,tenantB:ad2" -> {"tenantA": "ad1", ...}: tenant →
+    adapter routing for requests that carry no ``X-Adapter`` header."""
+    out: Dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, ad = part.partition(":")
+        if not sep or not name.strip() or not ad.strip():
+            raise ValueError(f"bad adapter mapping {part!r} "
+                             "(expected tenant:adapter)")
+        out[name.strip()] = ad.strip()
+    return out
 
 
 def parse_tenant_weights(spec: str) -> Dict[str, float]:
@@ -220,6 +248,8 @@ class AdmissionGateway:
         self.logger = get_logger()
         self._tracer = async_engine.engine.telemetry.tracer
         self._weights = parse_tenant_weights(cfg.tenant_weights)
+        self._adapter_map = parse_adapter_map(
+            getattr(cfg, "adapter_map", ""))
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # Per-class, per-tenant FIFO queues + stride-scheduling state.
@@ -281,21 +311,42 @@ class AdmissionGateway:
     def draining(self) -> bool:
         return self._draining
 
+    def adapter_for(self, tenant: str) -> str:
+        """The tenant's configured LoRA adapter (``adapter_map``); ""
+        routes to the base model. An ``X-Adapter`` header overrides."""
+        return self._adapter_map.get(tenant, "")
+
     # -- admission ------------------------------------------------------
     def submit(self, prompt_token_ids, params: SamplingParams,
                request_id: str, *, tenant: Optional[str] = None,
                priority: str = "interactive",
                deadline_s: float = 0.0,
                affinity_key: Optional[str] = None,
+               adapter: str = "",
                ) -> Tuple[GatewayRequest, queue.Queue]:
         """Admit or refuse synchronously. Returns ``(handle, event_queue)``
         — same event protocol as ``AsyncEngine.submit`` plus the terminal
         ``("reject", status, message)`` for post-admission sheds. Raises
-        :class:`AdmissionError` on refusal (429 bounds/rate, 503 drain)."""
+        :class:`AdmissionError` on refusal (429 bounds/rate, 503 drain,
+        404 unknown adapter)."""
         tenant = tenant or self.cfg.default_tenant
         if priority not in PRIORITIES:
             raise AdmissionError(
                 400, f"priority must be one of {PRIORITIES}, got {priority!r}")
+        if not adapter:
+            adapter = self._adapter_map.get(tenant, "")
+        if adapter:
+            # Routing-time validation against the process-global catalog:
+            # an unknown adapter is the CLIENT's error (404 here) — it
+            # must never reach the engine, whose only recourse would be
+            # failing the request after it burned a queue slot.
+            from dlti_tpu.serving.adapters import get_catalog
+
+            if adapter not in get_catalog():
+                self._reject("unknown_adapter", tenant=tenant)
+                raise AdmissionError(
+                    404, f"unknown adapter {adapter!r}: register it via "
+                         f"POST /v1/adapters first")
         n_tokens = len(prompt_token_ids)
         with self._cond:
             if self._draining or self._stop:
@@ -338,7 +389,7 @@ class AdmissionGateway:
                 priority=priority,
                 deadline=(time.monotonic() + deadline_s
                           if deadline_s and deadline_s > 0 else None),
-                affinity_key=affinity_key)
+                affinity_key=affinity_key, adapter=adapter)
             dq = self._queues[priority].setdefault(tenant, collections.deque())
             if not dq:
                 # (Re)activating tenant: sync its virtual time to the
@@ -448,6 +499,8 @@ class AdmissionGateway:
                 # facades predating it keep working with affinity off.
                 kw = ({"affinity_key": entry.affinity_key}
                       if entry.affinity_key else {})
+                if entry.adapter:
+                    kw["adapter"] = entry.adapter
                 req, _ = self.async_engine.submit(
                     entry.handle.prompt_token_ids, entry.handle.params,
                     entry.handle.request_id, q=entry.q, **kw)

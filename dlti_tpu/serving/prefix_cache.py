@@ -110,34 +110,45 @@ class PrefixCachingAllocator:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _chain_keys(tokens: Sequence[int], block_size: int) -> List[tuple]:
-        """Content key for each full block of ``tokens``."""
-        keys, prev = [], ()
+    def _chain_keys(tokens: Sequence[int], block_size: int,
+                    ns: Optional[str] = None) -> List[tuple]:
+        """Content key for each full block of ``tokens``.
+
+        ``ns`` namespaces the whole chain (multi-LoRA serving: a block's
+        KV is a function of the *adapter* as well as the token chain, so
+        the same prompt under different adapters must never alias). The
+        namespace seeds the chain's root key; ``None``/"" produces the
+        legacy keys byte-identical, so adapter-off engines and base
+        requests share one namespace."""
+        keys, prev = [], (() if not ns else ("adapter", ns))
         for i in range(len(tokens) // block_size):
             prev = (prev, tuple(tokens[i * block_size:(i + 1) * block_size]))
             keys.append(prev)
         return keys
 
     # ------------------------------------------------------------------
-    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+    def match_prefix(self, tokens: Sequence[int],
+                     ns: Optional[str] = None) -> Tuple[List[int], int]:
         """Longest cached chain of full blocks covering a prefix of
         ``tokens``; at most ``len(tokens) - 1`` tokens match so prefill
         always has at least one token to process (its logits produce the
         next token). Pure lookup (no stats, no refcounts) — admission may
-        be retried many times before it succeeds. Returns
+        be retried many times before it succeeds. ``ns`` is the adapter
+        namespace (see :meth:`_chain_keys`). Returns
         (block_ids, n_tokens_covered).
         """
         usable = len(tokens) - 1
         blocks: List[int] = []
         for key in self._chain_keys(tokens[:usable] if usable > 0 else [],
-                                    self.block_size):
+                                    self.block_size, ns):
             entry = self._by_key.get(key)
             if entry is None:
                 break
             blocks.append(entry.block)
         return blocks, len(blocks) * self.block_size
 
-    def match_tiers(self, tokens: Sequence[int], start_block: int) -> List[tuple]:
+    def match_tiers(self, tokens: Sequence[int], start_block: int,
+                    ns: Optional[str] = None) -> List[tuple]:
         """Continue a :meth:`match_prefix` chain into the lower tiers:
         chain keys for blocks ``start_block, start_block+1, ...`` that the
         tier store *indexes* (a disk entry may still fail verification at
@@ -146,7 +157,7 @@ class PrefixCachingAllocator:
             return []
         usable = len(tokens) - 1
         keys = self._chain_keys(tokens[:usable] if usable > 0 else [],
-                                self.block_size)
+                                self.block_size, ns)
         out: List[tuple] = []
         for key in keys[start_block:]:
             if self.tier_store.tier_of(key) is None:
@@ -299,15 +310,18 @@ class PrefixCachingAllocator:
 
     # ------------------------------------------------------------------
     def release_sequence(self, tokens: Sequence[int],
-                         blocks: List[int]) -> None:
+                         blocks: List[int],
+                         ns: Optional[str] = None) -> None:
         """Return a retiring sequence's blocks.
 
         Full blocks are registered for reuse (or deduplicated against an
         existing registration); partial/extra blocks go straight back to
         the allocator. ``blocks[i]`` must hold tokens
-        ``tokens[i*bs:(i+1)*bs]``.
+        ``tokens[i*bs:(i+1)*bs]`` — computed under the same ``ns`` the
+        sequence matched with, or cross-adapter aliasing serves one
+        adapter's KV to another.
         """
-        keys = self._chain_keys(tokens, self.block_size)
+        keys = self._chain_keys(tokens, self.block_size, ns)
         for i, block in enumerate(blocks):
             entry = self._by_block.get(block)
             if entry is not None:

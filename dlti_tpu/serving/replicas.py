@@ -199,11 +199,18 @@ class ReplicatedEngine:
     def submit(self, prompt_token_ids: Sequence[int],
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
-               affinity_key: Optional[str] = None) -> Request:
+               affinity_key: Optional[str] = None,
+               adapter: str = "") -> Request:
         """Dispatch to the least-loaded live replica (round-robin
         tiebreak) — or, with an ``affinity_key``, to its sticky
         rendezvous-hash target unless that replica's backlog exceeds its
-        decode slots by more than ``affinity_spill_threshold``."""
+        decode slots by more than ``affinity_spill_threshold``.
+
+        ``adapter`` names a registered LoRA adapter; the catalog is
+        process-global, so any replica can resolve it (each replica pins
+        it into its own pool at admission). On failover the adapter name
+        rides the Request — the survivor re-acquires from its own pool.
+        """
         live = self.live_engines()
         if not live:
             raise RuntimeError("all replicas dead (step faults); "
@@ -224,7 +231,8 @@ class ReplicatedEngine:
             eng = min(order, key=self._load)
         if request_id is None:
             request_id = f"rep-req-{next(self._req_counter)}"
-        req = eng.submit(prompt_token_ids, params, request_id)
+        req = eng.submit(prompt_token_ids, params, request_id,
+                         **({"adapter": adapter} if adapter else {}))
         req.replica = self.engines.index(eng)
         return req
 

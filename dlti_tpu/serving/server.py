@@ -107,6 +107,15 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
                    _pc.promotions_total, _pc.demotions_total,
                    _pc.blocks_gauge):
         registry.register(metric)
+    # Multi-LoRA adapter pool telemetry (serving.adapters): module-level
+    # like the prefix-cache counters — pool load/evict/hit/miss counters
+    # plus the slot/byte gauges, one series across replicas.
+    from dlti_tpu.serving import adapters as _ad
+
+    for metric in (_ad.loads_total, _ad.evictions_total,
+                   _ad.pool_hits_total, _ad.pool_misses_total,
+                   _ad.pool_slots_gauge, _ad.pool_bytes_gauge):
+        registry.register(metric)
 
     def _prefix_hit_rate() -> dict:
         # Derived hit-rate gauge so /dashboard gets a ready-made series
@@ -219,6 +228,7 @@ class AsyncEngine:
                request_id: Optional[str] = None,
                q: Optional[queue.Queue] = None,
                affinity_key: Optional[str] = None,
+               adapter: str = "",
                ) -> Tuple[Request, queue.Queue]:
         """Enqueue a request; returns (request, event queue).
 
@@ -228,7 +238,8 @@ class AsyncEngine:
         admission gateway hands it to the HTTP handler before dispatch)
         receive events on its own instance. ``affinity_key`` rides through
         to the engine's submit (session/prefix replica stickiness — a
-        no-op on a single engine).
+        no-op on a single engine); ``adapter`` names the LoRA adapter the
+        request decodes under ("" = shared base).
         """
         q = q if q is not None else queue.Queue()
         with self._work:
@@ -237,7 +248,8 @@ class AsyncEngine:
                     "engine is down (unrecoverable step fault)")
             req = self.engine.submit(
                 prompt_ids, params, request_id,
-                **({"affinity_key": affinity_key} if affinity_key else {}))
+                **({"affinity_key": affinity_key} if affinity_key else {}),
+                **({"adapter": adapter} if adapter else {}))
             self._queues[req.request_id] = q
             self._seen[req.request_id] = 0
             self._work.notify()
@@ -571,6 +583,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "id": self.cfg.model_name, "object": "model",
                 "owned_by": "dlti_tpu",
             }]})
+        elif self.path == "/v1/adapters":
+            # Registered adapter names (process-global catalog) — what a
+            # client may put in X-Adapter right now.
+            from dlti_tpu.serving.adapters import get_catalog
+
+            self._json(200, {"object": "list",
+                             "data": get_catalog().names()})
         else:
             self._error(404, f"no route {self.path}")
 
@@ -579,10 +598,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._completions(chat=False)
         elif self.path == "/v1/chat/completions":
             self._completions(chat=True)
+        elif self.path == "/v1/adapters":
+            self._register_adapter()
         elif self.path == "/debug/profile":
             self._profile()
         else:
             self._error(404, f"no route {self.path}")
+
+    def _register_adapter(self) -> None:
+        """Hot-register a trained adapter checkpoint with zero restart:
+        ``POST /v1/adapters {"name": n, "directory": d}``. The directory
+        is digest-verified through the checkpoint store before the name
+        exists; a corrupt checkpoint is quarantined and 400s here — the
+        name stays unknown, so completions keep 404ing it."""
+        body = self._read_body()
+        if body is None:
+            return
+        name = str(body.get("name", "") or "")
+        directory = str(body.get("directory", "") or "")
+        if not name or not directory:
+            return self._error(400, "name and directory are required")
+        from dlti_tpu.serving.adapters import AdapterError, register_adapter
+
+        try:
+            register_adapter(name, directory)
+        except AdapterError as e:
+            return self._error(400, str(e))
+        self._json(200, {"object": "adapter", "name": name,
+                         "directory": directory})
 
     def _profile(self) -> None:
         """On-demand ``jax.profiler`` capture around the live engine:
@@ -671,6 +714,19 @@ class _Handler(BaseHTTPRequestHandler):
                      "top_k=1) would return n identical choices; relax the "
                      "sampling or drop n")
 
+        # Multi-LoRA routing: X-Adapter header first (works with AND
+        # without a gateway), else the gateway's tenant→adapter map.
+        # Unknown names 404 HERE, before any queue/slot is consumed —
+        # the engine only ever sees catalog-registered adapters.
+        adapter = str(self.headers.get("X-Adapter", "") or "").strip()
+        if adapter:
+            from dlti_tpu.serving.adapters import get_catalog
+
+            if adapter not in get_catalog():
+                return self._error(
+                    404, f"unknown adapter {adapter!r}: register it via "
+                         "POST /v1/adapters first")
+
         # Admission metadata (gateway only): tenant from headers, priority
         # class + queued-deadline from the body. Validated before submit so
         # a bad value 400s this request, same contract as sampling params.
@@ -690,20 +746,28 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline_s = float(body.get("deadline_s", 0) or 0)
             except (TypeError, ValueError):
                 return self._error(400, "deadline_s must be a number")
+            if not adapter:
+                adapter = self.gateway.adapter_for(tenant)
             if self.gateway.cfg.affinity:
                 # Cache-affinity routing: a session (X-Session) or
                 # hashed prompt-prefix key makes repeat traffic land on
-                # the replica whose prefix cache is already warm.
+                # the replica whose prefix cache is already warm. The
+                # adapter id is part of the key: adapter A's warm KV is
+                # useless to adapter B.
                 affinity_key = affinity_key_from(
                     self.headers, prompt_ids,
-                    self.gateway.cfg.affinity_prefix_tokens)
+                    self.gateway.cfg.affinity_prefix_tokens,
+                    adapter=adapter)
 
         def _submit(p_ids, p, rid_):
             if self.gateway is not None:
                 return self.gateway.submit(
                     p_ids, p, rid_, tenant=tenant, priority=priority,
-                    deadline_s=deadline_s, affinity_key=affinity_key)
-            return self.async_engine.submit(p_ids, p, rid_)
+                    deadline_s=deadline_s, affinity_key=affinity_key,
+                    adapter=adapter)
+            return self.async_engine.submit(
+                p_ids, p, rid_,
+                **({"adapter": adapter} if adapter else {}))
 
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())

@@ -67,6 +67,7 @@ MEMORY_OWNERS = (
     "decode_state_cache",
     "prefetch_buffers",
     "kv_handoff_staging",  # disagg: host-staged prefill→decode KV payloads
+    "lora_adapters",      # multi-LoRA serving: the stacked A/B adapter pool
     "chaos_balloon",      # the hbm-squeeze injector, visible by design
 )
 

@@ -80,6 +80,17 @@ def main() -> None:
     p.add_argument("--long-prompt-tokens", type=int, default=512,
                    help="synthetic long-prompt length in tokens (exact "
                         "under the byte tokenizer)")
+    p.add_argument("--adapters", type=int, default=0,
+                   help="multi-LoRA mode: tag requests with an X-Adapter "
+                        "header drawn from N names 'adapter-0'..'adapter-"
+                        "N-1' (register them server-side first); the "
+                        "report adds per-adapter TTFT/TPOT percentiles "
+                        "and the scraped adapter-pool hit rate")
+    p.add_argument("--adapter-mix", default="zipf",
+                   choices=["zipf", "uniform"],
+                   help="adapter draw: zipf (1/(i+1) skew — hot adapters "
+                        "stay pool-resident, the tail exercises eviction) "
+                        "or uniform")
     p.add_argument("--scrape-server-metrics", action="store_true",
                    help="attach the server's on-engine histogram "
                         "summaries (/metrics) to the report")
@@ -107,6 +118,7 @@ def main() -> None:
         reuse_frac=args.reuse_frac,
         long_prompt_frac=args.long_prompt_frac,
         long_prompt_tokens=args.long_prompt_tokens,
+        adapters=args.adapters, adapter_mix=args.adapter_mix,
     )
     report = run_load_test(cfg)
     d = report.to_dict()

@@ -77,6 +77,43 @@ def lora_trainable_params(cfg: ModelConfig, r: int = 16,
     return cfg.num_layers * per_layer
 
 
+def _proj_dims(cfg: ModelConfig) -> dict:
+    """(in, out) of every LoRA-targetable projection — the same dims the
+    engine's AdapterPool walks off the live param tree."""
+    h = cfg.hidden_size
+    hd = cfg.resolved_head_dim
+    inter = cfg.intermediate_size
+    return {
+        "q_proj": (h, cfg.num_heads * hd),
+        "k_proj": (h, cfg.num_kv_heads * hd),
+        "v_proj": (h, cfg.num_kv_heads * hd),
+        "o_proj": (cfg.num_heads * hd, h),
+        "gate_proj": (h, inter),
+        "up_proj": (h, inter),
+        "down_proj": (inter, h),
+    }
+
+
+def adapter_pool_bytes(cfg: ModelConfig, num_slots: int, rank: int = 16,
+                       targets: tuple = ("q_proj", "k_proj",
+                                         "v_proj", "o_proj")) -> int:
+    """HBM the stacked multi-LoRA adapter pool pins: per layer and target,
+    f32 A (P, in, r) + B (P, r, out) + scale (P,) with P = num_slots + 1
+    (row 0 is the all-zero base row). Must equal
+    ``dlti_tpu.serving.adapters.plan_pool_bytes`` — cross-checked against
+    it AND the measured ``lora_adapters`` ledger owner in tier-1."""
+    if num_slots <= 0:
+        return 0
+    dims = _proj_dims(cfg)
+    unknown = [t for t in targets if t not in dims]
+    if unknown:
+        raise ValueError(f"unknown adapter targets {unknown}; "
+                         f"one of {sorted(dims)}")
+    per_row = sum(dims[t][0] * rank + rank * dims[t][1] + 1
+                  for t in targets)
+    return (num_slots + 1) * cfg.num_layers * per_row * 4
+
+
 def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str = "bfloat16") -> int:
     """K + V bytes one token holds resident across all layers."""
     return (2 * cfg.num_layers * cfg.num_kv_heads * cfg.resolved_head_dim
@@ -116,10 +153,14 @@ def plan_training(cfg: ModelConfig, param_dtype: Optional[str] = None,
 def plan_serving(cfg: ModelConfig, param_dtype: Optional[str] = None,
                  kv_dtype: str = "bfloat16", num_blocks: int = 256,
                  block_size: int = 16, max_model_len: int = 0,
-                 budget_bytes: int = 0) -> dict:
+                 budget_bytes: int = 0, adapter_slots: int = 0,
+                 adapter_rank: int = 16,
+                 adapter_targets: tuple = ("q_proj", "k_proj",
+                                           "v_proj", "o_proj")) -> dict:
     """Owner-bucket prediction for one engine replica: the KV pool is
     pre-allocated at init (engine.py), so its full size is resident from
-    the first request."""
+    the first request — and so is the multi-LoRA adapter pool when
+    ``adapter_slots`` > 0 (hot-loads scatter into it; it never grows)."""
     pbytes = _dtype_bytes(param_dtype or cfg.param_dtype)
     n = cfg.num_params()
     per_tok = kv_bytes_per_token(cfg, kv_dtype)
@@ -127,6 +168,9 @@ def plan_serving(cfg: ModelConfig, param_dtype: Optional[str] = None,
         "params": n * pbytes,
         "kv_block_pool": per_tok * block_size * num_blocks,
     }
+    if adapter_slots > 0:
+        owners["lora_adapters"] = adapter_pool_bytes(
+            cfg, adapter_slots, adapter_rank, adapter_targets)
     total = sum(owners.values())
     max_len = max_model_len or cfg.max_seq_len
     out = {
@@ -187,6 +231,15 @@ def main() -> None:
     ap.add_argument("--lora-r", type=int, default=0,
                     help="LoRA rank: trainable = adapters only "
                          "(0 = full fine-tune)")
+    ap.add_argument("--adapter-slots", type=int, default=0,
+                    help="multi-LoRA serving pool slots (engine "
+                         "--adapter-slots); adds the lora_adapters owner "
+                         "(0 = off)")
+    ap.add_argument("--adapter-rank", type=int, default=16,
+                    help="pool rank ceiling (engine --adapter-rank)")
+    ap.add_argument("--adapter-targets",
+                    default="q_proj,k_proj,v_proj,o_proj",
+                    help="comma-separated targeted projections")
     ap.add_argument("--budget-gb", type=float, default=0.0,
                     help="HBM budget to check the plan against")
     ap.add_argument("--json", action="store_true")
@@ -199,7 +252,12 @@ def main() -> None:
                          kv_dtype=args.kv_dtype, num_blocks=args.num_blocks,
                          block_size=args.block_size,
                          max_model_len=args.max_model_len,
-                         budget_bytes=budget)
+                         budget_bytes=budget,
+                         adapter_slots=args.adapter_slots,
+                         adapter_rank=args.adapter_rank,
+                         adapter_targets=tuple(
+                             t.strip() for t in
+                             args.adapter_targets.split(",") if t.strip()))
     else:
         trainable = (lora_trainable_params(cfg, r=args.lora_r)
                      if args.lora_r else None)

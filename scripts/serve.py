@@ -170,6 +170,27 @@ def parse_args():
     p.add_argument("--affinity-prefix-tokens", type=int, default=32,
                    help="prompt tokens hashed into the affinity key when "
                         "no X-Session header is present")
+    # -- multi-LoRA serving (dlti_tpu.serving.adapters) -----------------
+    p.add_argument("--adapter-slots", type=int, default=0,
+                   help="HBM adapter-pool slots: one decode batch serves "
+                        "up to this many distinct LoRA adapters over ONE "
+                        "shared base (S-LoRA-style gathered einsum); "
+                        "0 = multi-LoRA off, engine traces identically to "
+                        "an adapter-free build")
+    p.add_argument("--adapter-rank", type=int, default=16,
+                   help="pool rank ceiling R: registered adapters of rank "
+                        "<= R zero-pad into the stacked pool (float-exact)")
+    p.add_argument("--adapter", action="append", default=[],
+                   metavar="NAME=DIR",
+                   help="register adapter NAME from checkpoint-store DIR "
+                        "(scripts/train.py --export-adapter-dir / "
+                        "save_adapter) at startup; repeatable. More can "
+                        "hot-load later via POST /v1/adapters")
+    p.add_argument("--adapter-map", default="",
+                   help="tenant->adapter routing, e.g. "
+                        "'teamA:ad1,teamB:ad2': requests without an "
+                        "explicit X-Adapter header get their tenant's "
+                        "adapter (needs --gateway; X-Adapter always works)")
     p.add_argument("--steps-per-sync", type=int, default=1,
                    help="decode iterations per compiled program (multi-step "
                         "scheduling; amortizes host round-trips)")
@@ -315,7 +336,23 @@ def main() -> None:
         memory_ledger=not args.no_memory_ledger,
         hbm_budget_bytes=args.hbm_budget_bytes,
         admit_min_headroom_frac=args.admit_min_headroom_frac,
+        adapter_slots=args.adapter_slots,
+        adapter_rank=args.adapter_rank,
     )
+    if args.adapter:
+        # Register BEFORE the engines are built: verification (manifest
+        # digests) fails fast on a corrupt directory at startup, and the
+        # catalog is process-global so every replica resolves the names.
+        from dlti_tpu.serving.adapters import register_adapter
+
+        if args.adapter_slots <= 0:
+            raise SystemExit("--adapter needs --adapter-slots > 0")
+        for spec in args.adapter:
+            name, sep, adir = spec.partition("=")
+            if not sep or not name.strip() or not adir.strip():
+                raise SystemExit(f"--adapter expects NAME=DIR, got {spec!r}")
+            register_adapter(name.strip(), adir.strip())
+            print(f"registered adapter {name.strip()!r} from {adir.strip()}")
     if args.disagg:
         from dlti_tpu.serving import DisaggController
 
@@ -368,7 +405,8 @@ def main() -> None:
             fault_inject_step=args.fault_inject_step,
             affinity=args.affinity,
             affinity_spill_threshold=args.affinity_spill_threshold,
-            affinity_prefix_tokens=args.affinity_prefix_tokens)
+            affinity_prefix_tokens=args.affinity_prefix_tokens,
+            adapter_map=args.adapter_map)
     from dlti_tpu.config import (
         FlightRecorderConfig, TelemetryConfig, WatchdogConfig,
     )

@@ -312,8 +312,32 @@ def test_memledger_metric_names_are_schema_stable():
     assert memledger.MEMORY_OWNERS == (
         "params", "optimizer_state", "grad_buffers", "kv_block_pool",
         "prefix_cache_hbm", "decode_state_cache", "prefetch_buffers",
-        "kv_handoff_staging", "chaos_balloon",
+        "kv_handoff_staging", "lora_adapters", "chaos_balloon",
     )
+
+
+def test_adapter_metric_names_are_schema_stable():
+    """Multi-LoRA serving telemetry names are a scrape contract like the
+    prefix-cache set: adapter load/evict counters, pool hit/miss
+    counters, and the pool slot/byte gauges, all registered by the
+    server registry."""
+    from dlti_tpu.serving import adapters
+
+    assert adapters.ADAPTER_METRIC_NAMES == (
+        "dlti_adapter_loads_total",
+        "dlti_adapter_evictions_total",
+        "dlti_adapter_pool_hits_total",
+        "dlti_adapter_pool_misses_total",
+        "dlti_adapter_pool_slots",
+        "dlti_adapter_pool_bytes",
+    )
+    assert adapters.loads_total.name == adapters.ADAPTER_METRIC_NAMES[0]
+    assert adapters.evictions_total.name == adapters.ADAPTER_METRIC_NAMES[1]
+    assert adapters.pool_hits_total.name == adapters.ADAPTER_METRIC_NAMES[2]
+    assert adapters.pool_misses_total.name == \
+        adapters.ADAPTER_METRIC_NAMES[3]
+    assert adapters.pool_slots_gauge.name == adapters.ADAPTER_METRIC_NAMES[4]
+    assert adapters.pool_bytes_gauge.name == adapters.ADAPTER_METRIC_NAMES[5]
 
 
 def test_disagg_metric_names_are_schema_stable():
@@ -436,6 +460,9 @@ def test_load_report_schema_includes_gateway_fields():
         # Disaggregation era: mixed-interference mode's decode-TPOT split
         # by concurrent-long-prefill overlap.
         "interference",
+        # Multi-LoRA era: per-adapter latency breakdown + the
+        # server-scraped adapter-pool hit rate.
+        "per_adapter", "adapter_pool_hit_rate",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -35,6 +35,7 @@ def _assert_convention(names, where):
 def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
+    from dlti_tpu.serving.adapters import ADAPTER_METRIC_NAMES
     from dlti_tpu.serving.disagg import (
         KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
     )
@@ -65,16 +66,21 @@ def test_pinned_name_tuples_follow_convention():
                        (MEMLEDGER_METRIC_NAMES, "memledger"),
                        (HEARTBEAT_METRIC_NAMES, "heartbeat"),
                        (POOL_METRIC_NAMES, "disagg-pools"),
-                       (KV_HANDOFF_METRIC_NAMES, "kv-handoff")):
+                       (KV_HANDOFF_METRIC_NAMES, "kv-handoff"),
+                       (ADAPTER_METRIC_NAMES, "adapters")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
+    from dlti_tpu.serving import adapters
     from dlti_tpu.telemetry import flightrecorder, ledger, memledger, watchdog
     from dlti_tpu.training import elastic, sentinel
 
-    objs = (store.save_seconds, store.restore_seconds, store.corrupt_skipped,
+    objs = (adapters.loads_total, adapters.evictions_total,
+            adapters.pool_hits_total, adapters.pool_misses_total,
+            adapters.pool_slots_gauge, adapters.pool_bytes_gauge,
+            store.save_seconds, store.restore_seconds, store.corrupt_skipped,
             store.save_retries, store.last_verified_step,
             watchdog.alerts_total, flightrecorder.dumps_total,
             elastic.restarts_total, elastic.generation_gauge,
@@ -148,6 +154,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_prefix_cache_hits_total",
                      "dlti_prefix_cache_blocks",
                      "dlti_prefix_cache_hit_rate",
+                     "dlti_adapter_loads_total",
+                     "dlti_adapter_pool_hits_total",
+                     "dlti_adapter_pool_bytes",
                      "dlti_sentinel_rollbacks_total",
                      "dlti_sdc_mismatches_total",
                      "dlti_goodput_fraction",

@@ -39,6 +39,7 @@ SPAN_NAME_CATALOG = frozenset({
     "engine/admit",
     "engine/decode_dispatch",
     "engine/decode_sync",
+    "engine/adapter_load",
     "engine/kv_handoff",
     "engine/prefill_chunks",
     "engine/tier_restore",
