@@ -1,4 +1,4 @@
-"""Checkpoint corruption helpers for chaos tests.
+"""Checkpoint corruption + I/O fault injection for chaos tests.
 
 Deterministic ways to damage an on-disk checkpoint the way real failures
 do — a kill mid-save (stale staging dir), a truncated write, a bit flip
@@ -6,14 +6,36 @@ from a bad disk/NIC — so tier-1 tests can prove the verified-resume path
 quarantines the damage and falls back instead of crashing. Used by
 ``tests/test_crash_consistency.py``; importable by operators for fire
 drills.
+
+:class:`FaultyIO` is the *live* counterpart: instead of damaging bytes
+after the fact, it injects ENOSPC / EIO / slow writes / torn writes at
+the file boundary while the system runs, via the durable writer's
+injector hook (:func:`dlti_tpu.utils.durable_io.set_fault_injector`).
+Spec syntax (same colon-separated shape as ``DLTI_TRAIN_FAULT_INJECT``):
+
+    DLTI_IO_FAULT=PATH_GLOB:errno[:count|rate][:delay_s][;more-rules]
+
+* ``PATH_GLOB`` — fnmatch glob, tried against the full path and its
+  basename (``*ckpt*``, ``MANIFEST.json``, ``*/flight/*``).
+* ``errno`` — an errno name (``ENOSPC``, ``EIO``, ``ESTALE``, ...), or
+  ``torn`` (write half the bytes, then raise ``EIO``), or ``slow``
+  (sleep ``delay_s``, then succeed).
+* ``count|rate`` — an integer fires the rule that many times then
+  clears it (recovery drills); a float in (0, 1] fires probabilistically
+  (seeded — deterministic per injector instance). Empty = every match.
+* ``delay_s`` — seconds to sleep before the op (stalling-NFS drills).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import errno as _errno_mod
+import fnmatch
 import json
 import os
+import random
 import shutil
-from typing import Optional
+from typing import List, Optional
 
 from dlti_tpu.checkpoint.store import (
     _ARRAY_DIR,
@@ -21,6 +43,7 @@ from dlti_tpu.checkpoint.store import (
     _MANIFEST,
     _TMP_PREFIX,
 )
+from dlti_tpu.utils.durable_io import IO_FAULT_ENV, set_fault_injector
 
 CORRUPT_MODES = (
     "bitflip-array",      # flip one bit in the middle of an array file
@@ -126,3 +149,130 @@ def read_manifest(directory: str, step: int) -> dict:
     with open(os.path.join(os.path.abspath(directory), str(step),
                            _MANIFEST)) as f:
         return json.load(f)
+
+
+# ----------------------------------------------------------------------
+# Live I/O fault injection (the durable writer's chaos hook)
+# ----------------------------------------------------------------------
+
+# The special (non-errno) fault kinds. "torn" writes half the payload
+# before raising EIO — the wreckage a power cut mid-flush leaves; "slow"
+# only sleeps (a stalling NFS mount that eventually answers).
+IO_FAULT_KINDS = ("torn", "slow")
+
+
+@dataclasses.dataclass
+class IOFault:
+    """One parsed ``DLTI_IO_FAULT`` rule."""
+    glob: str
+    kind: str                      # errno name (lowercased), "torn", "slow"
+    err: Optional[int]             # errno to raise; None for pure slow
+    remaining: Optional[int] = None  # count budget; None = unlimited
+    rate: Optional[float] = None   # fire probability; None = always
+    delay_s: float = 0.0
+    fired: int = 0
+
+    def matches(self, path: str) -> bool:
+        return (fnmatch.fnmatch(path, self.glob)
+                or fnmatch.fnmatch(os.path.basename(path), self.glob))
+
+
+class FaultyIO:
+    """Monkeypatchable I/O fault injector for the durable writer.
+
+    Install with :meth:`install` (or as a context manager) for
+    in-process tests, or export ``DLTI_IO_FAULT=<spec>`` — the durable
+    writer parses the env spec lazily, so subprocess drills need no
+    code. ``plan(op, path)`` is the hook the writer calls before every
+    raw write/append/replace; it returns the matching rule (consuming
+    one count) or None.
+    """
+
+    def __init__(self, faults: List[IOFault], seed: int = 0xD170):
+        self.faults = list(faults)
+        self._rng = random.Random(seed)
+
+    # -- spec parsing ---------------------------------------------------
+    @staticmethod
+    def parse_rule(text: str) -> IOFault:
+        parts = text.split(":")
+        if len(parts) < 2 or not parts[0] or not parts[1]:
+            raise ValueError(
+                f"bad {IO_FAULT_ENV} rule {text!r}; expected "
+                "PATH_GLOB:errno[:count|rate][:delay_s]")
+        glob_pat, kind = parts[0], parts[1].lower()
+        if kind == "torn":
+            err: Optional[int] = _errno_mod.EIO
+        elif kind == "slow":
+            err = None
+        else:
+            err = getattr(_errno_mod, kind.upper(), None)
+            if not isinstance(err, int):
+                raise ValueError(
+                    f"unknown errno/kind {parts[1]!r} in {IO_FAULT_ENV} "
+                    f"rule {text!r} (errno name, 'torn', or 'slow')")
+        remaining: Optional[int] = None
+        rate: Optional[float] = None
+        if len(parts) > 2 and parts[2]:
+            if "." in parts[2]:
+                rate = float(parts[2])
+                if not 0.0 < rate <= 1.0:
+                    raise ValueError(
+                        f"rate {parts[2]} out of (0, 1] in rule {text!r}")
+            else:
+                remaining = int(parts[2])
+                if remaining <= 0:
+                    raise ValueError(
+                        f"count {parts[2]} must be positive in {text!r}")
+        delay_s = float(parts[3]) if len(parts) > 3 and parts[3] else 0.0
+        if kind == "slow" and delay_s <= 0.0:
+            delay_s = 0.05  # a "slow" rule with no delay still stalls
+        return IOFault(glob=glob_pat, kind=kind, err=err,
+                       remaining=remaining, rate=rate, delay_s=delay_s)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "Optional[FaultyIO]":
+        rules = [cls.parse_rule(part) for part in spec.split(";")
+                 if part.strip()]
+        return cls(rules) if rules else None
+
+    @classmethod
+    def from_env(cls) -> "Optional[FaultyIO]":
+        spec = os.environ.get(IO_FAULT_ENV, "")
+        return cls.from_spec(spec) if spec else None
+
+    # -- the hook -------------------------------------------------------
+    def plan(self, op: str, path: str) -> Optional[IOFault]:
+        """First armed rule matching ``path`` (consumes one count)."""
+        del op  # all write-side ops are fair game today
+        for rule in self.faults:
+            if rule.remaining is not None and rule.remaining <= 0:
+                continue
+            if not rule.matches(path):
+                continue
+            if rule.rate is not None and self._rng.random() >= rule.rate:
+                continue
+            if rule.remaining is not None:
+                rule.remaining -= 1
+            rule.fired += 1
+            return rule
+        return None
+
+    @property
+    def total_fired(self) -> int:
+        return sum(r.fired for r in self.faults)
+
+    # -- install / uninstall --------------------------------------------
+    def install(self) -> "FaultyIO":
+        set_fault_injector(self)
+        return self
+
+    def uninstall(self) -> None:
+        set_fault_injector(None)
+
+    def __enter__(self) -> "FaultyIO":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.uninstall()
+        return False

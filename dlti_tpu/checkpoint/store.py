@@ -67,6 +67,7 @@ import jax
 import numpy as np
 
 from dlti_tpu.telemetry.registry import Counter, Gauge, Histogram
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 _FORMAT_VERSION = 1
@@ -189,11 +190,13 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
-def _fsync_write(path: str, data: bytes) -> None:
-    with open(path, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
+def _fsync_write(path: str, data: bytes,
+                 path_class: str = "checkpoint") -> None:
+    # Durable-writer policy (dlti_tpu.utils.durable_io): transient errnos
+    # retry with backoff, ENOSPC reclaims quarantine/dump/cold-tier space
+    # then retries, persistent failure re-raises for the caller's
+    # skip-and-alert / degrade fallback.
+    durable_io.write_bytes(path, data, path_class=path_class, fsync=True)
 
 
 def _fsync_dir(path: str) -> None:
@@ -222,6 +225,9 @@ class _PendingSave:
     keep: Optional[int]
     retries: int
     retry_backoff_s: float
+    # Durable-writer criticality class: "checkpoint" for train state,
+    # "adapter" / "prefix_tier" when save_pytree serves those callers.
+    path_class: str = "checkpoint"
 
 
 class _Writer:
@@ -274,6 +280,11 @@ def _writer(directory: str) -> _Writer:
         w = _writers.get(directory)
         if w is None:
             w = _writers[directory] = _Writer(directory)
+            # ENOSPC escape hatch: this directory's quarantined wreckage
+            # is the first thing a reclaim pass quota-evicts.
+            durable_io.register_reclaimer(
+                f"ckpt-quarantine:{directory}",
+                durable_io.quarantine_reclaimer(directory))
         return w
 
 
@@ -289,7 +300,7 @@ def _write_and_commit(directory: str, p: _PendingSave) -> None:
             if os.path.isdir(final):
                 return  # idempotent: this step is already committed
             _write_staging(tmp, p)
-            os.rename(tmp, final)
+            durable_io.replace(tmp, final, path_class=p.path_class)
             _fsync_dir(directory)
             break
         except Exception:
@@ -314,24 +325,26 @@ def _write_staging(tmp: str, p: _PendingSave) -> None:
         "meta_files": {},
     }
     for meta, payload in zip(p.leaf_metas, p.payloads):
-        _fsync_write(os.path.join(tmp, meta["file"]), payload)
+        _fsync_write(os.path.join(tmp, meta["file"]), payload,
+                     p.path_class)
         entry = dict(meta)
         entry["size"] = len(payload)
         entry["sha256"] = _sha256_bytes(payload)
         manifest["leaves"].append(entry)
     if p.train_meta is not None:
         data = json.dumps(p.train_meta, indent=2, sort_keys=True).encode()
-        _fsync_write(os.path.join(tmp, _SIDECAR), data)
+        _fsync_write(os.path.join(tmp, _SIDECAR), data, p.path_class)
         manifest["meta_files"][_SIDECAR] = {
             "size": len(data), "sha256": _sha256_bytes(data)}
     mbytes = json.dumps(manifest, indent=2, sort_keys=True).encode()
-    _fsync_write(os.path.join(tmp, _MANIFEST), mbytes)
+    _fsync_write(os.path.join(tmp, _MANIFEST), mbytes, p.path_class)
     # The commit marker is written LAST and names the manifest's digest:
     # a torn copy of this directory (e.g. a partial rsync, or a non-atomic
     # rename on an exotic filesystem) cannot present a valid COMMIT over a
     # mismatched manifest.
     _fsync_write(os.path.join(tmp, _COMMIT), json.dumps(
-        {"manifest_sha256": _sha256_bytes(mbytes)}).encode())
+        {"manifest_sha256": _sha256_bytes(mbytes)}).encode(),
+        p.path_class)
     _fsync_dir(os.path.join(tmp, _ARRAY_DIR))
     _fsync_dir(tmp)
 
@@ -454,7 +467,13 @@ def quarantine_step(directory: str, name: str, reason: str) -> Optional[str]:
         if not os.path.exists(dst):
             break
         k += 1
-    os.rename(src, dst)
+    durable_io.replace(src, dst, path_class="checkpoint")
+    # Quarantined wreckage is reclaimable the moment it exists (the
+    # async-writer path registers this too; save_pytree-only directories
+    # — adapters, tier blocks — get their hatch here).
+    durable_io.register_reclaimer(
+        f"ckpt-quarantine:{directory}",
+        durable_io.quarantine_reclaimer(directory))
     corrupt_skipped.inc()
     get_logger().warning(
         "quarantined checkpoint %s (%s) -> %s", src, reason, dst)
@@ -570,23 +589,38 @@ def _place_like(host: np.ndarray, template: Any):
     return jax.device_put(host, sharding)
 
 
-def save_pytree(directory: str, tree: Any) -> str:
+def save_pytree(directory: str, tree: Any, *,
+                path_class: str = "checkpoint") -> str:
     """Write an arbitrary pytree (e.g. an export's params dict) with the
     same manifest+commit protocol as a step checkpoint, synchronously and
-    atomically (staging dir + rename). Returns ``directory``."""
+    atomically (staging dir + rename). Returns ``directory``.
+
+    ``path_class`` selects the durable-writer criticality (``"adapter"``
+    for LoRA exports, ``"prefix_tier"`` for KV-block demotions). A save
+    that fails mid-staging quarantines its partial staging dir (never a
+    stray ``.tmp-*``, never a torn committed dir) and re-raises."""
     directory = os.path.abspath(directory)
     parent = os.path.dirname(directory) or "."
     os.makedirs(parent, exist_ok=True)
     leaf_metas, payloads = _leaf_entries(tree)
     pending = _PendingSave(
         step=0, leaf_metas=leaf_metas, payloads=payloads, train_meta=None,
-        keep=None, retries=3, retry_backoff_s=0.2)
+        keep=None, retries=3, retry_backoff_s=0.2, path_class=path_class)
     tmp = f"{directory}{_TMP_PREFIX}{os.getpid()}"
     shutil.rmtree(tmp, ignore_errors=True)
-    _write_staging(tmp, pending)
-    if os.path.isdir(directory):
-        shutil.rmtree(directory)
-    os.rename(tmp, directory)
+    try:
+        _write_staging(tmp, pending)
+        if os.path.isdir(directory):
+            shutil.rmtree(directory)
+        durable_io.replace(tmp, directory, path_class=path_class)
+    except BaseException:
+        # Torn/failed staging: quarantine the partial bytes for forensics
+        # (falling back to plain removal when even the rename is sick).
+        try:
+            quarantine_step(parent, os.path.basename(tmp), "save-failed")
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
     _fsync_dir(parent)
     return directory
 

@@ -440,6 +440,11 @@ class WatchdogConfig:
     # published when HBM capacity is known) dropped below this absolute
     # floor (0 = rule off).
     hbm_headroom_floor_frac: float = 0.0
+    # disk_pressure: fires when free bytes on the persistence filesystem
+    # (the `disk_free_bytes` ring series, utils.durable_io) drop below
+    # this floor (0 = free-bytes check off; write-error growth and
+    # degraded path classes always fire the rule).
+    disk_free_floor_bytes: int = 0
 
 
 @dataclass(frozen=True)

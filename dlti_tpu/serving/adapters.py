@@ -127,7 +127,7 @@ def save_adapter(directory: str, params: Any, alpha: float = 32.0) -> str:
     return save_pytree(directory, {
         "meta": {"alpha": np.float32(alpha)},
         "weights": weights,
-    })
+    }, path_class="adapter")
 
 
 def _load_verified(name: str, directory: str) -> dict:

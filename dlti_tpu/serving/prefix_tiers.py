@@ -34,13 +34,23 @@ from __future__ import annotations
 import collections
 import hashlib
 import os
-from typing import Dict, Optional, Tuple
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 _QUARANTINE_DIR = "_quarantine"
+
+# Disk-tier health policy: this many consecutive write failures flip the
+# tier memory-only (writes skipped, existing blocks still readable) until
+# the cooldown expires, when the next demotion probes the disk again. A
+# dead disk costs pool misses / re-prefills — never a request error.
+DISK_FAIL_LIMIT = 3
+DISK_RETRY_COOLDOWN_S = 30.0
 
 # A block payload: {"l00000": {"k": np.ndarray, "v": np.ndarray, ...}, ...}
 # — one entry per model layer, every array of the per-layer pool's row
@@ -62,16 +72,29 @@ class TieredBlockStore:
     """Bounded host-RAM + disk store of demoted prefix-KV blocks.
 
     Single-threaded by contract: all calls happen on the engine stepper
-    thread (the same contract as the allocator it backs).
+    thread (the same contract as the allocator it backs). The one
+    exception is the durable writer's ENOSPC reclaimer, which may fire
+    on any thread mid-write — the disk index is lock-protected for it.
+
+    Disk-tier storage faults degrade, never error: ``disk_fail_limit``
+    consecutive write failures flip the tier memory-only until
+    ``disk_retry_cooldown_s`` elapses, then the next demotion probes the
+    disk again (automatic recovery once the fault clears).
     """
 
     def __init__(self, host_blocks: int = 0, disk_dir: str = "",
-                 disk_blocks: int = 0):
+                 disk_blocks: int = 0,
+                 disk_fail_limit: int = DISK_FAIL_LIMIT,
+                 disk_retry_cooldown_s: float = DISK_RETRY_COOLDOWN_S,
+                 clock: Callable[[], float] = time.monotonic):
         if disk_blocks > 0 and not disk_dir:
             raise ValueError("disk_blocks > 0 needs a disk_dir")
         self.host_blocks = int(host_blocks)
         self.disk_dir = os.path.abspath(disk_dir) if disk_dir else ""
         self.disk_blocks = int(disk_blocks) if self.disk_dir else 0
+        self.disk_fail_limit = int(disk_fail_limit)
+        self.disk_retry_cooldown_s = float(disk_retry_cooldown_s)
+        self._clock = clock
         # LRU order, oldest first; host maps key -> payload, disk maps
         # key -> block dir path (the index is in-memory: payloads on disk
         # are only trusted after digest verification at read time).
@@ -79,12 +102,50 @@ class TieredBlockStore:
             collections.OrderedDict()
         self._disk: "collections.OrderedDict[tuple, str]" = \
             collections.OrderedDict()
+        self._disk_lock = threading.Lock()
+        self._fail_streak = 0
+        self._down_until = 0.0     # clock() time the cooldown expires
         self.logger = get_logger()
         self.stats = {"host_puts": 0, "disk_puts": 0, "host_hits": 0,
                       "disk_hits": 0, "disk_evictions": 0,
-                      "corrupt_dropped": 0}
+                      "corrupt_dropped": 0, "disk_write_failures": 0,
+                      "disk_degraded_skips": 0}
         if self.disk_dir:
             os.makedirs(self.disk_dir, exist_ok=True)
+            # ENOSPC escape hatches: quarantined wreckage first, then
+            # cold (oldest-LRU) live blocks — a demoted block is a cache
+            # entry, and cache entries lose to keeping the system writing.
+            durable_io.register_reclaimer(
+                f"prefix-quarantine:{self.disk_dir}",
+                durable_io.quarantine_reclaimer(self.disk_dir))
+            durable_io.register_reclaimer(
+                f"prefix-cold-blocks:{self.disk_dir}",
+                self._reclaim_cold_blocks)
+
+    @property
+    def disk_degraded(self) -> bool:
+        """True while the disk tier is flipped memory-only."""
+        return (self._fail_streak >= self.disk_fail_limit
+                and self._clock() < self._down_until)
+
+    def _reclaim_cold_blocks(self, bytes_needed: int) -> int:
+        """Durable-writer reclaimer: drop oldest-LRU disk blocks (each
+        one is just a future cache hit) until enough bytes are freed."""
+        import shutil
+
+        freed = 0
+        while True:
+            with self._disk_lock:
+                if not self._disk:
+                    break
+                _vk, vpath = self._disk.popitem(last=False)
+            size = durable_io.dir_bytes(vpath)
+            shutil.rmtree(vpath, ignore_errors=True)
+            self.stats["disk_evictions"] += 1
+            freed += size
+            if bytes_needed > 0 and freed >= bytes_needed:
+                break
+        return freed
 
     # ------------------------------------------------------------------
     @property
@@ -93,15 +154,17 @@ class TieredBlockStore:
 
     @property
     def num_disk_blocks(self) -> int:
-        return len(self._disk)
+        with self._disk_lock:
+            return len(self._disk)
 
     def tier_of(self, key: tuple) -> Optional[str]:
         """Which tier holds ``key`` (index lookup only — a disk entry may
         still fail verification at fetch time)."""
         if key in self._host:
             return "host"
-        if key in self._disk:
-            return "disk"
+        with self._disk_lock:
+            if key in self._disk:
+                return "disk"
         return None
 
     # ------------------------------------------------------------------
@@ -112,8 +175,9 @@ class TieredBlockStore:
         tier is configured to take it (payload dropped, legacy behavior).
         Host overflow cascades its LRU victim down to disk.
         """
-        if key in self._host or key in self._disk:
-            return None  # already demoted under this content key
+        with self._disk_lock:
+            if key in self._host or key in self._disk:
+                return None  # already demoted under this content key
         if self.host_blocks > 0:
             self._host[key] = payload
             self._host.move_to_end(key)
@@ -133,25 +197,53 @@ class TieredBlockStore:
     def _spill_to_disk(self, key: tuple, payload: Payload) -> Optional[str]:
         if self.disk_blocks <= 0:
             return None  # no disk tier: the payload is dropped
+        if self.disk_degraded:
+            # Memory-only until the cooldown expires: the demotion reads
+            # as a drop (a future pool miss), never a request error.
+            self.stats["disk_degraded_skips"] += 1
+            return None
         from dlti_tpu.checkpoint.store import save_pytree
 
         path = os.path.join(self.disk_dir, f"block-{key_digest(key)}")
         try:
             # Checkpoint-store protocol: staging dir + per-file SHA-256
             # manifest + atomic rename — a kill mid-write can never
-            # present a torn block as valid.
-            save_pytree(path, payload)
+            # present a torn block as valid. path_class="prefix_tier"
+            # gives the writes the tier's (short) retry budget.
+            save_pytree(path, payload, path_class="prefix_tier")
         except OSError as e:
-            self.logger.warning("prefix disk tier write failed (%s); "
-                                "block dropped", e)
+            self.stats["disk_write_failures"] += 1
+            self._fail_streak += 1
+            if self._fail_streak >= self.disk_fail_limit:
+                newly = self._clock() >= self._down_until
+                self._down_until = self._clock() + self.disk_retry_cooldown_s
+                if newly:
+                    self.logger.error(
+                        "prefix disk tier DEGRADED to memory-only after %d "
+                        "consecutive write failures (last: %s); retrying "
+                        "in %.0fs", self._fail_streak, e,
+                        self.disk_retry_cooldown_s)
+            else:
+                self.logger.warning("prefix disk tier write failed (%s); "
+                                    "block dropped", e)
             return None
-        self._disk[key] = path
-        self._disk.move_to_end(key)
+        if self._fail_streak:
+            self.logger.warning("prefix disk tier recovered (write "
+                                "succeeded after %d failures)",
+                                self._fail_streak)
+        self._fail_streak = 0
+        self._down_until = 0.0
+        with self._disk_lock:
+            self._disk[key] = path
+            self._disk.move_to_end(key)
         self.stats["disk_puts"] += 1
-        while len(self._disk) > self.disk_blocks:
+        while True:
+            with self._disk_lock:
+                if len(self._disk) <= self.disk_blocks:
+                    break
+                vk, vpath = self._disk.popitem(last=False)
             from dlti_tpu.serving.prefix_cache import evictions_total
 
-            vk, vpath = self._disk.popitem(last=False)
             import shutil
 
             shutil.rmtree(vpath, ignore_errors=True)
@@ -171,7 +263,8 @@ class TieredBlockStore:
         if payload is not None:
             self.stats["host_hits"] += 1
             return payload, "host"
-        path = self._disk.pop(key, None)
+        with self._disk_lock:
+            path = self._disk.pop(key, None)
         if path is None:
             return None, None
         from dlti_tpu.checkpoint.store import (
@@ -203,7 +296,7 @@ class TieredBlockStore:
             while os.path.exists(dst):
                 k += 1
                 dst = os.path.join(qdir, f"{base}__{reason}__{k}")
-            os.rename(path, dst)
+            durable_io.replace(path, dst, path_class="prefix_tier")
             self.logger.warning(
                 "quarantined corrupt prefix block %s (%s) -> %s",
                 path, reason, dst)

@@ -153,6 +153,14 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     for metric in (_ml.hbm_bytes_gauge, _ml.hbm_peak_gauge,
                    _ml.hbm_headroom_gauge, _ml.hbm_untracked_gauge):
         registry.register(metric)
+    # Durable-writer health (utils.durable_io): free bytes on the
+    # persistence filesystem plus path_class-labeled write-error /
+    # degraded series — the watchdog's disk_pressure inputs on /metrics.
+    from dlti_tpu.utils import durable_io as _dio
+
+    for metric in (_dio.free_bytes_gauge, _dio.write_errors_total,
+                   _dio.degraded_gauge):
+        registry.register(metric)
     # Disaggregated serving (serving.disagg): per-pool gauges + KV-handoff
     # counters ride in via the controller's pool_scalars source, plus the
     # module-level handoff-latency histogram.

@@ -50,6 +50,7 @@ from typing import Callable, List, Optional
 
 from dlti_tpu.telemetry.registry import Counter
 from dlti_tpu.telemetry.tracer import SpanTracer, get_tracer
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 # Name-stability contract (pinned in tests/test_bench_contract.py).
@@ -103,6 +104,13 @@ class FlightRecorder:
         self._memory_sources: List[Callable[[], dict]] = []
         self._last_dump_t = 0.0
         self.last_dump_path: Optional[str] = None
+        self.dump_failures = 0
+        # Old dumps are the first thing to sacrifice under ENOSPC: any
+        # durable write anywhere can rotate them down to the newest one.
+        durable_io.register_reclaimer(
+            f"flight-dumps:{self.directory}",
+            lambda need: durable_io.sweep_oldest(
+                self.directory, keep=1, bytes_needed=need))
 
     # -- live context ---------------------------------------------------
     def note(self, **kw) -> None:
@@ -137,6 +145,12 @@ class FlightRecorder:
         fault) and throttles repeat dumps within ``min_interval_s``
         unless ``force`` — terminal paths (fatal exception, pre-kill
         chaos hook) pass ``force=True``.
+
+        An ENOSPC is not silent: the recorder rotates its own oldest
+        dumps (plus anything the durable writer's reclaimers free) and
+        retries the write once; when it *still* can't land, a
+        ``dump_failed`` event with the errno goes to the watchdog event
+        log — a missing black box leaves a paper trail.
         """
         try:
             now = time.monotonic()
@@ -145,11 +159,43 @@ class FlightRecorder:
                     return None
                 self._last_dump_t = now
                 context = dict(self._context)
-            return self._write(reason, exc, extra, context)
         except Exception:
             self.logger.exception("flight-record dump failed (reason=%s)",
                                   reason)
             return None
+        last_err: Optional[BaseException] = None
+        for retry in (False, True):
+            try:
+                if retry:
+                    durable_io.sweep_oldest(self.directory, keep=1)
+                return self._write(reason, exc, extra, context)
+            except OSError as e:
+                last_err = e
+                if durable_io.classify_errno(e) != "reclaim":
+                    break
+            except Exception as e:
+                last_err = e
+                break
+        self.dump_failures += 1
+        code = getattr(last_err, "errno", None)
+        self.logger.error("flight-record dump failed (reason=%s errno=%s): %s",
+                          reason, code, last_err)
+        self._log_dump_failed(reason, code, last_err)
+        return None
+
+    def _log_dump_failed(self, reason: str, code, err) -> None:
+        """Record ``dump_failed`` in the watchdog event log (best-effort;
+        lazy import — the watchdog imports us for its dump escalation)."""
+        try:
+            from dlti_tpu.telemetry import watchdog as _watchdog
+
+            _watchdog.log_event({
+                "event": "dump_failed", "reason": reason,
+                "errno": code, "error": str(err),
+                "directory": self.directory, "time": time.time(),
+            })
+        except Exception:
+            pass
 
     def _write(self, reason, exc, extra, context) -> str:
         for fn in self._context_sources:
@@ -230,18 +276,26 @@ class FlightRecorder:
         }
         manifest: dict = {"format": 1, "reason": reason,
                           "created": time.time(), "files": {}}
-        for name, obj in payloads.items():
-            path = os.path.join(tmp, name)
-            data = json.dumps(obj, indent=1, default=str).encode()
-            with open(path, "wb") as f:
-                f.write(data)
-            manifest["files"][name] = {
-                "bytes": len(data),
-                "sha256": hashlib.sha256(data).hexdigest(),
-            }
-        with open(os.path.join(tmp, MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=1)
-        os.replace(tmp, final)  # atomic: a visible flight-* dir is complete
+        try:
+            for name, obj in payloads.items():
+                path = os.path.join(tmp, name)
+                data = json.dumps(obj, indent=1, default=str).encode()
+                durable_io.write_bytes(path, data, path_class="flight")
+                manifest["files"][name] = {
+                    "bytes": len(data),
+                    "sha256": hashlib.sha256(data).hexdigest(),
+                }
+            durable_io.write_bytes(
+                os.path.join(tmp, MANIFEST),
+                json.dumps(manifest, indent=1).encode(),
+                path_class="flight")
+            # atomic: a visible flight-* dir is complete
+            durable_io.replace(tmp, final, path_class="flight")
+        except BaseException:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
         dumps_total.labels(reason=reason.split(":")[0]).inc()
         self.last_dump_path = final
         self.logger.warning("flight record (%s) -> %s", reason, final)

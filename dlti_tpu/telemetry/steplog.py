@@ -15,7 +15,10 @@ possible here. Three record types, one JSON object per line:
   columns by construction (guarded by ``tests/test_telemetry.py``).
 
 Lines are flushed per write so a preempted run's stream is readable up to
-the last completed step.
+the last completed step. Writes go through the durable writer's
+drop-and-count stream (``path_class="steplog"``): an EIO/ENOSPC on the
+telemetry disk costs log lines (counted in ``dlti_disk_write_errors_total``
+and the writer's ``dropped``), never a training step.
 """
 
 from __future__ import annotations
@@ -27,6 +30,7 @@ import os
 from typing import Optional
 
 from dlti_tpu.config import OptimizerConfig
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.metrics import MetricsRecord
 
 # Keys every "step" record carries (the per-step contract; the schema test
@@ -88,20 +92,27 @@ def schedule_lr(cfg: OptimizerConfig, step: int) -> float:
 
 
 class StepLogWriter:
-    """Append-mode JSONL writer; one instance per (rank-0) training run."""
+    """Append-mode JSONL writer; one instance per (rank-0) training run.
+
+    Telemetry criticality: a failed write is dropped and counted, never
+    raised — the step loop must survive a sick telemetry disk."""
 
     def __init__(self, path: str, run_meta: Optional[dict] = None):
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._f = open(path, "a")
+        self._w = durable_io.LineWriter(path, path_class="steplog")
         if run_meta is not None:
             self._write({"type": "run", **run_meta})
 
     def _write(self, obj: dict) -> None:
-        self._f.write(json.dumps(obj) + "\n")
-        self._f.flush()
+        self._w.write_line(json.dumps(obj))
+
+    @property
+    def dropped(self) -> int:
+        """Lines lost to I/O errors (drop-and-count contract)."""
+        return self._w.dropped
 
     def log_step(self, step: int, **fields) -> None:
         self._write({"type": "step", "step": step, **fields})
@@ -112,8 +123,7 @@ class StepLogWriter:
         self._write({"type": "final", **row})
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
+        self._w.close()
 
     def __enter__(self):
         return self

@@ -48,6 +48,16 @@ alert, not one per check interval):
   below ``hbm_headroom_floor_frac``: the next big allocation (a long
   prefill, a KV growth burst) is likely to OOM — alert (and dump the
   ownership map) while the process is still alive to tell the story.
+* ``disk_pressure``         — the durable writer (``utils.durable_io``)
+  is in trouble: free bytes under ``disk_free_floor_bytes``, write
+  errors accruing since the last check, or a path class degraded
+  (skipping/dropping writes). Fires while the run is still healthy
+  enough to act — the checkpoint that *couldn't* be written is exactly
+  the one a later incident will want.
+
+The module-level :func:`log_event` appends structured non-alert events
+(e.g. the flight recorder's ``dump_failed``) to the same JSONL event log
+the alerts go to, so one file tells the whole incident story.
 """
 
 from __future__ import annotations
@@ -64,6 +74,7 @@ from typing import Callable, List, Optional
 from dlti_tpu.telemetry.registry import Counter
 from dlti_tpu.telemetry.timeseries import TimeSeriesSampler
 from dlti_tpu.telemetry.tracer import get_tracer
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 # Name-stability contract (pinned in tests/test_bench_contract.py).
@@ -79,7 +90,7 @@ alerts_total = Counter(
 RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
          "nonfinite_step", "loss_spike", "sdc_mismatch",
-         "goodput_collapse", "hbm_pressure")
+         "goodput_collapse", "hbm_pressure", "disk_pressure")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -109,6 +120,44 @@ _THROUGHPUT_SERIES = (
 _SHED_KEY_PREFIXES = ("dlti_gateway_shed_total", "dlti_gateway_rejected_total")
 
 _CKPT_RETRY_KEYS = ("ckpt_save_retries", "dlti_ckpt_save_retries")
+
+# disk_pressure inputs: the trainer's scalar source exposes the bare
+# names (durable_io.scalars); the serving registry exposes the dlti_*
+# metrics, path_class-labeled — hence prefix sums for the labeled pair.
+_DISK_FREE_KEYS = ("disk_free_bytes", "dlti_disk_free_bytes")
+_DISK_ERROR_KEY_PREFIXES = ("disk_write_errors",
+                            "dlti_disk_write_errors_total")
+_DISK_DEGRADED_KEY_PREFIXES = ("disk_degraded", "dlti_disk_degraded")
+
+
+# ----------------------------------------------------------------------
+# Module-level event log: structured non-alert events (the flight
+# recorder's dump_failed, future maintenance events) append to the same
+# JSONL file the alerts go to. The trainer/server watchdog installs its
+# alert_log_path here at construction.
+# ----------------------------------------------------------------------
+_EVENT_LOG_PATH = [""]
+
+
+def set_event_log_path(path: Optional[str]) -> None:
+    _EVENT_LOG_PATH[0] = path or ""
+
+
+def log_event(record: dict) -> bool:
+    """Append a structured event to the watchdog event log (best-effort,
+    drop-and-count via the durable writer; False when unconfigured or
+    the write was dropped)."""
+    path = _EVENT_LOG_PATH[0]
+    if not path:
+        return False
+    d = os.path.dirname(path)
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return False
+    return durable_io.append_line(path, json.dumps(record, default=str),
+                                  path_class="watchdog")
 
 
 class AnomalyWatchdog:
@@ -143,6 +192,8 @@ class AnomalyWatchdog:
         self._last_dump_t = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        if getattr(cfg, "alert_log_path", ""):
+            set_event_log_path(cfg.alert_log_path)
 
     # -- push signals ---------------------------------------------------
     def notify_step(self, step: int) -> None:
@@ -322,8 +373,53 @@ class AnomalyWatchdog:
                 else:
                     self._active.discard("hbm_pressure")
 
-        # sentinel rules: nonfinite_step / loss_spike / sdc_mismatch ---
+        # disk_pressure ------------------------------------------------
         latest = (self.sampler.latest() or {}).get("values", {})
+        free = next((float(latest[k]) for k in _DISK_FREE_KEYS
+                     if k in latest), None)
+        floor_bytes = getattr(self.cfg, "disk_free_floor_bytes", 0)
+        if floor_bytes > 0 and free is not None:
+            if free < floor_bytes:
+                a = self._fire("disk_pressure", "disk_pressure:free",
+                               f"free disk down to {free / 1e9:.2f} GB "
+                               f"(floor {floor_bytes / 1e9:.2f} GB) — the "
+                               f"next save may hit ENOSPC",
+                               free_bytes=free, floor_bytes=floor_bytes)
+                if a:
+                    fired.append(a)
+            else:
+                self._active.discard("disk_pressure:free")
+        err_keys = [k for k in latest
+                    if k.startswith(_DISK_ERROR_KEY_PREFIXES)]
+        if err_keys:
+            errs = sum(float(latest[k]) for k in err_keys)
+            prev = self._watermarks.get("disk_pressure:errors")
+            self._watermarks["disk_pressure:errors"] = errs
+            if prev is not None and errs > prev:
+                a = self._fire("disk_pressure", "disk_pressure:errors",
+                               f"persistence write errors grew "
+                               f"{errs - prev:.0f} since last check "
+                               f"(now {errs:.0f})",
+                               grew=errs - prev, total=errs)
+                if a:
+                    fired.append(a)
+            elif prev is not None:
+                self._active.discard("disk_pressure:errors")
+        deg_keys = [k for k in latest
+                    if k.startswith(_DISK_DEGRADED_KEY_PREFIXES)]
+        if deg_keys:
+            degraded = sum(float(latest[k]) for k in deg_keys)
+            if degraded > 0:
+                a = self._fire("disk_pressure", "disk_pressure:degraded",
+                               f"{degraded:.0f} path class(es) degraded — "
+                               f"writes being skipped/dropped",
+                               degraded=degraded)
+                if a:
+                    fired.append(a)
+            else:
+                self._active.discard("disk_pressure:degraded")
+
+        # sentinel rules: nonfinite_step / loss_spike / sdc_mismatch ---
         for rule, keys in _SENTINEL_RULES:
             present = [k for k in keys if k in latest]
             if not present:
@@ -383,8 +479,9 @@ class AnomalyWatchdog:
                 d = os.path.dirname(self.cfg.alert_log_path)
                 if d:
                     os.makedirs(d, exist_ok=True)
-                with open(self.cfg.alert_log_path, "a") as f:
-                    f.write(json.dumps(alert) + "\n")
+                durable_io.append_line(self.cfg.alert_log_path,
+                                       json.dumps(alert),
+                                       path_class="watchdog")
             except OSError:
                 self.logger.exception("watchdog alert log write failed")
         try:

@@ -57,6 +57,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from dlti_tpu.telemetry.registry import Counter, Gauge
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 # -- rendezvous env extensions (on top of launcher's DLTI_* contract) ----
@@ -124,15 +125,11 @@ def beat(step: int) -> None:
     _last_beat[0] = now
     path = os.path.join(
         info["dir"], f"hb_g{info['generation']}_r{info['rank']}.json")
-    tmp = f"{path}.tmp-{os.getpid()}"
-    try:
-        with open(tmp, "w") as f:
-            json.dump({"step": int(step), "wall": time.time(),
-                       "generation": info["generation"],
-                       "rank": info["rank"], "pid": os.getpid()}, f)
-        os.replace(tmp, path)
-    except OSError:
-        pass
+    durable_io.write_json_atomic(
+        path, {"step": int(step), "wall": time.time(),
+               "generation": info["generation"],
+               "rank": info["rank"], "pid": os.getpid()},
+        path_class="elastic")
 
 
 _last_ledger_save = [0.0]
@@ -156,16 +153,11 @@ def save_generation_ledger(ledger_dict: dict, step: Optional[int] = None,
     _last_ledger_save[0] = now
     path = os.path.join(
         info["dir"], f"ledger_g{info['generation']}_r{info['rank']}.json")
-    tmp = f"{path}.tmp-{os.getpid()}"
-    try:
-        with open(tmp, "w") as f:
-            json.dump({**ledger_dict, "generation": info["generation"],
-                       "rank": info["rank"], "step": step,
-                       "wall": time.time()}, f)
-        os.replace(tmp, path)
-        return path
-    except OSError:
-        return None
+    ok = durable_io.write_json_atomic(
+        path, {**ledger_dict, "generation": info["generation"],
+               "rank": info["rank"], "step": step, "wall": time.time()},
+        path_class="elastic")
+    return path if ok else None
 
 
 def mirror_alert(alert: dict) -> None:
@@ -179,11 +171,8 @@ def mirror_alert(alert: dict) -> None:
     path = os.path.join(
         info["dir"],
         f"watchdog_alerts_g{info['generation']}_r{info['rank']}.jsonl")
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps(alert, default=str) + "\n")
-    except OSError:
-        pass
+    durable_io.append_line(path, json.dumps(alert, default=str),
+                           path_class="elastic")
 
 
 # ----------------------------------------------------------------------
@@ -413,12 +402,9 @@ class ElasticLauncher:
     def _event(self, event: str, **data) -> None:
         rec = {"wall": time.time(), "event": event,
                "generation": self.generation, **data}
-        try:
-            with open(os.path.join(self.elastic_dir, _EVENTS_FILE),
-                      "a") as f:
-                f.write(json.dumps(rec, default=str) + "\n")
-        except OSError:
-            pass
+        durable_io.append_line(os.path.join(self.elastic_dir, _EVENTS_FILE),
+                               json.dumps(rec, default=str),
+                               path_class="elastic")
         self.logger.info("elastic[g%d]: %s %s", self.generation, event,
                          {k: v for k, v in data.items()})
 
@@ -696,14 +682,10 @@ class ElasticLauncher:
             "heartbeat": hb and {k: hb[k] for k in hb if k != "_mtime"},
             "stale_s": (time.time() - hb["_mtime"]) if hb else None,
         }
-        try:
-            with open(os.path.join(
-                    self.elastic_dir,
-                    f"supervisor_incident_g{self.generation}.json"),
-                    "w") as f:
-                json.dump(incident, f, indent=1)
-        except OSError:
-            pass
+        durable_io.write_json_atomic(
+            os.path.join(self.elastic_dir,
+                         f"supervisor_incident_g{self.generation}.json"),
+            incident, path_class="elastic", indent=1)
         self._kill_target(workers, w.rank, reason)
         self._teardown(workers)
 
@@ -723,10 +705,8 @@ class ElasticLauncher:
                 load_generation_ledgers(self.elastic_dir), timeline,
                 self.num_processes)
             path = os.path.join(self.elastic_dir, STITCHED_LEDGER_FILE)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(stitched, f, indent=1)
-            os.replace(tmp, path)
+            durable_io.write_json_atomic(path, stitched,
+                                         path_class="elastic", indent=1)
         except Exception:
             self.logger.debug("stitched-ledger write failed", exc_info=True)
 

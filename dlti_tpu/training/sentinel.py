@@ -49,6 +49,7 @@ import statistics
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from dlti_tpu.telemetry.registry import Counter
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
 
 # Name-stability contract (pinned in tests/test_bench_contract.py).
@@ -298,15 +299,14 @@ class DataSkipList:
         """Atomic write of the standalone skip-list file (rollbacks land
         between checkpoint saves; this survives a crash in that gap)."""
         path = os.path.join(directory, self.FILENAME)
-        tmp = f"{path}.tmp-{os.getpid()}"
         try:
             os.makedirs(directory, exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump({"format": 1, "windows": self.to_meta()}, f,
-                          indent=1, sort_keys=True)
-            os.replace(tmp, path)
         except OSError:
             get_logger().exception("sentinel skip-list write failed")
+            return
+        durable_io.write_json_atomic(
+            path, {"format": 1, "windows": self.to_meta()},
+            path_class="sentinel", indent=1, sort_keys=True)
 
     def load(self, directory: str) -> None:
         path = os.path.join(directory, self.FILENAME)

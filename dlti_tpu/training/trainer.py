@@ -45,6 +45,7 @@ from dlti_tpu.telemetry.memledger import (
 from dlti_tpu.training.optimizer import build_optimizer
 from dlti_tpu.training.state import TrainState, create_train_state
 from dlti_tpu.training.step import make_train_step
+from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.experiment import experiment_name_from_config
 from dlti_tpu.utils.logging import StepTimer, get_logger, is_main_process
 from dlti_tpu.utils.metrics import (
@@ -580,6 +581,9 @@ class Trainer:
                 # Straggler lag on /debug/vars (the gauge twin lives in
                 # Heartbeat.register; this is the ring-series form).
                 d["heartbeat_lag"] = heartbeat.lag()
+            # Durable-writer health: disk free/error/degraded series (the
+            # watchdog's disk_pressure rule and flight dumps read these).
+            d.update(durable_io.scalars())
             return d
 
         if wcfg.enabled or fcfg.enabled:

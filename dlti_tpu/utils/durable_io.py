@@ -1,0 +1,601 @@
+"""Durable writer: every persistence path's single door to the disk.
+
+PRs 4-13 built durability *protocols* (manifest+commit checkpoints,
+digest-verified tiers, atomic heartbeat renames) on the assumption of a
+healthy filesystem. This module is the layer underneath: every byte the
+package persists — checkpoint staging files, adapter exports, prefix-tier
+blocks, flight dumps, the step log, elastic heartbeats/ledgers, the
+sentinel skip-list, watchdog alert logs — goes through one writer with
+*classified* error handling (an AST guard, ``tests/test_durable_io_guard
+.py``, keeps it that way):
+
+* **Transient** (``EIO``, ``EAGAIN``, ``EINTR``, ``EBUSY``,
+  ``ETIMEDOUT``, ``ESTALE`` — the flaky-NFS family): bounded retry with
+  exponential backoff.
+* **Reclaimable** (``ENOSPC``, ``EDQUOT``): run the registered reclaim
+  callbacks — quota-evict ``_quarantine/`` wreckage, rotate old flight
+  dumps, drop cold disk-tier blocks — then retry. Components register
+  what they can afford to lose via :func:`register_reclaimer`; the
+  per-path-class :func:`disk_ledger` records what each class wrote,
+  dropped, and reclaimed.
+* **Persistent** (anything else, or retries exhausted): degrade by the
+  path class's criticality instead of crashing. ``checkpoint`` /
+  ``adapter`` / ``prefix_tier`` / ``flight`` writes re-raise the final
+  ``OSError`` so their callers run the protocol-level fallback (skip the
+  save and alert; flip the tier memory-only; record ``dump_failed``);
+  telemetry-stream classes (``steplog``, ``elastic``, ``sentinel``,
+  ``watchdog``) drop-and-count — a lost log line must never abort a
+  training step.
+
+Degradation is self-announcing: ``dlti_disk_write_errors_total`` and
+``dlti_disk_degraded`` carry a ``path_class`` label, ``dlti_disk_free_
+bytes`` tracks the filesystem, and the watchdog's ``disk_pressure`` rule
+fires on any of them. Recovery is automatic — the first successful write
+of a class clears its degraded flag.
+
+Chaos: all raw file operations funnel through :func:`_raw_write_bytes` /
+:func:`_raw_append_text` / :func:`_raw_replace`, which consult the
+installed fault injector (:class:`dlti_tpu.checkpoint.chaos.FaultyIO`,
+spec ``DLTI_IO_FAULT=PATH_GLOB:errno[:count|rate][:delay_s]``) before
+touching the os — ENOSPC/EIO/slow-write/torn-write injection at the
+file boundary without monkeypatching builtins.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from dlti_tpu.telemetry.registry import Counter, Gauge
+from dlti_tpu.utils.logging import get_logger
+
+# Chaos-spec env var (parsed by dlti_tpu.checkpoint.chaos.FaultyIO; read
+# lazily per operation so subprocess drills only need the env set).
+IO_FAULT_ENV = "DLTI_IO_FAULT"
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+DISK_METRIC_NAMES = (
+    "dlti_disk_free_bytes",
+    "dlti_disk_write_errors_total",
+    "dlti_disk_degraded",
+)
+
+free_bytes_gauge = Gauge(
+    DISK_METRIC_NAMES[0],
+    help="free bytes on the filesystem of the last persistence write")
+write_errors_total = Counter(
+    DISK_METRIC_NAMES[1],
+    help="persistence write errors, labeled by path_class")
+degraded_gauge = Gauge(
+    DISK_METRIC_NAMES[2],
+    help="1 while a path class is degraded (skipping/dropping writes), "
+         "labeled by path_class")
+
+# Path classes, with the per-class policy: does a persistent failure
+# re-raise (the caller owns a protocol-level fallback) or drop-and-count
+# (telemetry streams — losing a line must never hurt the run)? The
+# retry budget is per durable operation (the transient-errno family);
+# callers with their own outer retry loops (the checkpoint writer) keep
+# them on top.
+#   class        raises  retries
+_POLICY: Dict[str, tuple] = {
+    "checkpoint":  (True, 3),
+    "adapter":     (True, 2),
+    "prefix_tier": (True, 1),
+    "flight":      (True, 1),
+    "steplog":     (False, 0),
+    "elastic":     (False, 1),
+    "sentinel":    (False, 1),
+    "watchdog":    (False, 0),
+}
+PATH_CLASSES = tuple(_POLICY)
+
+_TRANSIENT_ERRNOS = frozenset(
+    e for e in (errno.EIO, errno.EAGAIN, errno.EINTR, errno.EBUSY,
+                errno.ETIMEDOUT, getattr(errno, "ESTALE", None))
+    if e is not None)
+_RECLAIM_ERRNOS = frozenset(
+    e for e in (errno.ENOSPC, getattr(errno, "EDQUOT", None))
+    if e is not None)
+
+_lock = threading.Lock()
+_degraded: set = set()
+_ledger: Dict[str, Dict[str, float]] = {}
+_reclaimers: "Dict[str, Callable[[int], int]]" = {}
+_last_free = [0.0, 0]          # [monotonic probe time, bytes]
+_probe_dir = ["."]             # filesystem the free-bytes gauge tracks
+
+
+def classify_errno(exc: BaseException) -> str:
+    """``"transient"`` | ``"reclaim"`` | ``"persistent"`` for an OSError
+    (anything that is not an OSError classifies persistent)."""
+    code = getattr(exc, "errno", None)
+    if code in _TRANSIENT_ERRNOS:
+        return "transient"
+    if code in _RECLAIM_ERRNOS:
+        return "reclaim"
+    return "persistent"
+
+
+# ----------------------------------------------------------------------
+# Fault injection hook (the os/file boundary FaultyIO patches)
+# ----------------------------------------------------------------------
+
+_injector = [None]             # explicit (test-installed) injector
+_env_cache: list = ["", None]  # [spec string, parsed FaultyIO]
+
+
+def set_fault_injector(inj) -> None:
+    """Install (or clear, with None) an explicit fault injector. An
+    explicit injector wins over the ``DLTI_IO_FAULT`` env spec."""
+    _injector[0] = inj
+
+
+def _active_injector():
+    if _injector[0] is not None:
+        return _injector[0]
+    spec = os.environ.get(IO_FAULT_ENV, "")
+    if not spec:
+        return None
+    if _env_cache[0] != spec:
+        from dlti_tpu.checkpoint.chaos import FaultyIO
+
+        _env_cache[0], _env_cache[1] = spec, FaultyIO.from_spec(spec)
+    return _env_cache[1]
+
+
+def _plan_fault(op: str, path: str):
+    inj = _active_injector()
+    if inj is None:
+        return None
+    try:
+        return inj.plan(op, str(path))
+    except Exception:
+        # A broken injector must never break production writes.
+        get_logger().exception("io fault injector failed; ignoring")
+        return None
+
+
+def _apply_fault(fault, path: str, data: Optional[bytes]) -> None:
+    """Honor a planned fault: sleep, tear, or raise. A torn write leaves
+    a half-written file behind (the on-disk wreckage a real power cut or
+    full NFS buffer flush produces) before raising."""
+    if fault is None:
+        return
+    if fault.delay_s:
+        time.sleep(fault.delay_s)
+    if fault.err is None:
+        return  # pure slow-write
+    if fault.kind == "torn" and data is not None:
+        with open(path, "wb") as f:
+            f.write(data[: max(1, len(data) // 2)])
+    raise OSError(fault.err,
+                  f"chaos: injected {errno.errorcode.get(fault.err, fault.err)}"
+                  f" ({fault.kind})", str(path))
+
+
+# ----------------------------------------------------------------------
+# Raw ops — the only places in the covered modules that touch the file
+# boundary for writes (the AST guard pins this).
+# ----------------------------------------------------------------------
+
+def _raw_write_bytes(path: str, data: bytes, fsync: bool) -> None:
+    _apply_fault(_plan_fault("write", path), path, data)
+    with open(path, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def _raw_append_text(path: str, text: str) -> None:
+    fault = _plan_fault("write", path)
+    if fault is not None and fault.err is not None and fault.kind == "torn":
+        if fault.delay_s:
+            time.sleep(fault.delay_s)
+        with open(path, "a") as f:
+            f.write(text[: max(1, len(text) // 2)])
+        raise OSError(fault.err, "chaos: torn append", str(path))
+    _apply_fault(fault, path, None)
+    with open(path, "a") as f:
+        f.write(text)
+        f.flush()
+
+
+def _raw_replace(src: str, dst: str) -> None:
+    _apply_fault(_plan_fault("replace", dst), dst, None)
+    os.replace(src, dst)
+
+
+# ----------------------------------------------------------------------
+# Ledger / degrade bookkeeping
+# ----------------------------------------------------------------------
+
+def _class_entry(path_class: str) -> Dict[str, float]:
+    return _ledger.setdefault(path_class, {
+        "writes": 0, "bytes": 0, "errors": 0, "drops": 0,
+        "reclaims": 0, "reclaimed_bytes": 0, "last_errno": 0})
+
+
+def _note_write(path_class: str, nbytes: int) -> None:
+    with _lock:
+        e = _class_entry(path_class)
+        e["writes"] += 1
+        e["bytes"] += nbytes
+        if path_class in _degraded:
+            _degraded.discard(path_class)
+            degraded_gauge.labels(path_class=path_class).set(0)
+            get_logger().warning(
+                "durable_io: path class %r recovered (write succeeded)",
+                path_class)
+
+
+def _note_error(path_class: str, exc: BaseException) -> None:
+    write_errors_total.labels(path_class=path_class).inc()
+    with _lock:
+        e = _class_entry(path_class)
+        e["errors"] += 1
+        e["last_errno"] = getattr(exc, "errno", 0) or 0
+
+
+def _note_drop(path_class: str) -> None:
+    with _lock:
+        _class_entry(path_class)["drops"] += 1
+
+
+def _set_degraded(path_class: str) -> None:
+    with _lock:
+        newly = path_class not in _degraded
+        _degraded.add(path_class)
+    degraded_gauge.labels(path_class=path_class).set(1)
+    if newly:
+        get_logger().error(
+            "durable_io: path class %r DEGRADED (persistent write "
+            "failure); writes will be skipped/dropped per criticality "
+            "until one succeeds", path_class)
+
+
+def is_degraded(path_class: str) -> bool:
+    with _lock:
+        return path_class in _degraded
+
+
+def degraded_classes() -> tuple:
+    with _lock:
+        return tuple(sorted(_degraded))
+
+
+def disk_ledger() -> Dict[str, Dict[str, float]]:
+    """Per-path-class budget ledger: writes/bytes persisted, errors,
+    drops, reclaim passes and bytes they freed, last errno seen."""
+    with _lock:
+        return {k: dict(v) for k, v in _ledger.items()}
+
+
+def reset_for_tests() -> None:
+    """Zero the module's mutable state (ledger, degraded flags, injector,
+    reclaimers) so chaos tests don't leak into each other."""
+    with _lock:
+        _ledger.clear()
+        for c in _degraded:
+            degraded_gauge.labels(path_class=c).set(0)
+        _degraded.clear()
+        _reclaimers.clear()
+    _injector[0] = None
+    _env_cache[0], _env_cache[1] = "", None
+
+
+def probe_free_bytes(path: Optional[str] = None) -> int:
+    """statvfs free bytes for ``path``'s filesystem (default: the last
+    directory a durable write touched); updates the gauge."""
+    target = path or _probe_dir[0]
+    try:
+        free = shutil.disk_usage(target).free
+    except OSError:
+        return _last_free[1]
+    _last_free[0], _last_free[1] = time.monotonic(), free
+    free_bytes_gauge.set(free)
+    return free
+
+
+def scalars() -> dict:
+    """Sampler-ring snapshot (the trainer's ``_train_scalars`` merges
+    this; the watchdog's ``disk_pressure`` rule reads the keys)."""
+    if time.monotonic() - _last_free[0] > 5.0:
+        probe_free_bytes()
+    with _lock:
+        errors = sum(e["errors"] for e in _ledger.values())
+        drops = sum(e["drops"] for e in _ledger.values())
+        degraded = len(_degraded)
+    return {"disk_free_bytes": _last_free[1],
+            "disk_write_errors": errors,
+            "disk_write_drops": drops,
+            "disk_degraded": degraded}
+
+
+# ----------------------------------------------------------------------
+# Reclaim registry (the ENOSPC escape hatch)
+# ----------------------------------------------------------------------
+
+def register_reclaimer(name: str, fn: Callable[[int], int]) -> None:
+    """Register ``fn(bytes_needed) -> bytes_freed`` under ``name``
+    (idempotent: re-registering a name replaces it). Components offer up
+    what they can afford to lose: quarantined wreckage, old flight
+    dumps, cold disk-tier blocks."""
+    with _lock:
+        _reclaimers[name] = fn
+
+
+def unregister_reclaimer(name: str) -> None:
+    with _lock:
+        _reclaimers.pop(name, None)
+
+
+def reclaim(bytes_needed: int, path_class: str = "") -> int:
+    """Run reclaimers until ``bytes_needed`` is freed (or all ran).
+    Returns bytes freed. Reclaimer exceptions are logged and skipped —
+    reclaim is best-effort by definition."""
+    with _lock:
+        items = list(_reclaimers.items())
+    freed = 0
+    for name, fn in items:
+        try:
+            freed += max(0, int(fn(max(0, bytes_needed - freed))))
+        except Exception:
+            get_logger().exception("reclaimer %r failed", name)
+        if bytes_needed > 0 and freed >= bytes_needed:
+            break
+    if path_class:
+        with _lock:
+            e = _class_entry(path_class)
+            e["reclaims"] += 1
+            e["reclaimed_bytes"] += freed
+    get_logger().warning(
+        "durable_io: reclaim pass freed %d bytes (%d reclaimers, wanted "
+        "%d) for class %r", freed, len(items), bytes_needed, path_class)
+    return freed
+
+
+def dir_bytes(path: str) -> int:
+    """Recursive byte count of ``path`` (file or directory)."""
+    if os.path.isfile(path):
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
+def sweep_oldest(directory: str, keep: int = 0,
+                 bytes_needed: int = 0) -> int:
+    """Delete oldest-mtime entries under ``directory`` until only
+    ``keep`` remain (and, when ``bytes_needed`` > 0, stop early once
+    enough is freed). Returns bytes freed."""
+    if not os.path.isdir(directory):
+        return 0
+    try:
+        entries = sorted(
+            (os.path.join(directory, n) for n in os.listdir(directory)),
+            key=lambda p: os.path.getmtime(p) if os.path.exists(p) else 0)
+    except OSError:
+        return 0
+    freed = 0
+    doomed = entries[:-keep] if keep > 0 else entries
+    for path in doomed:
+        size = dir_bytes(path)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.remove(path)
+        except OSError:
+            continue
+        freed += size
+        if bytes_needed > 0 and freed >= bytes_needed:
+            break
+    return freed
+
+
+def quarantine_reclaimer(root: str,
+                         subdir: str = "_quarantine") -> Callable[[int], int]:
+    """A reclaimer that quota-evicts ``root/subdir`` oldest-first —
+    quarantined wreckage is forensics, and forensics lose to keeping the
+    run writing."""
+    qdir = os.path.join(os.path.abspath(root), subdir)
+
+    def _sweep(bytes_needed: int) -> int:
+        return sweep_oldest(qdir, keep=0, bytes_needed=bytes_needed)
+
+    return _sweep
+
+
+# ----------------------------------------------------------------------
+# The durable operations
+# ----------------------------------------------------------------------
+
+def _attempt(op: Callable[[], None], path: str, path_class: str,
+             nbytes: int, retries: Optional[int],
+             backoff_s: float) -> bool:
+    """Run ``op`` under the classified retry/reclaim/degrade policy.
+    Returns True on success; False when a drop-class gave up; re-raises
+    the final OSError for raising classes."""
+    raises, default_retries = _POLICY[path_class]
+    budget = default_retries if retries is None else retries
+    d = os.path.dirname(path)
+    if d:
+        _probe_dir[0] = d
+    attempt = 0
+    reclaimed = False
+    while True:
+        try:
+            op()
+        except OSError as e:
+            _note_error(path_class, e)
+            kind = classify_errno(e)
+            probe_free_bytes(d or ".")
+            if kind == "reclaim" and not reclaimed:
+                reclaimed = True
+                if reclaim(max(nbytes, 1), path_class) > 0:
+                    continue  # space came back: retry without burning budget
+            if kind in ("transient", "reclaim") and attempt < budget:
+                time.sleep(backoff_s * (2 ** attempt))
+                attempt += 1
+                continue
+            _set_degraded(path_class)
+            if raises:
+                raise
+            _note_drop(path_class)
+            get_logger().warning(
+                "durable_io: dropped %s write to %s (%s)", path_class,
+                path, e)
+            return False
+        else:
+            _note_write(path_class, nbytes)
+            return True
+
+
+def write_bytes(path: str, data: bytes, *, path_class: str,
+                fsync: bool = False, retries: Optional[int] = None,
+                backoff_s: float = 0.05) -> bool:
+    """Durably write ``data`` to ``path`` (replacing it). Returns True on
+    success; drop-class failures return False; raising classes re-raise
+    the final OSError."""
+    path = str(path)
+    return _attempt(lambda: _raw_write_bytes(path, data, fsync),
+                    path, path_class, len(data), retries, backoff_s)
+
+
+def append_line(path: str, text: str, *, path_class: str,
+                retries: Optional[int] = None,
+                backoff_s: float = 0.05) -> bool:
+    """Durably append ``text`` (newline added if missing) to ``path``."""
+    path = str(path)
+    line = text if text.endswith("\n") else text + "\n"
+    return _attempt(lambda: _raw_append_text(path, line),
+                    path, path_class, len(line), retries, backoff_s)
+
+
+def replace(src: str, dst: str, *, path_class: str,
+            retries: Optional[int] = None,
+            backoff_s: float = 0.05) -> bool:
+    """Durable ``os.replace`` (atomic rename; works for the staging-dir
+    commits too)."""
+    src, dst = str(src), str(dst)
+    return _attempt(lambda: _raw_replace(src, dst),
+                    dst, path_class, 0, retries, backoff_s)
+
+
+def write_json_atomic(path: str, obj, *, path_class: str,
+                      fsync: bool = False, indent: Optional[int] = None,
+                      sort_keys: bool = False, default=None,
+                      retries: Optional[int] = None) -> bool:
+    """tmp-file + atomic-rename JSON write under the durable policy (the
+    heartbeat/ledger/skip-list idiom, centralized). Returns True only
+    when both the staging write and the rename landed."""
+    path = str(path)
+    data = json.dumps(obj, indent=indent, sort_keys=sort_keys,
+                      default=default).encode()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        if not write_bytes(tmp, data, path_class=path_class, fsync=fsync,
+                           retries=retries):
+            return False
+        if not replace(tmp, path, path_class=path_class, retries=retries):
+            return False
+        return True
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+class LineWriter:
+    """Append-mode line stream with drop-and-count semantics: a write
+    failure counts a drop, closes the handle, and the next write reopens
+    — the stream heals itself when the fault clears and never raises
+    (the step log / heartbeat contract: telemetry must not abort the
+    step it describes)."""
+
+    def __init__(self, path: str, *, path_class: str):
+        self.path = os.path.abspath(path)
+        self.path_class = path_class
+        self.dropped = 0
+        self._f = None
+        self._warned = False
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._reopen()
+
+    def _reopen(self) -> bool:
+        try:
+            self._f = open(self.path, "a")
+            return True
+        except OSError as e:
+            _note_error(self.path_class, e)
+            self._f = None
+            return False
+
+    def write_line(self, text: str) -> bool:
+        line = text if text.endswith("\n") else text + "\n"
+        try:
+            fault = _plan_fault("write", self.path)
+            if fault is not None:
+                if fault.delay_s:
+                    time.sleep(fault.delay_s)
+                if fault.err is not None:
+                    if fault.kind == "torn" and self._f is not None:
+                        self._f.write(line[: max(1, len(line) // 2)])
+                        self._f.flush()
+                    raise OSError(fault.err, "chaos: injected fault",
+                                  self.path)
+            if self._f is None and not self._reopen():
+                raise OSError(errno.EIO, "stream unavailable", self.path)
+            self._f.write(line)
+            self._f.flush()
+        except (OSError, ValueError) as e:
+            if isinstance(e, OSError):
+                _note_error(self.path_class, e)
+            _note_drop(self.path_class)
+            _set_degraded(self.path_class)
+            self.dropped += 1
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except Exception:
+                    pass
+                self._f = None
+            if not self._warned or self.dropped % 100 == 0:
+                self._warned = True
+                get_logger().warning(
+                    "durable_io: %s line dropped on %s (%s; %d dropped "
+                    "so far)", self.path_class, self.path, e, self.dropped)
+            return False
+        _note_write(self.path_class, len(line))
+        return True
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+        self._f = None
+
+    @property
+    def closed(self) -> bool:
+        return self._f is None or self._f.closed

@@ -26,6 +26,7 @@ import numpy as np
 import pytest
 from flax.core import unfreeze
 
+from dlti_tpu.checkpoint.chaos import FaultyIO
 from dlti_tpu.config import LoRAConfig, MODEL_PRESETS
 from dlti_tpu.models import LlamaForCausalLM
 from dlti_tpu.models.lora import merge_lora_params
@@ -42,6 +43,7 @@ from dlti_tpu.serving.adapters import (
     register_adapter,
     save_adapter,
 )
+from dlti_tpu.utils import durable_io
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "scripts"))
@@ -223,6 +225,65 @@ def test_corrupt_after_registration_unregisters_on_load(setup, tmp_path):
     assert not pool.resident("rots")
     with pytest.raises(AdapterError, match="unknown adapter"):
         pool.acquire("rots")
+
+
+# ----------------------------------------------------------------------
+# Storage faults during export (durable-writer integration)
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def _clean_io():
+    durable_io.reset_for_tests()
+    yield
+    durable_io.reset_for_tests()
+
+
+def test_save_adapter_torn_write_quarantines_and_reexport_serves(
+        setup, tmp_path, _clean_io):
+    """A torn write mid-export leaves NOTHING at the target path and no
+    stray staging dir — the partial bytes are quarantined for forensics —
+    and a re-export after the fault clears loads rows byte-identical to
+    an unfaulted export of the same tree."""
+    d = str(tmp_path / "ad-t")
+    with FaultyIO.from_spec("*.bin:torn"):
+        with pytest.raises(OSError):
+            save_adapter(d, setup.trees["ad-a"], alpha=ALPHA)
+    assert not os.path.exists(d)
+    assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+    qdir = os.path.join(str(tmp_path), "_quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+    assert durable_io.is_degraded("adapter")
+
+    save_adapter(d, setup.trees["ad-a"], alpha=ALPHA)  # fault cleared
+    assert not durable_io.is_degraded("adapter")       # success heals
+    register_adapter("ad-t", d)
+    register_adapter("ad-a", setup.dirs["ad-a"])
+    pool = AdapterPool(setup.base, num_slots=2, rank=R, targets=TARGETS)
+    row_t, _ = pool.acquire("ad-t")
+    row_a, _ = pool.acquire("ad-a")
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           _row(pool, row_t), _row(pool, row_a))
+
+
+def test_save_adapter_enospc_reclaims_quarantine_then_lands(
+        setup, tmp_path, _clean_io):
+    """ENOSPC mid-export: the reclaim pass quota-evicts the quarantined
+    wreckage a previous failed save left behind, then the free retry
+    lands the export whole (digest-verified at registration)."""
+    with FaultyIO.from_spec("*.bin:torn"):
+        with pytest.raises(OSError):
+            save_adapter(str(tmp_path / "ad-bad"), setup.trees["ad-a"],
+                         alpha=ALPHA)
+    qdir = tmp_path / "_quarantine"
+    assert list(qdir.iterdir())
+
+    d = str(tmp_path / "ad-ok")
+    with FaultyIO.from_spec("*.bin:ENOSPC:1"):
+        save_adapter(d, setup.trees["ad-a"], alpha=ALPHA)
+    assert not qdir.exists() or not list(qdir.iterdir())
+    led = durable_io.disk_ledger()["adapter"]
+    assert led["reclaims"] == 1 and led["reclaimed_bytes"] > 0
+    register_adapter("ad-ok", d)  # digest verification: export is whole
 
 
 # ----------------------------------------------------------------------

@@ -197,7 +197,32 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "hung_step", "throughput_collapse", "queue_buildup",
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
-        "goodput_collapse", "hbm_pressure",
+        "goodput_collapse", "hbm_pressure", "disk_pressure",
+    )
+
+
+def test_disk_metric_names_are_schema_stable():
+    """Durable-writer health names are a scrape contract like the
+    watchdog/ckpt sets: the free-bytes gauge plus the path_class-labeled
+    write-error counter and degraded gauge, all registered by the server
+    registry and watched by the disk_pressure rule."""
+    from dlti_tpu.utils import durable_io
+
+    assert durable_io.DISK_METRIC_NAMES == (
+        "dlti_disk_free_bytes",
+        "dlti_disk_write_errors_total",
+        "dlti_disk_degraded",
+    )
+    assert durable_io.free_bytes_gauge.name == \
+        durable_io.DISK_METRIC_NAMES[0]
+    assert durable_io.write_errors_total.name == \
+        durable_io.DISK_METRIC_NAMES[1]
+    assert durable_io.degraded_gauge.name == durable_io.DISK_METRIC_NAMES[2]
+    # The path-class set is the degradation-policy contract (the README
+    # criticality table and the AST guard's covered modules key on it).
+    assert durable_io.PATH_CLASSES == (
+        "checkpoint", "adapter", "prefix_tier", "flight",
+        "steplog", "elastic", "sentinel", "watchdog",
     )
 
 

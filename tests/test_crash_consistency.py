@@ -147,26 +147,38 @@ def test_all_checkpoints_corrupt_returns_none(tmp_path):
     assert restore_latest_verified(d, target) is None
 
 
-def test_save_retries_transient_failure(tmp_path, monkeypatch):
-    """A transient commit failure retries with backoff (counted) and the
-    checkpoint still lands."""
-    import dlti_tpu.checkpoint.store as store
+def test_save_retries_transient_failure(tmp_path):
+    """Transient I/O faults during a save retry with backoff and the
+    checkpoint still lands — healed *below* the store by the durable
+    writer (ledger-counted) while they fit its budget; exhausting that
+    budget escapes to the store's staging-cycle retry loop
+    (``dlti_ckpt_save_retries``), which restages and commits."""
+    from dlti_tpu.checkpoint.chaos import FaultyIO
+    from dlti_tpu.utils import durable_io
 
-    real_rename = os.rename
-    fails = {"left": 2}
+    durable_io.reset_for_tests()
+    try:
+        # 2 EIOs: absorbed by the durable writer's own transient retry.
+        before = save_retries.value
+        with FaultyIO.from_spec(f"{tmp_path}{os.sep}.tmp-2-*:EIO:2"):
+            save_train_state(str(tmp_path), 2, _tree(0), async_save=False,
+                             retries=3, retry_backoff_s=0.01)
+        assert save_retries.value == before  # never reached the store loop
+        assert durable_io.disk_ledger()["checkpoint"]["errors"] == 2
+        assert verify_checkpoint(str(tmp_path), 2) == (True, "ok")
 
-    def flaky_rename(src, dst):
-        if fails["left"] > 0 and os.path.basename(src).startswith(".tmp-"):
-            fails["left"] -= 1
-            raise OSError("injected transient rename failure")
-        return real_rename(src, dst)
-
-    monkeypatch.setattr(store.os, "rename", flaky_rename)
-    before = save_retries.value
-    save_train_state(str(tmp_path), 2, _tree(0), async_save=False,
-                     retries=3, retry_backoff_s=0.01)
-    assert save_retries.value == before + 2
-    assert verify_checkpoint(str(tmp_path), 2) == (True, "ok")
+        # 4 EIOs on one op: the checkpoint class's durable budget (3
+        # retries = 4 attempts) exhausts, the store books a save retry
+        # and restages into a fresh .tmp-* — the commit still lands.
+        with FaultyIO.from_spec(f"{tmp_path}{os.sep}.tmp-3-*:EIO:4"):
+            save_train_state(str(tmp_path), 3, _tree(1), async_save=False,
+                             retries=3, retry_backoff_s=0.01)
+        assert save_retries.value == before + 1
+        assert verify_checkpoint(str(tmp_path), 3) == (True, "ok")
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith(".tmp-")]
+    finally:
+        durable_io.reset_for_tests()
 
 
 def test_save_failure_is_bounded_and_never_raises_on_wait(tmp_path,

@@ -1,0 +1,263 @@
+"""Unit tests: the durable writer's classified retry/reclaim/degrade
+policy and the FaultyIO chaos injector it pairs with.
+
+Everything here runs on the real filesystem with *injected* faults (the
+``DLTI_IO_FAULT`` spec / an installed ``FaultyIO``) — no monkeypatched
+builtins — because the injection point (durable_io's raw ops) is exactly
+the boundary production writes cross.
+"""
+
+import errno
+import json
+import os
+
+import pytest
+
+from dlti_tpu.checkpoint.chaos import FaultyIO, IOFault
+from dlti_tpu.utils import durable_io
+
+
+@pytest.fixture(autouse=True)
+def _clean_durable_io_state():
+    durable_io.reset_for_tests()
+    yield
+    durable_io.reset_for_tests()
+
+
+# ----------------------------------------------------------------------
+# Errno classification
+# ----------------------------------------------------------------------
+
+def test_classify_errno():
+    assert durable_io.classify_errno(OSError(errno.EIO, "x")) == "transient"
+    assert durable_io.classify_errno(OSError(errno.EAGAIN, "x")) == "transient"
+    assert durable_io.classify_errno(OSError(errno.ESTALE, "x")) == "transient"
+    assert durable_io.classify_errno(OSError(errno.ENOSPC, "x")) == "reclaim"
+    assert durable_io.classify_errno(OSError(errno.EDQUOT, "x")) == "reclaim"
+    assert durable_io.classify_errno(OSError(errno.EACCES, "x")) == "persistent"
+    assert durable_io.classify_errno(ValueError("x")) == "persistent"
+
+
+# ----------------------------------------------------------------------
+# FaultyIO spec parsing
+# ----------------------------------------------------------------------
+
+def test_parse_rule_errno_count_delay():
+    r = FaultyIO.parse_rule("*ckpt*:ENOSPC:3:0.5")
+    assert (r.glob, r.kind, r.err, r.remaining, r.rate, r.delay_s) == \
+        ("*ckpt*", "enospc", errno.ENOSPC, 3, None, 0.5)
+
+
+def test_parse_rule_rate_and_torn_and_slow():
+    r = FaultyIO.parse_rule("MANIFEST.json:EIO:0.5")
+    assert r.rate == 0.5 and r.remaining is None
+    t = FaultyIO.parse_rule("*:torn")
+    assert t.err == errno.EIO and t.kind == "torn"
+    s = FaultyIO.parse_rule("*:slow")
+    assert s.err is None and s.delay_s > 0
+
+
+@pytest.mark.parametrize("bad", [
+    "no-errno-part", ":EIO", "*:NOTANERRNO", "*:EIO:0", "*:EIO:-2",
+    "*:EIO:1.5",
+])
+def test_parse_rule_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        FaultyIO.parse_rule(bad)
+
+
+def test_from_spec_multi_rule_and_empty():
+    inj = FaultyIO.from_spec("*a*:EIO:1;*b*:ENOSPC")
+    assert len(inj.faults) == 2
+    assert FaultyIO.from_spec("  ;  ") is None
+
+
+def test_fault_matching_full_path_and_basename():
+    f = IOFault(glob="hb_*.json", kind="eio", err=errno.EIO)
+    assert f.matches("/any/where/hb_g0_r1.json")
+    assert not f.matches("/any/where/ledger_g0_r1.json")
+
+
+def test_count_budget_consumed_then_clears():
+    inj = FaultyIO.from_spec("*:EIO:2")
+    assert inj.plan("write", "/x") is not None
+    assert inj.plan("write", "/x") is not None
+    assert inj.plan("write", "/x") is None  # budget spent: fault cleared
+    assert inj.total_fired == 2
+
+
+# ----------------------------------------------------------------------
+# write_bytes: retry / degrade / recover
+# ----------------------------------------------------------------------
+
+def test_transient_eio_is_retried_away(tmp_path):
+    path = tmp_path / "ckpt.bin"
+    with FaultyIO.from_spec("*ckpt.bin:EIO:2"):
+        assert durable_io.write_bytes(str(path), b"payload",
+                                      path_class="checkpoint",
+                                      backoff_s=0.001)
+    assert path.read_bytes() == b"payload"
+    led = durable_io.disk_ledger()["checkpoint"]
+    assert led["errors"] == 2 and led["writes"] == 1
+    assert not durable_io.is_degraded("checkpoint")
+
+
+def test_raising_class_reraises_after_budget(tmp_path):
+    path = tmp_path / "ckpt.bin"
+    with FaultyIO.from_spec("*ckpt.bin:EACCES"):
+        with pytest.raises(OSError) as ei:
+            durable_io.write_bytes(str(path), b"x", path_class="checkpoint",
+                                   backoff_s=0.001)
+    assert ei.value.errno == errno.EACCES
+    assert durable_io.is_degraded("checkpoint")
+
+
+def test_drop_class_returns_false_and_counts(tmp_path):
+    path = tmp_path / "log.jsonl"
+    with FaultyIO.from_spec("*log.jsonl:EIO"):
+        assert durable_io.append_line(str(path), "line",
+                                      path_class="steplog",
+                                      backoff_s=0.001) is False
+    led = durable_io.disk_ledger()["steplog"]
+    assert led["drops"] == 1
+    assert durable_io.is_degraded("steplog")
+    assert durable_io.degraded_classes() == ("steplog",)
+
+
+def test_first_success_clears_degraded(tmp_path):
+    path = tmp_path / "log.jsonl"
+    with FaultyIO.from_spec("*log.jsonl:EIO:1"):
+        durable_io.append_line(str(path), "dropped", path_class="steplog")
+    assert durable_io.is_degraded("steplog")
+    assert durable_io.append_line(str(path), "kept", path_class="steplog")
+    assert not durable_io.is_degraded("steplog")
+    assert path.read_text() == "kept\n"
+
+
+def test_torn_write_leaves_half_payload(tmp_path):
+    path = tmp_path / "blob.bin"
+    with FaultyIO.from_spec("*blob.bin:torn"):
+        with pytest.raises(OSError):
+            durable_io.write_bytes(str(path), b"0123456789",
+                                   path_class="checkpoint", retries=0)
+    assert path.read_bytes() == b"01234"  # the wreckage is on disk
+
+
+def test_slow_write_succeeds(tmp_path):
+    path = tmp_path / "s.bin"
+    with FaultyIO.from_spec("*s.bin:slow::0.01"):
+        assert durable_io.write_bytes(str(path), b"x",
+                                      path_class="checkpoint")
+    assert path.read_bytes() == b"x"
+
+
+# ----------------------------------------------------------------------
+# ENOSPC reclaim
+# ----------------------------------------------------------------------
+
+def test_enospc_runs_reclaimers_then_retries(tmp_path):
+    junk = tmp_path / "_quarantine" / "old"
+    junk.mkdir(parents=True)
+    (junk / "w.bin").write_bytes(b"z" * 4096)
+    durable_io.register_reclaimer(
+        "q", durable_io.quarantine_reclaimer(str(tmp_path)))
+    path = tmp_path / "data.bin"
+    # One ENOSPC: the reclaim pass frees quarantine bytes, then the free
+    # retry (no budget burned) succeeds.
+    with FaultyIO.from_spec("*data.bin:ENOSPC:1"):
+        assert durable_io.write_bytes(str(path), b"x" * 16,
+                                      path_class="checkpoint", retries=0)
+    assert not junk.exists()
+    led = durable_io.disk_ledger()["checkpoint"]
+    assert led["reclaims"] == 1 and led["reclaimed_bytes"] >= 4096
+    assert path.read_bytes() == b"x" * 16
+
+
+def test_persistent_enospc_degrades_after_budget(tmp_path):
+    path = tmp_path / "data.bin"
+    with FaultyIO.from_spec("*data.bin:ENOSPC"):
+        with pytest.raises(OSError) as ei:
+            durable_io.write_bytes(str(path), b"x", path_class="checkpoint",
+                                   retries=1, backoff_s=0.001)
+    assert ei.value.errno == errno.ENOSPC
+    assert durable_io.is_degraded("checkpoint")
+
+
+def test_sweep_oldest_keeps_newest(tmp_path):
+    d = tmp_path / "dumps"
+    d.mkdir()
+    for i in range(4):
+        p = d / f"f{i}"
+        p.write_bytes(b"x" * 10)
+        os.utime(p, (i, i))  # deterministic mtime order
+    freed = durable_io.sweep_oldest(str(d), keep=1)
+    assert freed == 30
+    assert sorted(os.listdir(d)) == ["f3"]
+
+
+# ----------------------------------------------------------------------
+# write_json_atomic / LineWriter
+# ----------------------------------------------------------------------
+
+def test_write_json_atomic_roundtrip_and_no_tmp_left(tmp_path):
+    path = tmp_path / "hb.json"
+    assert durable_io.write_json_atomic(str(path), {"step": 3},
+                                        path_class="elastic")
+    assert json.loads(path.read_text()) == {"step": 3}
+    assert os.listdir(tmp_path) == ["hb.json"]  # staging tmp cleaned up
+
+
+def test_write_json_atomic_drop_class_failure_keeps_old_file(tmp_path):
+    path = tmp_path / "hb.json"
+    path.write_text('{"step": 1}')
+    with FaultyIO.from_spec("*:EIO"):
+        assert durable_io.write_json_atomic(str(path), {"step": 2},
+                                            path_class="elastic",
+                                            retries=0) is False
+    # The previous atomic write survives a failed refresh intact.
+    assert json.loads(path.read_text()) == {"step": 1}
+
+
+def test_linewriter_drops_and_self_heals(tmp_path):
+    path = tmp_path / "stream.jsonl"
+    w = durable_io.LineWriter(str(path), path_class="steplog")
+    assert w.write_line("a")
+    with FaultyIO.from_spec("*stream.jsonl:EIO"):
+        assert w.write_line("b") is False
+        assert w.write_line("c") is False
+    assert w.dropped == 2
+    assert w.write_line("d")  # fault cleared: stream reopens and heals
+    w.close()
+    assert path.read_text().splitlines() == ["a", "d"]
+    assert not durable_io.is_degraded("steplog")
+
+
+# ----------------------------------------------------------------------
+# Env-spec activation + scalars
+# ----------------------------------------------------------------------
+
+def test_env_spec_injects_without_install(tmp_path, monkeypatch):
+    monkeypatch.setenv(durable_io.IO_FAULT_ENV, "*env.bin:EIO")
+    assert durable_io.write_bytes(str(tmp_path / "env.bin"), b"x",
+                                  path_class="steplog", retries=0) is False
+    monkeypatch.delenv(durable_io.IO_FAULT_ENV)
+    # Spec change (removal) re-parses: writes work again.
+    assert durable_io.write_bytes(str(tmp_path / "env.bin"), b"x",
+                                  path_class="steplog")
+
+
+def test_scalars_report_errors_and_degraded(tmp_path):
+    with FaultyIO.from_spec("*:EIO"):
+        durable_io.append_line(str(tmp_path / "l"), "x",
+                               path_class="steplog")
+    s = durable_io.scalars()
+    assert s["disk_write_errors"] >= 1
+    assert s["disk_write_drops"] == 1
+    assert s["disk_degraded"] == 1
+    assert s["disk_free_bytes"] > 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -51,8 +51,10 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.training.sentinel import (
         SDC_METRIC_NAMES, SENTINEL_METRIC_NAMES,
     )
+    from dlti_tpu.utils.durable_io import DISK_METRIC_NAMES
 
     for tup, where in ((CKPT_METRIC_NAMES, "checkpoint"),
+                       (DISK_METRIC_NAMES, "durable_io"),
                        (PREFETCH_METRIC_NAMES, "prefetch"),
                        (GATEWAY_METRIC_NAMES, "gateway"),
                        (PREFIX_CACHE_METRIC_NAMES, "prefix_cache"),
@@ -76,6 +78,7 @@ def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.serving import adapters
     from dlti_tpu.telemetry import flightrecorder, ledger, memledger, watchdog
     from dlti_tpu.training import elastic, sentinel
+    from dlti_tpu.utils import durable_io
 
     objs = (adapters.loads_total, adapters.evictions_total,
             adapters.pool_hits_total, adapters.pool_misses_total,
@@ -92,7 +95,9 @@ def test_module_level_metric_objects_follow_convention():
             ledger.goodput_mfu_gauge, ledger.phase_seconds_total,
             ledger.phase_requests_total,
             memledger.hbm_bytes_gauge, memledger.hbm_peak_gauge,
-            memledger.hbm_headroom_gauge, memledger.hbm_untracked_gauge)
+            memledger.hbm_headroom_gauge, memledger.hbm_untracked_gauge,
+            durable_io.free_bytes_gauge, durable_io.write_errors_total,
+            durable_io.degraded_gauge)
     _assert_convention([m.name for m in objs], "module-level metrics")
 
 
@@ -164,6 +169,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_request_phase_seconds_total",
                      "dlti_hbm_bytes",
                      "dlti_hbm_headroom_bytes",
+                     "dlti_disk_free_bytes",
+                     "dlti_disk_write_errors_total",
+                     "dlti_disk_degraded",
                      "dlti_heartbeat_lag_steps"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")

@@ -23,9 +23,11 @@ import os
 import numpy as np
 import pytest
 
+from dlti_tpu.checkpoint.chaos import FaultyIO
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.prefix_cache import PrefixCachingAllocator
 from dlti_tpu.serving.prefix_tiers import TieredBlockStore, key_digest
+from dlti_tpu.utils import durable_io
 
 
 def _payload(block: int, layers: int = 2) -> dict:
@@ -291,6 +293,92 @@ def test_allocator_counts_corruption_as_tier_miss(tmp_path):
         open(f, "wb").write(b"garbage")
     assert pc.fetch_restore(key) == (None, None)
     assert pc.stats["tier_corrupt_dropped"] == 1
+
+
+# ----------------------------------------------------------------------
+# Disk-tier WRITE faults: drop, degrade, reclaim — never an error
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def _clean_io():
+    durable_io.reset_for_tests()
+    yield
+    durable_io.reset_for_tests()
+
+
+def test_disk_write_fault_drops_block_and_quarantines(tmp_path, _clean_io):
+    """A torn write during demotion is a dropped block (a future cache
+    miss), never an exception: nothing lands at the live block path, the
+    partial staging bytes are quarantined, and the next demotion after
+    the fault clears round-trips byte-identically."""
+    store = TieredBlockStore(disk_dir=str(tmp_path), disk_blocks=4)
+    key = ((), (1, 2, 3, 4))
+    with FaultyIO.from_spec("*.bin:torn"):
+        assert store.put(key, _payload(5)) is None  # dropped, no raise
+    assert store.stats["disk_write_failures"] == 1
+    assert store.tier_of(key) is None
+    assert store.fetch(key) == (None, None)
+    assert not glob.glob(os.path.join(str(tmp_path), "block-*"))
+    assert glob.glob(os.path.join(str(tmp_path), "_quarantine", "*"))
+
+    p = _payload(5)
+    assert store.put(key, p) == "disk"  # fault cleared: probe lands
+    got, tier = store.fetch(key)
+    assert tier == "disk"
+    for layer in p:
+        for name in p[layer]:
+            assert p[layer][name].tobytes() == got[layer][name].tobytes()
+
+
+def test_disk_tier_degrades_memory_only_then_auto_recovers(tmp_path,
+                                                           _clean_io):
+    """``disk_fail_limit`` consecutive write failures flip the tier
+    memory-only; during the cooldown demotions are skipped WITHOUT
+    touching the disk; after the cooldown the next demotion probes and
+    a success re-arms the tier."""
+    now = [0.0]
+    store = TieredBlockStore(disk_dir=str(tmp_path), disk_blocks=8,
+                             disk_fail_limit=2, disk_retry_cooldown_s=10.0,
+                             clock=lambda: now[0])
+    keys = [((), (i,)) for i in range(5)]
+    inj = FaultyIO.from_spec("*.bin:EIO")
+    with inj:
+        assert store.put(keys[0], _payload(0)) is None
+        assert not store.disk_degraded        # one strike: still trying
+        assert store.put(keys[1], _payload(1)) is None
+        assert store.disk_degraded            # second strike: flipped
+        fired = inj.total_fired
+        assert store.put(keys[2], _payload(2)) is None
+        assert inj.total_fired == fired       # skipped: disk never touched
+    assert store.stats["disk_write_failures"] == 2
+    assert store.stats["disk_degraded_skips"] == 1
+    # Fault gone but cooldown not elapsed: still memory-only.
+    assert store.put(keys[3], _payload(3)) is None
+    assert store.stats["disk_degraded_skips"] == 2
+    now[0] = 11.0  # cooldown expired: next demotion probes the disk
+    assert store.put(keys[4], _payload(4)) == "disk"
+    assert not store.disk_degraded
+    assert store.fetch(keys[4])[1] == "disk"
+    # Fully re-armed: subsequent demotions write through again.
+    assert store.put(keys[0], _payload(0)) == "disk"
+
+
+def test_disk_tier_enospc_reclaims_cold_blocks(tmp_path, _clean_io):
+    """ENOSPC during a demotion triggers the store's own reclaimer: the
+    coldest live blocks are quota-evicted (each is just a future cache
+    hit) and the free retry lands the new block."""
+    store = TieredBlockStore(disk_dir=str(tmp_path), disk_blocks=8)
+    for i in range(3):
+        assert store.put(((), (i,)), _payload(i)) == "disk"
+    with FaultyIO.from_spec("*.bin:ENOSPC:1"):
+        assert store.put(((), (9,)), _payload(9)) == "disk"
+    # The LRU-coldest block was sacrificed to keep the tier writing.
+    assert store.tier_of(((), (0,))) is None
+    assert store.stats["disk_evictions"] >= 1
+    led = durable_io.disk_ledger()["prefix_tier"]
+    assert led["reclaims"] == 1 and led["reclaimed_bytes"] > 0
+    got, tier = store.fetch(((), (9,)))
+    assert tier == "disk" and got is not None
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,404 @@
+"""Storage fault tolerance drills: injected I/O faults against the real
+trainer, flight recorder, and watchdog.
+
+The tier-1 half of the storage-chaos story (unit contracts for the
+durable writer itself live in ``tests/test_durable_io.py``; the
+adapter/prefix-tier write-fault cases ride in their own suites):
+
+* **Steplog EIO mid-epoch** — telemetry writes are drop-and-count, so an
+  I/O fault on the step log costs log lines, never a training step: the
+  surviving lines carry the exact float losses of a clean run.
+* **ENOSPC mid-async-save** — the save is skipped (bounded retries, no
+  torn staging dir left), a ``disk_pressure`` alert fires off the same
+  scalars the in-trainer watchdog samples, training completes, and a
+  resume lands on the last pre-fault *verified* step with a bit-identical
+  replay.
+* **Flight recorder under ENOSPC** — the reclaim pass rotates the oldest
+  dumps and the squeezed dump still lands; a persistently dead disk is
+  counted (``dump_failures``) and recorded as a ``dump_failed`` event in
+  the watchdog event log, never raised.
+* **Watchdog** ``disk_pressure`` **rule** — all three triggers (free
+  floor, error growth, degraded class), edge-triggered per episode, fed
+  both synthetically and by the real ``durable_io.scalars()``.
+
+The slow tier runs the honest versions: ``scripts/train.py`` in a
+subprocess with ``DLTI_IO_FAULT`` set in its environment (the env
+activation path, no in-process injector), and a serving engine whose
+prefix disk tier dies mid-run yet finishes every request byte-identical
+to an untier'd engine.
+"""
+
+import errno
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dlti_tpu.checkpoint import latest_verified_step, list_checkpoint_steps
+from dlti_tpu.checkpoint.chaos import FaultyIO
+from dlti_tpu.config import (
+    CheckpointConfig, Config, DataConfig, LoRAConfig, MODEL_PRESETS,
+    OptimizerConfig, ParallelConfig, TelemetryConfig, TrainConfig,
+    WatchdogConfig,
+)
+from dlti_tpu.data import TokenBatchDataset
+from dlti_tpu.telemetry import (
+    AnomalyWatchdog, FlightRecorder, SpanTracer, TimeSeriesSampler,
+)
+from dlti_tpu.telemetry import watchdog as watchdog_mod
+from dlti_tpu.telemetry.flightrecorder import list_dumps
+from dlti_tpu.utils import durable_io
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_io():
+    durable_io.reset_for_tests()
+    yield
+    durable_io.reset_for_tests()
+
+
+def _watchdog(sampler, **over):
+    kw = dict(enabled=True, interval_s=0.05, hung_step_min_s=30.0)
+    kw.update(over)
+    return AnomalyWatchdog(WatchdogConfig(**kw), sampler,
+                           tracer=SpanTracer(enabled=False),
+                           clock=time.monotonic)
+
+
+# ----------------------------------------------------------------------
+# Watchdog: disk_pressure rule + shared event log
+# ----------------------------------------------------------------------
+
+def test_disk_pressure_rule_three_triggers_edge_per_episode():
+    s = TimeSeriesSampler(capacity=32)
+    state = {"free": 100e9, "err": 0.0, "deg": 0.0}
+    s.add_source(lambda: {"disk_free_bytes": state["free"],
+                          "disk_write_errors": state["err"],
+                          "disk_degraded": state["deg"]})
+    wd = _watchdog(s, disk_free_floor_bytes=int(1e9))
+    s.sample_now()
+    assert wd.check_now() == []  # healthy; error watermark established
+    # (1) error growth: one alert per growth episode.
+    state["err"] = 3.0
+    s.sample_now()
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["disk_pressure"]
+    s.sample_now()
+    assert wd.check_now() == []  # flat since last check: re-armed quietly
+    state["err"] = 5.0
+    s.sample_now()
+    assert [a["rule"] for a in wd.check_now()] == ["disk_pressure"]
+    # (2) a degraded path class: its own trigger key, own episode.
+    state["deg"] = 1.0
+    s.sample_now()
+    assert [a["rule"] for a in wd.check_now()] == ["disk_pressure"]
+    s.sample_now()
+    assert wd.check_now() == []  # same degraded episode: one alert
+    state["deg"] = 0.0
+    s.sample_now()
+    assert wd.check_now() == []  # recovery re-arms
+    # (3) free bytes under the configured floor.
+    state["free"] = 0.5e9
+    s.sample_now()
+    fired = wd.check_now()
+    assert [a["rule"] for a in fired] == ["disk_pressure"]
+    assert "floor" in fired[0]["message"]
+    state["free"] = 50e9
+    s.sample_now()
+    assert wd.check_now() == []
+
+
+def test_disk_pressure_fires_from_real_durable_scalars(tmp_path):
+    """The rule consumes ``durable_io.scalars()`` exactly as the trainer's
+    scalar source exposes them: a real injected fault must alert."""
+    s = TimeSeriesSampler(capacity=8)
+    s.add_source(durable_io.scalars)
+    wd = _watchdog(s)
+    s.sample_now()
+    assert wd.check_now() == []
+    with FaultyIO.from_spec("*x.jsonl:EIO"):
+        durable_io.append_line(str(tmp_path / "x.jsonl"), "a",
+                               path_class="steplog")
+    s.sample_now()
+    fired = wd.check_now()  # errors grew AND a class degraded
+    assert fired and {a["rule"] for a in fired} == {"disk_pressure"}
+
+
+def test_event_log_shared_with_alerts(tmp_path):
+    """``log_event`` appends structured non-alert events (the flight
+    recorder's ``dump_failed``) to the same JSONL file alerts go to."""
+    log = tmp_path / "events.jsonl"
+    watchdog_mod.set_event_log_path(str(log))
+    try:
+        assert watchdog_mod.log_event({"event": "dump_failed",
+                                       "errno": errno.ENOSPC})
+    finally:
+        watchdog_mod.set_event_log_path("")
+    rows = [json.loads(line) for line in open(log)]
+    assert rows[-1] == {"event": "dump_failed", "errno": errno.ENOSPC}
+    assert watchdog_mod.log_event({"event": "x"}) is False  # unconfigured
+
+
+# ----------------------------------------------------------------------
+# Flight recorder: ENOSPC reclaim-and-retry, dump_failed accounting
+# ----------------------------------------------------------------------
+
+def test_flight_dump_enospc_rotates_oldest_and_lands(tmp_path):
+    frdir = str(tmp_path / "fr")
+    rec = FlightRecorder(frdir, tracer=SpanTracer(), keep=4,
+                         min_interval_s=0.0)
+    assert rec.dump(reason="a") is not None
+    assert rec.dump(reason="b") is not None
+    with FaultyIO.from_spec(f"{frdir}{os.sep}*:ENOSPC:1"):
+        path = rec.dump(reason="squeezed")
+    # The reclaim pass sacrificed old dump(s); the squeezed one landed.
+    assert path is not None and os.path.isdir(path)
+    assert rec.dump_failures == 0
+    assert len(list_dumps(frdir)) < 3
+    assert durable_io.disk_ledger()["flight"]["reclaims"] >= 1
+
+
+def test_flight_dump_persistent_enospc_counted_and_logged(tmp_path):
+    log = tmp_path / "events.jsonl"
+    watchdog_mod.set_event_log_path(str(log))
+    frdir = str(tmp_path / "fr")
+    rec = FlightRecorder(frdir, tracer=SpanTracer(), min_interval_s=0.0)
+    try:
+        with FaultyIO.from_spec(f"{frdir}{os.sep}*:ENOSPC"):
+            assert rec.dump(reason="doomed") is None  # never raises
+    finally:
+        watchdog_mod.set_event_log_path("")
+    assert rec.dump_failures == 1
+    assert list_dumps(frdir) == []  # no torn staging dir left behind
+    rows = [json.loads(line) for line in open(log)]
+    [row] = [r for r in rows if r.get("event") == "dump_failed"]
+    assert row["errno"] == errno.ENOSPC
+    assert row["reason"] == "doomed"
+
+
+# ----------------------------------------------------------------------
+# Trainer drills (in-process tier-1; the subprocess/env versions below)
+# ----------------------------------------------------------------------
+
+def _dataset(n=96, seq_len=16):
+    rng = np.random.default_rng(11)
+    seqs = [list(map(int, rng.integers(1, 500,
+                                       size=int(rng.integers(6, 12)))))
+            for _ in range(n)]
+    return TokenBatchDataset(sequences=seqs, seq_len=seq_len, pad_id=0,
+                             micro_batch_size=2, grad_accum_steps=1,
+                             shard_by_host=False, pack=False)
+
+
+def _cfg(tmp_path, tag, max_steps, save_steps=1000, save_strategy="steps"):
+    return Config(
+        model=CFG, lora=LoRAConfig(r=2, alpha=4, dropout=0.0),
+        optimizer=OptimizerConfig(warmup_steps=2),
+        parallel=ParallelConfig(),
+        data=DataConfig(max_seq_len=16, prefetch_depth=2),
+        train=TrainConfig(num_epochs=1, max_steps=max_steps,
+                          micro_batch_size=2, grad_accum_steps=1,
+                          logging_steps=1000,
+                          metrics_csv=str(tmp_path / f"{tag}.csv")),
+        checkpoint=CheckpointConfig(output_dir=str(tmp_path / "ckpt"),
+                                    save_strategy=save_strategy,
+                                    save_steps=save_steps,
+                                    save_total_limit=3, async_save=True,
+                                    save_retries=1,
+                                    save_retry_backoff_s=0.01),
+        telemetry=TelemetryConfig(
+            step_log_path=str(tmp_path / f"{tag}.jsonl")),
+    )
+
+
+def _losses(tmp_path, tag):
+    rows = [json.loads(line) for line in open(tmp_path / f"{tag}.jsonl")]
+    return {r["step"]: r["loss"] for r in rows if r.get("type") == "step"}
+
+
+def test_steplog_eio_mid_epoch_never_costs_a_step(tmp_path):
+    """Telemetry criticality: EIO on the step-log disk drops lines
+    (counted) and self-heals when the fault clears — and the surviving
+    lines carry the EXACT losses of a clean run, proving the fault never
+    touched the training math or aborted a step."""
+    from dlti_tpu.training.trainer import Trainer
+
+    Trainer(_cfg(tmp_path, "ref", max_steps=6,
+                 save_strategy="no")).train(dataset=_dataset())
+    ref = _losses(tmp_path, "ref")
+    assert len(ref) == 6
+
+    flt_cfg = _cfg(tmp_path, "flt", max_steps=6, save_strategy="no")
+    with FaultyIO.from_spec("*flt.jsonl:EIO:4"):
+        state, _ = Trainer(flt_cfg).train(dataset=_dataset())
+    assert int(jax.device_get(state.step)) == 6  # training completed
+    got = _losses(tmp_path, "flt")
+    # Dropped: the run-meta line + steps 1-3. Healed: steps 4-6 + final.
+    assert set(got) == {4, 5, 6}
+    for s in (4, 5, 6):
+        assert got[s] == ref[s], (s, got[s], ref[s])
+    led = durable_io.disk_ledger()["steplog"]
+    assert led["drops"] == 4
+    assert not durable_io.is_degraded("steplog")  # first success cleared it
+
+
+def test_enospc_mid_async_save_skips_alerts_and_resumes_bit_identical(
+        tmp_path):
+    """The PR's acceptance drill, in-process: persistent ENOSPC lands on
+    step 4's async save. The save is skipped (bounded retries, no torn
+    staging dir), training completes, a ``disk_pressure`` alert fires off
+    the same durable scalars the in-trainer watchdog samples, and resume
+    restores the last pre-fault verified step (2) with the replayed steps
+    bit-identical to an uninterrupted run."""
+    from dlti_tpu.training.trainer import Trainer
+
+    Trainer(_cfg(tmp_path, "ref", max_steps=6,
+                 save_strategy="no")).train(dataset=_dataset())
+    ref = _losses(tmp_path, "ref")
+
+    # A watchdog over the exact scalar source the trainer feeds its own
+    # sampler — driven explicitly so the assertion is free of the
+    # background thread's shutdown timing.
+    s = TimeSeriesSampler(capacity=8)
+    s.add_source(durable_io.scalars)
+    alog = tmp_path / "alerts.jsonl"
+    wd = _watchdog(s, alert_log_path=str(alog))
+    s.sample_now()
+    assert wd.check_now() == []  # pre-fault watermark: healthy
+
+    flt_cfg = _cfg(tmp_path, "flt", max_steps=4, save_steps=2)
+    with FaultyIO.from_spec("*.tmp-4-*:ENOSPC"):
+        state, _ = Trainer(flt_cfg).train(dataset=_dataset())
+    assert int(jax.device_get(state.step)) == 4  # trainer never crashed
+    ckpt = str(tmp_path / "ckpt")
+    assert [n for n in os.listdir(ckpt) if n.startswith(".tmp-")] == []
+    assert latest_verified_step(ckpt) == 2  # step 4 skipped, step 2 whole
+    led = durable_io.disk_ledger()["checkpoint"]
+    assert led["errors"] > 0
+
+    s.sample_now()
+    fired = wd.check_now()
+    assert fired and {a["rule"] for a in fired} == {"disk_pressure"}
+    assert any(r["rule"] == "disk_pressure"
+               for r in map(json.loads, open(alog)))
+
+    rest_cfg = _cfg(tmp_path, "rest", max_steps=6, save_steps=6)
+    state, _ = Trainer(rest_cfg).train(dataset=_dataset())
+    assert int(jax.device_get(state.step)) == 6
+    got = _losses(tmp_path, "rest")
+    # Resumed from step 2 (the last pre-fault verified step): replayed
+    # 3..6 with float equality against the uninterrupted run.
+    assert set(got) == {3, 4, 5, 6}
+    for s_ in (3, 4, 5, 6):
+        assert got[s_] == ref[s_], (s_, got[s_], ref[s_])
+    # The resume's successful save (step 6) cleared the degraded flag.
+    assert latest_verified_step(ckpt) == 6
+    assert not durable_io.is_degraded("checkpoint")
+
+
+# ----------------------------------------------------------------------
+# Slow drills: env-activated chaos through the real CLI; dead disk tier
+# under a serving engine
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_train_cli_survives_env_injected_storage_faults(tmp_path):
+    """The honest version: ``scripts/train.py`` in a subprocess with
+    ``DLTI_IO_FAULT`` in its environment (the env activation path — no
+    in-process injector). Step 4's save hits persistent ENOSPC and the
+    first steplog lines hit EIO; the run must exit 0 with the later
+    checkpoints landed and the later step lines written."""
+    rng = np.random.default_rng(5)
+    with open(tmp_path / "corpus.txt", "w") as f:
+        for i in range(160):
+            words = " ".join(f"w{int(w)}" for w in rng.integers(0, 50, 6))
+            f.write(f"sample {i}: {words}\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_backend_optimization_level=0"
+    env[durable_io.IO_FAULT_ENV] = "*.tmp-4-*:ENOSPC;*steps.jsonl:EIO:2"
+    steplog = tmp_path / "steps.jsonl"
+    cmd = [
+        sys.executable, os.path.join(REPO, "scripts", "train.py"),
+        "--preset", "baseline", "--model", "llama_tiny",
+        "--tokenizer", "byte",
+        "--dataset-path", str(tmp_path / "corpus.txt"),
+        "--output-dir", str(tmp_path / "ckpt"),
+        "--max-seq-len", "32", "--per-device-batch-size", "2",
+        "--gradient-accumulation-steps", "1", "--lora-r", "2",
+        "--warmup-steps", "2", "--max-steps", "6", "--save-steps", "2",
+        "--logging-steps", "1000",
+        "--metrics-csv", str(tmp_path / "m.csv"),
+        "--step-log", str(steplog),
+    ]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       text=True, timeout=420)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    ckpt = str(tmp_path / "ckpt")
+    # Step 4's save was skipped; 2 and 6 landed whole and verified.
+    assert list_checkpoint_steps(ckpt) == [2, 6]
+    assert latest_verified_step(ckpt) == 6
+    # The first 2 steplog lines were dropped; later steps + final wrote.
+    rows = [json.loads(line) for line in open(steplog)]
+    steps = {row["step"] for row in rows if row.get("type") == "step"}
+    assert 6 in steps and len(steps) >= 2
+    assert any(row.get("type") == "final" for row in rows)
+
+
+@pytest.mark.slow
+def test_serving_dead_disk_tier_zero_client_errors(tmp_path):
+    """A prefix disk tier whose writes die mid-run: demotions degrade to
+    memory-only (counted), every request still completes, and outputs
+    stay byte-identical to an engine with no tiers at all."""
+    import jax.numpy as jnp
+
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+
+    model = LlamaForCausalLM(CFG, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def _engine(**kw):
+        d = dict(max_seqs=1, block_size=8, num_blocks=7, max_model_len=40,
+                 cache_dtype="float32", eos_token_id=-1,
+                 enable_prefix_caching=True)
+        d.update(kw)
+        return InferenceEngine(CFG, params, EngineConfig(**d))
+
+    tier = str(tmp_path / "tier")
+    eng = _engine(prefix_disk_dir=tier, prefix_disk_blocks=16)
+    plain = _engine()
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    prompts = [[i] * 8 + [7] * 8 + [1, 2, 3] for i in range(4)]
+    for p in prompts:  # warm both engines; tiers absorb real evictions
+        eng.generate([p], sp)
+        plain.generate([p], sp)
+    assert eng.prefix_cache.stats["demotions"] > 0
+
+    store = eng.prefix_cache.tier_store
+    with FaultyIO.from_spec(f"{tier}{os.sep}*:EIO"):
+        for _ in range(2):  # revisit everything with the disk dead
+            for p in prompts:
+                [rt] = eng.generate([p], sp)
+                [rp] = plain.generate([p], sp)
+                assert rt.finish_reason == "length"
+                assert rt.output_token_ids == rp.output_token_ids
+    assert store.stats["disk_write_failures"] >= store.disk_fail_limit
+    assert store.disk_degraded  # flipped memory-only, cooldown pending
+    assert store.stats["disk_degraded_skips"] > 0
+    # Not one request error: the engine's error path was never taken.
+    assert eng.stats["requests"] == plain.stats["requests"]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))
