@@ -122,6 +122,12 @@ class RequestRecord:
     # failover, decode — telemetry.ledger); empty when the server
     # predates it or the request failed.
     phases: dict = field(default_factory=dict)
+    # Replica-lifecycle visibility (serving.lifecycle): how many times
+    # this request's KV was live-migrated between replicas mid-flight,
+    # and how many failover/preempt resubmissions it survived. 0 when
+    # the server predates the fields or the fleet stayed healthy.
+    migrations: int = 0
+    retries: int = 0
 
     @property
     def shed(self) -> bool:
@@ -153,6 +159,16 @@ class LoadReport:
     ttft_p90_s: float = 0.0
     ttft_p99_s: float = 0.0
     tpot_mean_ms: float = 0.0
+    # Tail-of-the-tail latency (p99.9) — the SLO percentile a
+    # self-healing fleet is judged on: a single quarantine/migration
+    # event lands here long before it moves p99.
+    ttft_p999_s: float = 0.0
+    tpot_p999_ms: float = 0.0
+    # Replica-lifecycle disturbance totals over the run: live KV
+    # migrations and failover resubmissions the served requests
+    # reported (RequestRecord.migrations / .retries).
+    migrations_total: int = 0
+    retries_total: int = 0
     # Gateway shed accounting: 429/503 refusals are deliberate
     # load-shedding, counted apart from num_ok and from real errors.
     num_shed: int = 0
@@ -332,6 +348,10 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                             obj["usage"].get("completion_tokens", 0))
                     if obj.get("phases"):
                         rec.phases = dict(obj["phases"])
+                    if "migrations" in obj:
+                        rec.migrations = int(obj.get("migrations") or 0)
+                    if "retries" in obj:
+                        rec.retries = int(obj.get("retries") or 0)
             # Prefer the final chunk's usage (token-accurate; our server
             # always sends it — stream_options.include_usage semantics).
             # Fallback: SSE event count, the stream's visible progress
@@ -345,6 +365,8 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
             rec.output_tokens = int(usage.get("completion_tokens", 0))
             if obj.get("phases"):
                 rec.phases = dict(obj["phases"])
+            rec.migrations = int(obj.get("migrations") or 0)
+            rec.retries = int(obj.get("retries") or 0)
             rec.ok = True
     except Exception as e:  # noqa: BLE001 — one request's failure is a
         # recorded data point, never a crash of the whole load test.
@@ -815,6 +837,10 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         ttft_p90_s=round(_percentile(ttfts, 90), 4),
         ttft_p99_s=round(_percentile(ttfts, 99), 4),
         tpot_mean_ms=round(sum(tpots_ms) / len(tpots_ms), 2) if tpots_ms else 0.0,
+        ttft_p999_s=round(_percentile(ttfts, 99.9), 4),
+        tpot_p999_ms=round(_percentile(tpots_ms, 99.9), 2),
+        migrations_total=sum(r.migrations for r in records),
+        retries_total=sum(r.retries for r in records),
         num_shed=len(shed),
         shed_rate=round(len(shed) / len(records), 4) if records else 0.0,
         per_class=per_class,

@@ -445,6 +445,10 @@ class WatchdogConfig:
     # this floor (0 = free-bytes check off; write-error growth and
     # degraded path classes always fire the rule).
     disk_free_floor_bytes: int = 0
+    # replica_flap: fires when the serving replica-lifecycle flap
+    # breaker evicts a replica (the flaps counter grew across the
+    # watchdog window). 0 = rule off.
+    replica_flap_limit: int = 1
 
 
 @dataclass(frozen=True)
@@ -562,9 +566,11 @@ class GatewayConfig:
     # (default) raises in place of a device fault; "nan-logits" poisons
     # the replica's params with NaN so the engine's REAL numeric output
     # guard (EngineConfig.guard_nonfinite) detects the garbage and trips
-    # the same quarantine path. Also settable via env
-    # DLTI_GATEWAY_FAULT_INJECT; tests and chaos runs use it to exercise
-    # failover without a real device fault.
+    # the same quarantine path; "preempt" simulates a planned preemption
+    # notice — the replica drains via live KV migration to survivors and
+    # enters the lifecycle quarantine (no fault dump). Also settable via
+    # env DLTI_GATEWAY_FAULT_INJECT; tests and chaos runs use it to
+    # exercise failover without a real device fault.
     fault_inject_step: str = ""
 
 
@@ -615,6 +621,35 @@ class DisaggConfig:
 
 
 @dataclass(frozen=True)
+class ReplicaLifecycleConfig:
+    """Serving replica self-healing (``dlti_tpu.serving.lifecycle``): a
+    faulted replica is quarantined instead of permanently evicted, its
+    engine rebuilt from known-good weights, then reinstated only after a
+    passing canary probe — with exponential probation backoff and a flap
+    breaker (repeated quarantine/reinstate cycles inside a window →
+    permanent eviction + watchdog alert). Off by default: with healing
+    disabled a faulted replica stays dead forever (the legacy
+    behavior)."""
+
+    enabled: bool = False
+    # Probation before the first reinstate probe, and the exponential
+    # backoff applied per failed probe (delay = initial * backoff**fails,
+    # capped at max).
+    probation_initial_s: float = 2.0
+    probation_backoff: float = 2.0
+    probation_max_s: float = 60.0
+    # Canary probe: a short greedy generation on the rebuilt replica,
+    # checked against a digest pinned at fleet construction (and
+    # re-pinned on weight reload).
+    canary_prompt_tokens: int = 8
+    canary_max_tokens: int = 4
+    # Flap breaker: more than flap_max_cycles quarantines within
+    # flap_window_s seconds evicts the replica permanently.
+    flap_window_s: float = 300.0
+    flap_max_cycles: int = 3
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
@@ -622,6 +657,8 @@ class ServingConfig:
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     prefix_tiers: PrefixTierConfig = field(default_factory=PrefixTierConfig)
     disagg: DisaggConfig = field(default_factory=DisaggConfig)
+    lifecycle: ReplicaLifecycleConfig = field(
+        default_factory=ReplicaLifecycleConfig)
 
 
 @dataclass(frozen=True)
@@ -673,7 +710,7 @@ class Config:
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
-                    "disagg",
+                    "disagg", "lifecycle",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -686,6 +723,7 @@ class Config:
                         "prefix_tiers": PrefixTierConfig,
                         "sentinel": SentinelConfig,
                         "disagg": DisaggConfig,
+                        "lifecycle": ReplicaLifecycleConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -155,6 +155,7 @@ class DisaggController:
         handoff_queue_depth: int = 8,
         handoff_deadline_s: float = 0.0,
         affinity_spill_threshold: int = 4,
+        lifecycle_cfg=None,
     ):
         import jax
 
@@ -186,7 +187,7 @@ class DisaggController:
                 devices=devices[:split], max_retries=max_retries,
                 fault_inject_step=faults.get("prefill", ""),
                 affinity_spill_threshold=affinity_spill_threshold,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry, lifecycle_cfg=lifecycle_cfg)
             self.decode = ReplicatedEngine(
                 model_cfg, params, engine_cfg, lora_cfg,
                 replicas=decode_replicas, tensor=tensor,
@@ -194,7 +195,7 @@ class DisaggController:
                 max_retries=max_retries,
                 fault_inject_step=faults.get("decode", ""),
                 affinity_spill_threshold=affinity_spill_threshold,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry, lifecycle_cfg=lifecycle_cfg)
         finally:
             if env_saved is not None:
                 os.environ[FAULT_INJECT_ENV] = env_saved
@@ -489,6 +490,24 @@ class DisaggController:
     @property
     def num_live(self) -> int:
         return self.prefill.num_live + self.decode.num_live
+
+    # -- replica lifecycle (pool-aware) ---------------------------------
+    @property
+    def lifecycle_pending(self) -> bool:
+        return self.prefill.lifecycle_pending or self.decode.lifecycle_pending
+
+    def lifecycle_counts(self) -> dict:
+        """/health summary aggregated across both pools."""
+        pc, dc = self.prefill.lifecycle_counts(), self.decode.lifecycle_counts()
+        return {k: pc[k] + dc[k] for k in pc}
+
+    def request_reload(self, weights_provider) -> bool:
+        """Rolling weight reload across BOTH pools (prefill first — a
+        mixed-version window between the pools is unavoidable mid-roll;
+        each pool stays internally consistent)."""
+        ok_p = self.prefill.request_reload(weights_provider)
+        ok_d = self.decode.request_reload(weights_provider)
+        return ok_p and ok_d
 
     @property
     def failover(self) -> dict:

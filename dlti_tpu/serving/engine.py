@@ -225,6 +225,10 @@ class Request:
     # Failover resubmissions consumed (ReplicatedEngine moves a dead
     # replica's requests onto survivors up to a retry cap).
     num_retries: int = 0
+    # Live migrations survived (planned drains hand this request's paged
+    # KV to a survivor mid-decode instead of re-prefilling; the server
+    # surfaces the count so load drills can assert on it).
+    num_migrations: int = 0
     # Admission metadata (set by the gateway when one is configured; the
     # engine itself schedules FCFS and ignores them).
     tenant: str = ""

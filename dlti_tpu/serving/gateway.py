@@ -262,6 +262,7 @@ class AdmissionGateway:
         self._queued_tokens = 0
         self._inflight: List[_Pending] = []
         self._draining = False
+        self._drain_t0: Optional[float] = None
         self._stop = False
 
         # Metrics: labeled counters are first-class registry objects; live
@@ -351,9 +352,18 @@ class AdmissionGateway:
         with self._cond:
             if self._draining or self._stop:
                 self._reject("draining")
+                # Retry-After derived from the expected drain time: the
+                # remaining SIGTERM grace window (a retrying client that
+                # honors it lands on the replacement process, not on the
+                # next refusal), floored at the static backoff.
+                retry_after = self.cfg.retry_after_s
+                if self._drain_t0 is not None:
+                    remaining = self.cfg.drain_grace_s - (
+                        time.monotonic() - self._drain_t0)
+                    retry_after = max(self.cfg.retry_after_s, remaining)
                 raise AdmissionError(
                     503, "server is draining; not accepting new requests",
-                    retry_after=self.cfg.retry_after_s)
+                    retry_after=retry_after)
             if self.cfg.rate_limit_rps > 0:
                 burst = (self.cfg.rate_limit_burst
                          or max(1.0, 2.0 * self.cfg.rate_limit_rps))
@@ -464,7 +474,7 @@ class AdmissionGateway:
                             now - e.enqueue_t, 4))
                     e.q.put(("reject", 503,
                              "deadline expired while queued (shed before "
-                             "prefill)"))
+                             "prefill)", self.cfg.retry_after_s))
         alive = []
         for e in self._inflight:
             if e.handle.done:
@@ -530,6 +540,8 @@ class AdmissionGateway:
         requests run to completion. ``/health`` reports ``draining``."""
         with self._cond:
             self._draining = True
+            if self._drain_t0 is None:
+                self._drain_t0 = time.monotonic()
             self._cond.notify()
         self.logger.info("gateway draining: refusing new admissions")
 

@@ -27,10 +27,13 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 
-from dlti_tpu.config import LoRAConfig, ModelConfig, ParallelConfig
+from dlti_tpu.config import (
+    LoRAConfig, ModelConfig, ParallelConfig, ReplicaLifecycleConfig,
+)
 from dlti_tpu.serving.engine import (
     EngineConfig, GenerationResult, InferenceEngine, Request, SamplingParams,
 )
+from dlti_tpu.serving.lifecycle import ReplicaLifecycle, canary_digest
 from dlti_tpu.telemetry import RequestTelemetry
 from dlti_tpu.utils.logging import get_logger
 
@@ -40,7 +43,7 @@ from dlti_tpu.utils.logging import get_logger
 FAULT_INJECT_ENV = "DLTI_GATEWAY_FAULT_INJECT"
 
 
-_FAULT_MODES = ("raise", "nan-logits")
+_FAULT_MODES = ("raise", "nan-logits", "preempt")
 
 
 def _parse_fault_inject(spec: str) -> Optional[Tuple[int, int, str]]:
@@ -49,7 +52,10 @@ def _parse_fault_inject(spec: str) -> Optional[Tuple[int, int, str]]:
     place of a device fault; "nan-logits" instead poisons the replica's
     params with NaN so the engine's REAL numeric guard
     (:class:`~dlti_tpu.serving.engine.NumericFault`) detects the garbage
-    output and trips the same quarantine path."""
+    output and trips the same quarantine path; "preempt" simulates a
+    planned preemption notice — the replica drains via live KV migration
+    to survivors (:meth:`ReplicatedEngine.drain_replica`) and enters the
+    lifecycle quarantine instead of faulting."""
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -99,6 +105,8 @@ class ReplicatedEngine:
         fault_inject_step: str = "",
         affinity_spill_threshold: int = 4,
         telemetry: Optional[RequestTelemetry] = None,
+        lifecycle_cfg: Optional[ReplicaLifecycleConfig] = None,
+        lifecycle_clock=None,
     ):
         devices = list(devices if devices is not None else jax.devices())
         if replicas < 1 or tensor < 1:
@@ -119,6 +127,15 @@ class ReplicatedEngine:
         self.telemetry = telemetry if telemetry is not None \
             else RequestTelemetry()
         self.engines: List[InferenceEngine] = []
+        # Rebuild materials (lifecycle reinstates, rolling reloads): each
+        # replica's device group / mesh / final engine config, plus the
+        # model/lora configs, are enough to construct a replacement
+        # engine from a host weight tree.
+        self._model_cfg = model_cfg
+        self._lora_cfg = lora_cfg
+        self._groups: List[list] = []
+        self._meshes: List[Optional[object]] = []
+        self._rep_cfgs: List[EngineConfig] = []
         for r in range(replicas):
             group = devices[r * tensor:(r + 1) * tensor]
             mesh = (build_mesh(ParallelConfig(tensor=tensor), devices=group)
@@ -138,6 +155,9 @@ class ReplicatedEngine:
                 rep_cfg = dataclasses.replace(
                     engine_cfg, prefix_disk_dir=os.path.join(
                         engine_cfg.prefix_disk_dir, f"replica{r}"))
+            self._groups.append(group)
+            self._meshes.append(mesh)
+            self._rep_cfgs.append(rep_cfg)
             self.engines.append(
                 InferenceEngine(model_cfg, rep_params, rep_cfg, lora_cfg,
                                 mesh=mesh, telemetry=self.telemetry))
@@ -172,17 +192,52 @@ class ReplicatedEngine:
         # (returning True = rehomed elsewhere) before erroring — the
         # controller routes it to the other pool (degraded colocation).
         self.failover_fallback = None
+        # Replica lifecycle (serving.lifecycle): the state machine always
+        # exists (it backs /health counts and the dlti_replica_state
+        # gauge), but self-healing behavior — quarantine instead of
+        # permanent death, probation probes, reinstates — only runs when
+        # the config enables it; disabled, a faulted replica is marked
+        # dead forever (the legacy contract the kill-drill tests pin).
+        self.lifecycle_cfg = lifecycle_cfg if lifecycle_cfg is not None \
+            else ReplicaLifecycleConfig()
+        self._heal = self.lifecycle_cfg.enabled
+        self.lifecycle = ReplicaLifecycle(
+            self.lifecycle_cfg, replicas,
+            clock=lifecycle_clock if lifecycle_clock is not None
+            else time.monotonic)
+        # Planned drains (rolling reload of a sole replica): dispatch
+        # stops but the engine keeps stepping its in-flight work, unlike
+        # _dead whose engines never step again.
+        self._draining: set = set()
+        self._warmed = False
+        self._reload: Optional[dict] = None
+        # Known-good weights for quarantine rebuilds: a host snapshot of
+        # the boot tree (only paid when healing is on); a completed
+        # rolling reload replaces it with the new tree.
+        self._weights_host = None
+        self._canary_digest: Optional[str] = None
+        if self._heal:
+            self._weights_host = jax.device_get(params)
+            toks = self._run_canary(self.engines[0])
+            if toks is not None:
+                self._canary_digest = canary_digest(toks)
+            else:
+                self.logger.warning(
+                    "lifecycle: canary digest could not be pinned at "
+                    "construction; probes will gate on generation "
+                    "success only")
 
     # ------------------------------------------------------------------
     def _load(self, eng: InferenceEngine) -> int:
         return len(eng.waiting) + eng.num_active
 
     def live_engines(self) -> List[InferenceEngine]:
-        return [e for i, e in enumerate(self.engines) if i not in self._dead]
+        return [e for i, e in enumerate(self.engines)
+                if i not in self._dead and i not in self._draining]
 
     @property
     def num_live(self) -> int:
-        return len(self.engines) - len(self._dead)
+        return len(self.engines) - len(self._dead | self._draining)
 
     def _sticky_target(self, key: str,
                        live: List[InferenceEngine]) -> InferenceEngine:
@@ -267,6 +322,15 @@ class ReplicatedEngine:
                         # guard (not this hook) must catch it before any
                         # garbage token streams.
                         self._poison_params_nan(eng, i)
+                    elif self._fault_inject[2] == "preempt":
+                        # Planned preemption notice: drain via live KV
+                        # migration (no fault dump — nothing is broken),
+                        # then quarantine; the probe reinstates shortly.
+                        self.logger.warning(
+                            "chaos: preemption notice for replica %d at "
+                            "step %d", i, self._step_counts[i])
+                        finished.extend(self.drain_replica(i))
+                        continue
                     else:
                         raise ReplicaFault(
                             f"gateway.fault_inject_step: injected fault on "
@@ -274,6 +338,7 @@ class ReplicatedEngine:
                 finished.extend(eng.step())
             except Exception as e:  # noqa: BLE001 — isolate per replica
                 finished.extend(self._fail_replica(i, e))
+        self._lifecycle_tick()
         return finished
 
     def _poison_params_nan(self, eng: InferenceEngine, idx: int) -> None:
@@ -309,6 +374,15 @@ class ReplicatedEngine:
         survivors) finish as ``"error"`` and are returned so callers see
         them retire."""
         self._dead.add(idx)
+        self._draining.discard(idx)
+        # Lifecycle: with healing on this is a quarantine — the probe
+        # loop rebuilds the engine from known-good weights and canaries
+        # it back to live (unless the flap breaker evicts); with healing
+        # off it is the legacy permanent death.
+        if self._heal:
+            self.lifecycle.on_fault(idx)
+        else:
+            self.lifecycle.mark_dead(idx)
         self.failover["replica_faults"] += 1
         eng = self.engines[idx]
         from dlti_tpu.telemetry import get_recorder
@@ -373,6 +447,301 @@ class ReplicatedEngine:
             req.replica = self.engines.index(target)
         return errored
 
+    # -- Replica lifecycle: drain/migrate, rebuild, canary, reload ------
+    def _rehome(self, req: Request, eng: InferenceEngine,
+                survivors: List[InferenceEngine], kind: str,
+                ) -> List[Request]:
+        """Failover-style resubmit of one request onto a survivor
+        (recompute-on-readmit); errors it out past the retry cap or with
+        no survivors (after offering the disagg rescue hook). Returns
+        the request iff it errored."""
+        from dlti_tpu.telemetry.ledger import note_requeue
+
+        if not survivors or req.num_retries >= self.max_retries:
+            if (not survivors and req.num_retries < self.max_retries
+                    and self.failover_fallback is not None):
+                note_requeue(req, kind)
+                if self.failover_fallback(req):
+                    req.num_retries += 1
+                    self.failover["retries"] += 1
+                    return []
+            req.finish_reason = "error"
+            req.finish_time = time.monotonic()
+            self.failover["failover_errors"] += 1
+            self.telemetry.on_finished(req)
+            eng.finished.append(req)
+            return [req]
+        req.num_retries += 1
+        self.failover["retries"] += 1
+        note_requeue(req, kind)
+        target = min(survivors, key=self._load)
+        target.resubmit(req)
+        req.replica = self.engines.index(target)
+        return []
+
+    def drain_replica(self, idx: int, *, kind: str = "preempt",
+                      quarantine: bool = True) -> List[Request]:
+        """Planned drain of one replica: move its in-flight decodes to
+        survivors over the paged-KV handoff path (``export_handoff`` /
+        ``adopt_handoff``) — generated-so-far tokens and the slot's rng
+        stream survive byte-exactly, no re-prefill — falling back to a
+        failover-style resubmit when handoff fails; queued and
+        mid-prefill requests (nothing decodable to migrate) resubmit
+        directly. With ``quarantine`` the replica then enters the
+        lifecycle (healing on: quarantined → probe → live; healing off:
+        dead); the rolling-reload driver passes ``quarantine=False`` and
+        swaps weights itself. Returns the requests that errored out."""
+        eng = self.engines[idx]
+        self.lifecycle.begin_drain(idx)
+        self._dead.add(idx)
+        self._draining.discard(idx)
+        survivors = self.live_engines()
+        from dlti_tpu.telemetry.ledger import note_requeue
+
+        migrated = fallbacks = 0
+        errored: List[Request] = []
+        for slot in list(eng.slots):
+            req = slot.request
+            if req is None or req.done:
+                continue
+            # The wall time from here to re-admission on the survivor
+            # books as a requeue stall of this kind (the survivor's
+            # adopt/admit closes the mark), not as inflated decode.
+            note_requeue(req, kind)
+            snap = None
+            if survivors and not slot.prefilling:
+                snap = eng.export_handoff(slot)
+            if snap is not None:
+                adopted = False
+                for target in sorted(survivors, key=self._load):
+                    if target.adopt_handoff(snap):
+                        req.num_migrations += 1
+                        req.replica = self.engines.index(target)
+                        migrated += 1
+                        adopted = True
+                        break
+                if adopted:
+                    continue
+                fallbacks += 1
+            elif survivors and not slot.prefilling:
+                fallbacks += 1
+            # export_handoff leaves the slot intact on failure; release
+            # it (the drained engine stays healthy — blocks go back to
+            # its pool) and fail the request over.
+            if slot.request is not None:
+                eng._release(slot)
+            errored.extend(self._rehome(req, eng, survivors, kind))
+        stranded = list(eng.waiting)
+        eng.waiting.clear()
+        for req in stranded:
+            errored.extend(self._rehome(req, eng, survivors, kind))
+        if migrated:
+            self.lifecycle.note_migration(migrated)
+        if fallbacks:
+            self.lifecycle.note_migration_fallback(fallbacks)
+        self.logger.warning(
+            "replica %d drained (%s): %d decode(s) migrated via KV "
+            "handoff, %d re-prefill fallback(s), %d queued rehomed, %d "
+            "errored", idx, kind, migrated, fallbacks, len(stranded),
+            len(errored))
+        if quarantine:
+            if self._heal:
+                self.lifecycle.on_fault(idx)
+            else:
+                self.lifecycle.mark_dead(idx)
+        return errored
+
+    def _rebuild_replica(self, idx: int, host_params=None) -> None:
+        """Fresh engine for one replica from a host weight tree, on the
+        replica's own device group. The fleet's SHARED telemetry is
+        threaded through — a rebuilt replica keeps booking into the same
+        histograms, and requests that later fail over again keep their
+        ``stall_s`` phase attribution in ``request_breakdown()``."""
+        host = host_params if host_params is not None else self._weights_host
+        if host is None:
+            raise RuntimeError(
+                "no weights snapshot to rebuild from (lifecycle healing "
+                "was disabled at construction)")
+        old = self.engines[idx]
+        mesh = self._meshes[idx]
+        rep_params = (host if mesh is not None
+                      else jax.device_put(host, self._groups[idx][0]))
+        eng = InferenceEngine(self._model_cfg, rep_params,
+                              self._rep_cfgs[idx], self._lora_cfg,
+                              mesh=mesh, telemetry=self.telemetry)
+        eng.prefill_only = old.prefill_only
+        self.engines[idx] = eng
+        if self._warmed and not eng.prefill_only:
+            eng.warmup_decode_ladder()
+
+    def _run_canary(self, eng: InferenceEngine) -> Optional[List[int]]:
+        """Short greedy canary generation on one engine (only ever an
+        engine carrying no live traffic: a rebuilt quarantined replica,
+        or replica 0 at construction before any dispatch). Returns the
+        emitted token ids, or None when generation fails — a NaN-poisoned
+        replica trips the engine's numeric guard here, never in front of
+        a client."""
+        cfg = self.lifecycle_cfg
+        vocab = max(2, self._model_cfg.vocab_size)
+        prompt = [(i % min(97, vocab - 1)) + 1
+                  for i in range(max(1, cfg.canary_prompt_tokens))]
+        sp = SamplingParams(temperature=0.0,
+                            max_tokens=max(1, cfg.canary_max_tokens))
+        prev = eng.prefill_only
+        eng.prefill_only = False
+        try:
+            req = eng.submit(prompt, sp,
+                             f"canary-{next(self._req_counter)}")
+            for _ in range(1000):
+                if req.done:
+                    break
+                eng.step()
+            if not req.done or req.finish_reason == "error":
+                return None
+            return list(req.output_token_ids)
+        except Exception as e:  # noqa: BLE001 — a failed canary is a verdict
+            self.logger.warning("canary generation failed: %s", e)
+            return None
+        finally:
+            eng.prefill_only = prev
+
+    def _probe_replica(self, idx: int) -> None:
+        """Probation elapsed: rebuild the quarantined replica from
+        known-good weights and gate reinstatement on the canary matching
+        the pinned digest."""
+        self.lifecycle.begin_probe(idx)
+        toks = None
+        try:
+            self._rebuild_replica(idx)
+            toks = self._run_canary(self.engines[idx])
+        except Exception as e:  # noqa: BLE001 — a failed rebuild re-quarantines
+            self.logger.error("replica %d rebuild/canary raised: %s", idx, e)
+        ok = toks is not None and (
+            self._canary_digest is None
+            or canary_digest(toks) == self._canary_digest)
+        if self.lifecycle.on_probe_result(idx, ok) == "live":
+            self._dead.discard(idx)
+
+    def request_reload(self, weights_provider) -> bool:
+        """Enqueue a rolling weight reload (thread-safe: one GIL-atomic
+        attribute write; the roll itself runs on the stepper thread).
+        ``weights_provider()`` is called once there and must return a
+        host param tree with the boot tree's structure — the server's
+        /v1/reload handler wraps a verified checkpoint-store load.
+        Returns False if a roll is already in progress."""
+        if self._reload is not None:
+            return False
+        self._reload = {"provider": weights_provider, "host": None,
+                        "queue": None, "digest": None}
+        return True
+
+    def _reload_tick(self) -> None:
+        """One rolling-reload action per step: drain-via-migration one
+        replica, swap in the new weights, canary, reinstate — clients on
+        other replicas never notice. The first upgraded replica pins the
+        new canary digest with a determinism double-run; a canary failure
+        aborts the roll (the failed replica re-quarantines and heals back
+        onto the PREVIOUS weights — the fleet stays consistent)."""
+        st = self._reload
+        if st["host"] is None:
+            try:
+                st["host"] = st["provider"]()
+            except Exception as e:  # noqa: BLE001 — bad checkpoint aborts roll
+                self.logger.error(
+                    "rolling reload aborted: weights provider failed: %s", e)
+                self._reload = None
+                return
+            st["queue"] = [i for i in range(len(self.engines))
+                           if self.lifecycle.state(i) != "evicted"]
+            self.logger.info("rolling reload: %d replica(s) queued",
+                             len(st["queue"]))
+        if not st["queue"]:
+            self._weights_host = st["host"]
+            if st["digest"] is not None:
+                self._canary_digest = st["digest"]
+            self._reload = None
+            self.logger.info("rolling reload complete")
+            return
+        idx = st["queue"][0]
+        eng = self.engines[idx]
+        others = [e for i, e in enumerate(self.engines)
+                  if i != idx and i not in self._dead
+                  and i not in self._draining]
+        if others:
+            self.drain_replica(idx, quarantine=False)
+        else:
+            # Sole live replica: no migration target. Lame-duck it (stop
+            # dispatch, keep stepping) and wait for in-flight work to
+            # finish before swapping; the gateway queues/sheds meanwhile.
+            if idx not in self._draining and idx not in self._dead:
+                self.lifecycle.begin_drain(idx)
+                self._draining.add(idx)
+            if eng.has_work:
+                return
+            self._draining.discard(idx)
+            self._dead.add(idx)
+        toks = None
+        try:
+            self._rebuild_replica(idx, host_params=st["host"])
+            toks = self._run_canary(self.engines[idx])
+        except Exception as e:  # noqa: BLE001 — failed swap handled below
+            self.logger.error("replica %d reload rebuild failed: %s", idx, e)
+        ok = toks is not None
+        if ok and st["digest"] is None:
+            # First replica on the new weights: nothing to compare
+            # against, so gate on determinism (two identical greedy
+            # runs) and pin the digest the rest of the roll checks.
+            ok = self._run_canary(self.engines[idx]) == toks
+            if ok:
+                st["digest"] = canary_digest(toks)
+        elif ok:
+            ok = canary_digest(toks) == st["digest"]
+        st["queue"].pop(0)
+        if self.lifecycle.on_probe_result(idx, ok) == "live":
+            self._dead.discard(idx)
+        if not ok:
+            self.logger.error(
+                "rolling reload aborted: replica %d failed canary on new "
+                "weights; fleet stays on previous weights", idx)
+            self._reload = None
+
+    def _lifecycle_tick(self) -> None:
+        """End-of-step lifecycle work, at most one heavy action per tick
+        (bounded step latency): advance a rolling reload, else probe one
+        quarantined replica whose probation elapsed. Runs on the stepper
+        thread — the only thread allowed to touch slots/engines."""
+        if self._reload is not None:
+            self._reload_tick()
+            return
+        if not self._heal:
+            return
+        due = self.lifecycle.due_probes()
+        if due:
+            self._probe_replica(due[0])
+
+    @property
+    def lifecycle_pending(self) -> bool:
+        """True when the stepper must keep ticking without client work —
+        a reload is rolling or a quarantined replica awaits its probe.
+        The server's AsyncEngine polls instead of parking on its event
+        when this is set."""
+        if self._reload is not None:
+            return True
+        if not self._heal:
+            return False
+        return any(s in ("quarantined", "probing")
+                   for s in self.lifecycle.states().values())
+
+    def lifecycle_counts(self) -> dict:
+        """/health summary: ``quarantined`` replicas are healing (probe
+        pending/running) and expected back; ``dead`` ones (flap-evicted,
+        or faulted with healing off) are gone for good."""
+        c = self.lifecycle.counts()
+        return {"live": c["live"],
+                "quarantined": c["quarantined"] + c["probing"],
+                "draining": c["draining"],
+                "dead": c["evicted"]}
+
     def generate(self, prompts: Sequence[Sequence[int]],
                  params: Optional[SamplingParams] = None,
                  ) -> List[GenerationResult]:
@@ -390,6 +759,7 @@ class ReplicatedEngine:
 
     # -- InferenceEngine-compat surface (AsyncEngine / gateway) ---------
     def warmup_decode_ladder(self) -> None:
+        self._warmed = True  # rebuilt replicas re-warm before reinstating
         for e in self.engines:
             e.warmup_decode_ladder()
 

@@ -161,6 +161,16 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     for metric in (_dio.free_bytes_gauge, _dio.write_errors_total,
                    _dio.degraded_gauge):
         registry.register(metric)
+    # Replica lifecycle (serving.lifecycle): quarantine / reinstate /
+    # flap / migration counters plus the per-replica state gauge —
+    # module-level so every fleet in the process (both disagg pools)
+    # shares one exposition.
+    from dlti_tpu.serving import lifecycle as _lc
+
+    for metric in (_lc.quarantines_total, _lc.reinstates_total,
+                   _lc.flaps_total, _lc.migrations_total,
+                   _lc.migration_fallbacks_total, _lc.replica_state_gauge):
+        registry.register(metric)
     # Disaggregated serving (serving.disagg): per-pool gauges + KV-handoff
     # counters ride in via the controller's pool_scalars source, plus the
     # module-level handoff-latency histogram.
@@ -273,6 +283,15 @@ class AsyncEngine:
         while True:
             with self._work:
                 while not self._stop and not self.engine.has_work:
+                    if getattr(self.engine, "lifecycle_pending", False):
+                        # A quarantined replica awaits its probe or a
+                        # rolling reload is in flight: poll instead of
+                        # parking, so the fleet's lifecycle tick runs
+                        # even on an idle server (a no-work step() is
+                        # just the tick). Engines without a lifecycle
+                        # keep the legacy untimed park.
+                        self._work.wait(timeout=0.05)
+                        break
                     self._work.wait()
                 if self._stop:
                     for q in self._queues.values():
@@ -554,12 +573,20 @@ class _Handler(BaseHTTPRequestHandler):
             # Load-balancer truth: a parked stepper or a draining gateway
             # must read unhealthy so traffic routes elsewhere — 200 here
             # while submits 503 kept corpses in rotation.
+            body = {}
+            counts = getattr(self.async_engine.engine,
+                             "lifecycle_counts", None)
+            if counts is not None:
+                # Fleet lifecycle detail: "quarantined" replicas are
+                # healing (probe pending) and expected back; "dead" ones
+                # are gone for good — a balancer weighs them differently.
+                body.update(counts())
             if self.async_engine.dead:
-                self._json(503, {"status": "dead"})
+                self._json(503, {"status": "dead", **body})
             elif self.gateway is not None and self.gateway.draining:
-                self._json(503, {"status": "draining"})
+                self._json(503, {"status": "draining", **body})
             else:
-                self._json(200, {"status": "ok"})
+                self._json(200, {"status": "ok", **body})
         elif self.path == "/stats":
             # Raw engine counters/gauges + request-latency histogram
             # summaries (count/sum/mean/p50/p90/p99), all served from the
@@ -608,10 +635,54 @@ class _Handler(BaseHTTPRequestHandler):
             self._completions(chat=True)
         elif self.path == "/v1/adapters":
             self._register_adapter()
+        elif self.path == "/v1/reload":
+            self._reload_weights()
         elif self.path == "/debug/profile":
             self._profile()
         else:
             self._error(404, f"no route {self.path}")
+
+    def _reload_weights(self) -> None:
+        """Zero-downtime rolling weight upgrade:
+        ``POST /v1/reload {"directory": d}`` where ``d`` is a params
+        export written by ``checkpoint.store.save_pytree`` (the same
+        artifact class adapters hot-load from). The fleet hot-swaps the
+        weights one replica at a time — drain via live KV migration,
+        rebuild, canary, reinstate — so clients never see an error. The
+        artifact is digest-verified on the stepper thread before any
+        replica swaps; 409 while a roll is already in progress; 400 when
+        the engine has no lifecycle support (single-engine servers
+        restart instead)."""
+        body = self._read_body()
+        if body is None:
+            return
+        directory = str(body.get("directory", "") or "")
+        if not directory:
+            return self._error(400, "directory is required")
+        if not os.path.isfile(os.path.join(directory, "MANIFEST.json")):
+            return self._error(
+                400, f"{directory!r} is not a checkpoint-store params "
+                     f"export (no MANIFEST.json)")
+        request_reload = getattr(self.async_engine.engine,
+                                 "request_reload", None)
+        if request_reload is None:
+            return self._error(
+                400, "engine has no replica lifecycle (rolling reload "
+                     "needs a replicated fleet; restart single-engine "
+                     "servers instead)")
+        from dlti_tpu.checkpoint.store import load_pytree
+
+        def _provider():
+            # Runs once on the stepper thread: digest-verified load — a
+            # corrupt artifact aborts the roll before any replica swaps.
+            return load_pytree(directory, verify=True)
+
+        if not request_reload(_provider):
+            return self._error(409, "a rolling reload is already in "
+                                    "progress")
+        with self.async_engine._work:
+            self.async_engine._work.notify()  # wake an idle stepper
+        self._json(200, {"status": "reloading", "directory": directory})
 
     def _register_adapter(self) -> None:
         """Hot-register a trained adapter checkpoint with zero restart:
@@ -866,7 +937,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif ev[0] == "done":
                 finish = ev[1]
             elif ev[0] == "reject":  # gateway shed (e.g. queued deadline)
-                return None, (ev[1], ev[2])
+                # Pass any retry-after hint through to _error (the shed
+                # tuple grew a 4th element; older 3-element producers
+                # still work).
+                return None, tuple(ev[1:])
             else:
                 return None, (500, ev[1])
         text = self.tokenizer.decode(token_ids)
@@ -921,6 +995,13 @@ class _Handler(BaseHTTPRequestHandler):
             # tier restore, prefill, failover, decode): lets a client —
             # and the loadgen — decompose the latency it observed.
             out["phases"] = phases
+        eng_req = getattr(req, "_req", None) or req
+        # Lifecycle visibility: how many times this request was live-
+        # migrated (paged-KV handoff mid-decode) or failover-resubmitted
+        # — rolling-restart drills assert "zero errors AND the migrations
+        # actually happened".
+        out["migrations"] = getattr(eng_req, "num_migrations", 0)
+        out["retries"] = getattr(eng_req, "num_retries", 0)
         self._json(200, out)
 
     def _multi_response(self, subs: list, rid: str, chat: bool,
@@ -1079,6 +1160,9 @@ class _Handler(BaseHTTPRequestHandler):
                 phases = self._phases_of(req)
                 if phases is not None:
                     final["phases"] = phases
+                eng_req = getattr(req, "_req", None) or req
+                final["migrations"] = getattr(eng_req, "num_migrations", 0)
+                final["retries"] = getattr(eng_req, "num_retries", 0)
                 chunk(json.dumps(final))
             chunk("[DONE]")
             self.wfile.write(b"0\r\n\r\n")

@@ -54,6 +54,12 @@ alert, not one per check interval):
   (skipping/dropping writes). Fires while the run is still healthy
   enough to act — the checkpoint that *couldn't* be written is exactly
   the one a later incident will want.
+* ``replica_flap``          — the serving replica-lifecycle flap breaker
+  permanently evicted a replica (``serving.lifecycle``'s
+  ``dlti_replica_lifecycle_flaps_total`` grew since the last check): a
+  replica cycled live → quarantined → live too many times inside the
+  flap window, so self-healing gave up on it — capacity is now down a
+  replica until an operator intervenes.
 
 The module-level :func:`log_event` appends structured non-alert events
 (e.g. the flight recorder's ``dump_failed``) to the same JSONL event log
@@ -90,7 +96,8 @@ alerts_total = Counter(
 RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
          "nonfinite_step", "loss_spike", "sdc_mismatch",
-         "goodput_collapse", "hbm_pressure", "disk_pressure")
+         "goodput_collapse", "hbm_pressure", "disk_pressure",
+         "replica_flap")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -440,6 +447,28 @@ class AnomalyWatchdog:
                     fired.append(a)
             else:
                 self._active.discard(rule)
+
+        # replica_flap: lifecycle flap breaker evicted a replica --------
+        if getattr(self.cfg, "replica_flap_limit", 0) > 0:
+            flap_keys = [k for k in latest
+                         if k.startswith("dlti_replica_lifecycle_"
+                                         "flaps_total")]
+            if flap_keys:
+                flaps = sum(float(latest[k]) for k in flap_keys)
+                prev = self._watermarks.get("replica_flap")
+                self._watermarks["replica_flap"] = flaps
+                if prev is not None and flaps > prev:
+                    a = self._fire(
+                        "replica_flap", "replica_flap",
+                        f"replica_flap: flap breaker permanently "
+                        f"evicted a replica ({flaps - prev:.0f} new "
+                        f"eviction(s), {flaps:.0f} total) — the fleet "
+                        f"is down capacity until an operator acts",
+                        grew=flaps - prev, total=flaps)
+                    if a:
+                        fired.append(a)
+                elif prev is not None:
+                    self._active.discard("replica_flap")
         return fired
 
     def _throughput_series(self):

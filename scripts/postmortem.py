@@ -58,6 +58,12 @@ _KEY_METRICS = (
     "pool_prefill_active", "pool_decode_active",
     "kv_handoff_total", "kv_handoff_staged",
     "kv_handoff_fallbacks_total", "kv_handoff_sheds_total",
+    # Replica lifecycle / self-healing (dlti_tpu.serving.lifecycle).
+    "dlti_replica_lifecycle_quarantines_total",
+    "dlti_replica_lifecycle_reinstates_total",
+    "dlti_replica_lifecycle_flaps_total",
+    "dlti_replica_lifecycle_migrations_total",
+    "dlti_replica_lifecycle_migration_fallbacks_total",
 )
 
 # Sentinel dump reasons / context keys surfaced as their own report

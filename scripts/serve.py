@@ -132,8 +132,31 @@ def parse_args():
                         "(default) raises in place of a device fault; "
                         "'nan-logits' poisons the replica's params so "
                         "the engine's numeric output guard trips the "
-                        "same quarantine (also env "
+                        "same quarantine; 'preempt' simulates a planned "
+                        "preemption notice (drain via live KV migration, "
+                        "then quarantine) (also env "
                         "DLTI_GATEWAY_FAULT_INJECT)")
+    p.add_argument("--self-heal", action="store_true",
+                   help="replica lifecycle healing: a faulted replica is "
+                        "quarantined, rebuilt from known-good weights, "
+                        "and reinstated after a passing canary probe "
+                        "(default: a faulted replica stays dead)")
+    p.add_argument("--probation", type=float, default=2.0,
+                   help="seconds before a quarantined replica's first "
+                        "reinstate probe (doubles per failed probe, "
+                        "capped at 60s)")
+    p.add_argument("--flap-window", type=float, default=300.0,
+                   help="flap-breaker window: more than --flap-max-cycles "
+                        "quarantines inside this many seconds evicts the "
+                        "replica permanently")
+    p.add_argument("--flap-max-cycles", type=int, default=3,
+                   help="quarantine/reinstate cycles tolerated inside "
+                        "--flap-window before permanent eviction")
+    p.add_argument("--reload-checkpoint", default="",
+                   help="kick off a rolling weight reload at startup "
+                        "from this checkpoint-store params export (same "
+                        "artifact POST /v1/reload takes); mostly useful "
+                        "with --self-heal drills")
     p.add_argument("--no-numeric-guard", action="store_true",
                    help="disable the nonfinite decode-output guard "
                         "(NumericFault -> replica quarantine; leaving it "
@@ -353,6 +376,13 @@ def main() -> None:
                 raise SystemExit(f"--adapter expects NAME=DIR, got {spec!r}")
             register_adapter(name.strip(), adir.strip())
             print(f"registered adapter {name.strip()!r} from {adir.strip()}")
+    from dlti_tpu.config import ReplicaLifecycleConfig
+
+    lc_cfg = ReplicaLifecycleConfig(
+        enabled=args.self_heal,
+        probation_initial_s=args.probation,
+        flap_window_s=args.flap_window,
+        flap_max_cycles=args.flap_max_cycles)
     if args.disagg:
         from dlti_tpu.serving import DisaggController
 
@@ -366,8 +396,13 @@ def main() -> None:
             fault_inject_step=args.fault_inject_step,
             handoff_queue_depth=args.handoff_queue_depth,
             handoff_deadline_s=args.handoff_deadline_s,
-            affinity_spill_threshold=args.affinity_spill_threshold)
-    elif args.replicas > 1:
+            affinity_spill_threshold=args.affinity_spill_threshold,
+            lifecycle_cfg=lc_cfg)
+    elif args.replicas > 1 or args.self_heal or args.reload_checkpoint:
+        # A sole replica still gets the lifecycle layer when healing or
+        # a rolling reload is requested — quarantine/probe/reinstate and
+        # weight swaps work fleet-of-one (migration has no survivors, so
+        # drains wait for in-flight work instead).
         from dlti_tpu.serving import ReplicatedEngine
 
         engine = ReplicatedEngine(
@@ -375,7 +410,8 @@ def main() -> None:
             replicas=args.replicas, tensor=args.tensor,
             max_retries=args.max_retries,
             fault_inject_step=args.fault_inject_step,
-            affinity_spill_threshold=args.affinity_spill_threshold)
+            affinity_spill_threshold=args.affinity_spill_threshold,
+            lifecycle_cfg=lc_cfg)
     else:
         mesh = None
         if args.tensor > 1:
@@ -440,6 +476,19 @@ def main() -> None:
         print(f"disaggregated pools: {args.prefill_replicas} prefill + "
               f"{args.decode_replicas} decode replicas "
               f"(handoff queue depth {args.handoff_queue_depth})")
+    if args.reload_checkpoint:
+        # Startup-kicked rolling upgrade (the drill path: boot on old
+        # weights, roll to new ones under load): same verified-load
+        # contract as POST /v1/reload.
+        reload_fn = getattr(engine, "request_reload", None)
+        if reload_fn is None:
+            raise SystemExit("--reload-checkpoint needs a replicated "
+                             "fleet (--replicas > 1 or --disagg)")
+        from dlti_tpu.checkpoint.store import load_pytree
+
+        rdir = args.reload_checkpoint
+        reload_fn(lambda: load_pytree(rdir, verify=True))
+        print(f"rolling weight reload queued from {rdir}")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
     print(f"live dashboard: http://{args.host}:{args.port}/dashboard  "

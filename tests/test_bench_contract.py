@@ -198,6 +198,7 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
         "goodput_collapse", "hbm_pressure", "disk_pressure",
+        "replica_flap",
     )
 
 
@@ -223,6 +224,40 @@ def test_disk_metric_names_are_schema_stable():
     assert durable_io.PATH_CLASSES == (
         "checkpoint", "adapter", "prefix_tier", "flight",
         "steplog", "elastic", "sentinel", "watchdog",
+    )
+
+
+def test_lifecycle_metric_names_are_schema_stable():
+    """Replica-lifecycle telemetry names are a scrape contract like the
+    watchdog/disk sets: the self-healing counters (quarantine, reinstate,
+    flap eviction, live migration + fallback) and the per-replica state
+    gauge, all registered by the server registry and watched by the
+    replica_flap rule."""
+    from dlti_tpu.serving import lifecycle
+
+    assert lifecycle.LIFECYCLE_METRIC_NAMES == (
+        "dlti_replica_lifecycle_quarantines_total",
+        "dlti_replica_lifecycle_reinstates_total",
+        "dlti_replica_lifecycle_flaps_total",
+        "dlti_replica_lifecycle_migrations_total",
+        "dlti_replica_lifecycle_migration_fallbacks_total",
+        "dlti_replica_state",
+    )
+    assert lifecycle.quarantines_total.name == \
+        lifecycle.LIFECYCLE_METRIC_NAMES[0]
+    assert lifecycle.reinstates_total.name == \
+        lifecycle.LIFECYCLE_METRIC_NAMES[1]
+    assert lifecycle.flaps_total.name == lifecycle.LIFECYCLE_METRIC_NAMES[2]
+    assert lifecycle.migrations_total.name == \
+        lifecycle.LIFECYCLE_METRIC_NAMES[3]
+    assert lifecycle.migration_fallbacks_total.name == \
+        lifecycle.LIFECYCLE_METRIC_NAMES[4]
+    assert lifecycle.replica_state_gauge.name == \
+        lifecycle.LIFECYCLE_METRIC_NAMES[5]
+    # The state set is the replica_state gauge's value contract
+    # (dashboards map code -> label via STATES order).
+    assert lifecycle.STATES == (
+        "live", "quarantined", "probing", "draining", "evicted",
     )
 
 
@@ -488,6 +523,9 @@ def test_load_report_schema_includes_gateway_fields():
         # Multi-LoRA era: per-adapter latency breakdown + the
         # server-scraped adapter-pool hit rate.
         "per_adapter", "adapter_pool_hit_rate",
+        # Replica-lifecycle era: tail-of-the-tail percentiles plus the
+        # per-run migration/retry disturbance totals.
+        "ttft_p999_s", "tpot_p999_ms", "migrations_total", "retries_total",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

@@ -297,6 +297,7 @@ def _fake_replicated(n: int, max_seqs: int = 4, spill_threshold: int = 4):
     rep = ReplicatedEngine.__new__(ReplicatedEngine)
     rep.engines = [_mk(i) for i in range(n)]
     rep._dead = set()
+    rep._draining = set()
     rep._rr = 0
     rep._req_counter = itertools.count()
     rep.affinity_spill_threshold = spill_threshold

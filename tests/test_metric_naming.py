@@ -40,6 +40,7 @@ def test_pinned_name_tuples_follow_convention():
         KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
     )
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
+    from dlti_tpu.serving.lifecycle import LIFECYCLE_METRIC_NAMES
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
     from dlti_tpu.telemetry import (
         FLIGHT_METRIC_NAMES, LEDGER_METRIC_NAMES,
@@ -69,18 +70,23 @@ def test_pinned_name_tuples_follow_convention():
                        (HEARTBEAT_METRIC_NAMES, "heartbeat"),
                        (POOL_METRIC_NAMES, "disagg-pools"),
                        (KV_HANDOFF_METRIC_NAMES, "kv-handoff"),
-                       (ADAPTER_METRIC_NAMES, "adapters")):
+                       (ADAPTER_METRIC_NAMES, "adapters"),
+                       (LIFECYCLE_METRIC_NAMES, "lifecycle")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
-    from dlti_tpu.serving import adapters
+    from dlti_tpu.serving import adapters, lifecycle
     from dlti_tpu.telemetry import flightrecorder, ledger, memledger, watchdog
     from dlti_tpu.training import elastic, sentinel
     from dlti_tpu.utils import durable_io
 
-    objs = (adapters.loads_total, adapters.evictions_total,
+    objs = (lifecycle.quarantines_total, lifecycle.reinstates_total,
+            lifecycle.flaps_total, lifecycle.migrations_total,
+            lifecycle.migration_fallbacks_total,
+            lifecycle.replica_state_gauge,
+            adapters.loads_total, adapters.evictions_total,
             adapters.pool_hits_total, adapters.pool_misses_total,
             adapters.pool_slots_gauge, adapters.pool_bytes_gauge,
             store.save_seconds, store.restore_seconds, store.corrupt_skipped,
@@ -172,6 +178,8 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_disk_free_bytes",
                      "dlti_disk_write_errors_total",
                      "dlti_disk_degraded",
+                     "dlti_replica_lifecycle_quarantines_total",
+                     "dlti_replica_state",
                      "dlti_heartbeat_lag_steps"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")
