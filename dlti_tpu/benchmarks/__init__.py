@@ -7,3 +7,11 @@ package is that leg, dependency-free.
 """
 
 from dlti_tpu.benchmarks.loadgen import LoadGenConfig, LoadReport, run_load_test  # noqa: F401
+from dlti_tpu.benchmarks.traces import (  # noqa: F401
+    TRACE_FORMAT,
+    TraceEvent,
+    read_trace,
+    synthesize,
+    trace_summary,
+    write_trace,
+)

@@ -25,6 +25,8 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from dlti_tpu.benchmarks.traces import TraceEvent, read_trace, write_trace
+
 
 @dataclass
 class LoadGenConfig:
@@ -94,6 +96,16 @@ class LoadGenConfig:
     # "long_prompt"/"short_prompt" per_class entries. 0.0 = off.
     long_prompt_frac: float = 0.0
     long_prompt_tokens: int = 512
+    # Trace replay / capture (benchmarks.traces, dlti-trace/1 JSONL).
+    # `trace` replays a recorded workload: each event fires at its
+    # recorded arrival offset, and tenant / priority / session / adapter
+    # / prompt+output lengths / deadline all come from the event
+    # (num_requests, qps, tenants, priority_mix are ignored; concurrency
+    # still caps in-flight). `record_trace` writes every request THIS
+    # run submitted (any drive mode, replay included) back out as a
+    # trace file, so live runs become replayable fixtures.
+    trace: str = ""
+    record_trace: str = ""
 
 
 @dataclass
@@ -227,6 +239,14 @@ class LoadReport:
     # headroom" alongside the latency numbers. Empty when the scrape is
     # off, the route is absent, or the server's ledger is disabled.
     memory: dict = field(default_factory=dict)
+    # SLO cross-check (telemetry.slo via GET /debug/slo): the server's
+    # per-(objective, class) compliance / error-budget / breaching state
+    # at run end, the client's own compliance recomputed from this run's
+    # records at the server-reported (bucket-snapped) thresholds, and
+    # per-pair agreement deltas — the server's SLO engine audited from
+    # outside. Empty when the scrape is off, the route is absent, or the
+    # server runs without --slo.
+    slo: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -235,11 +255,18 @@ class LoadReport:
 
 
 def _percentile(xs: List[float], p: float) -> float:
+    """Linear interpolation between closest ranks (numpy's default
+    method). Nearest-rank rounding is too coarse for tail percentiles at
+    bench-sized sample counts — at n=100, p99 and p99.9 both snapped to
+    the max sample, hiding a tail regression until it moved p90."""
     if not xs:
         return 0.0
     xs = sorted(xs)
-    i = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
-    return xs[i]
+    k = max(0.0, p / 100.0 * (len(xs) - 1))
+    f = int(k)
+    if f >= len(xs) - 1:
+        return xs[-1]
+    return xs[f] + (k - f) * (xs[f + 1] - xs[f])
 
 
 async def _iter_body(reader, headers: dict, timeout_s: float):
@@ -485,6 +512,97 @@ def _watchdog_report(debug_vars: Optional[dict]) -> Tuple[dict, float]:
     return alerts, peak
 
 
+def _slo_client_compliance(server_slo: dict,
+                           recs: List[RequestRecord]) -> dict:
+    """Recompute the server's SLO compliance from this run's records,
+    classifying at the server-reported (bucket-snapped) thresholds so
+    both sides cut on the identical boundary. Only objectives the client
+    can observe from outside are recomputed — ttft (first SSE token),
+    tpot (per-token decode latency), availability (ok vs refused) —
+    queue_delay and goodput are server-internal."""
+    out: dict = {}
+    for key, st in (server_slo.get("objectives") or {}).items():
+        name = st.get("objective")
+        cls = st.get("class", "all")
+        pool = [r for r in recs
+                if cls in ("all", "") or r.priority == cls]
+        thr = st.get("threshold_s")
+        good = total = 0
+        if name == "ttft" and thr:
+            vals = [r.ttft for r in pool if r.ok and r.ttft is not None]
+            total = len(vals)
+            good = sum(1 for v in vals if v <= thr)
+        elif name == "tpot" and thr:
+            vals = [(r.latency - r.ttft) / (r.output_tokens - 1)
+                    for r in pool
+                    if r.ok and r.ttft is not None and r.output_tokens > 1]
+            total = len(vals)
+            good = sum(1 for v in vals if v <= thr)
+        elif name == "availability":
+            done = [r for r in pool if r.status or r.error]
+            total = len(done)
+            good = sum(1 for r in done if r.ok)
+        else:
+            continue
+        if total:
+            out[key] = {"good": good, "total": total,
+                        "compliance": round(good / total, 6)}
+    return out
+
+
+def _slo_report(server_slo: dict, recs: List[RequestRecord]) -> dict:
+    """LoadReport.slo: the server's /debug/slo state, the client-side
+    recomputation, and per-(objective, class) agreement deltas. The
+    server is windowed — the cross-check is honest only when its SLO
+    window covers the whole run (the drill harness arranges that)."""
+    server: dict = {}
+    for key, st in (server_slo.get("objectives") or {}).items():
+        server[key] = {
+            "compliance": st.get("compliance"),
+            "error_budget_remaining": st.get("error_budget_remaining"),
+            "breaching": bool(st.get("breaching", False)),
+            "threshold_s": st.get("threshold_s"),
+            "target": st.get("target"),
+        }
+    client = _slo_client_compliance(server_slo, recs)
+    agreement: dict = {}
+    for key, c in client.items():
+        s = server.get(key, {})
+        if s.get("compliance") is None:
+            continue
+        delta = abs(float(s["compliance"]) - c["compliance"])
+        agreement[key] = {"server": s["compliance"],
+                         "client": c["compliance"],
+                         "delta": round(delta, 6)}
+    return {
+        "server": server,
+        "client": client,
+        "agreement": agreement,
+        "max_delta": round(max((a["delta"] for a in agreement.values()),
+                               default=0.0), 6),
+        "breaching": list(server_slo.get("breaching") or []),
+    }
+
+
+def _trace_prompt(ev: TraceEvent, idx: int) -> str:
+    """Synthetic prompt sized to ev.prompt_tokens tokens (exact under the
+    byte tokenizer: one char per token). A per-event prefix keeps replayed
+    prompts distinct so a prefix cache can't collapse the prefill work
+    the trace's length distribution encodes."""
+    filler = f"[trace {idx}] replayed workload payload segment text. "
+    n = max(1, int(ev.prompt_tokens))
+    return (filler * (n // len(filler) + 1))[:n]
+
+
+def _body_prompt_tokens(body: dict) -> int:
+    """~token count of a request body's prompt (exact under the byte
+    tokenizer: one char per token)."""
+    if "prompt" in body:
+        return len(body["prompt"])
+    return sum(len(m.get("content", ""))
+               for m in body.get("messages") or [])
+
+
 def parse_priority_mix(spec: str) -> List[Tuple[str, float]]:
     """"interactive:0.8,batch:0.2" -> [("interactive", 0.8), ...]."""
     out: List[Tuple[str, float]] = []
@@ -694,7 +812,21 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     rng = random.Random(cfg.seed)
     mix = parse_priority_mix(cfg.priority_mix)
     records: List[RequestRecord] = []
+    captured: List[TraceEvent] = []
     sem = asyncio.Semaphore(cfg.concurrency)
+
+    def _capture(rec: RequestRecord, body: dict) -> None:
+        # --record-trace: every submitted request (any drive mode)
+        # becomes a trace event at its actual send offset.
+        if not cfg.record_trace:
+            return
+        captured.append(TraceEvent(
+            offset_s=max(0.0, rec.start - t0),
+            prompt_tokens=_body_prompt_tokens(body),
+            max_tokens=int(body.get("max_tokens", cfg.max_tokens)),
+            tenant=rec.tenant, priority=rec.priority,
+            session=rec.session, adapter=rec.adapter,
+            deadline_s=float(body.get("deadline_s", 0.0) or 0.0)))
 
     async def one(idx: int) -> None:
         async with sem:
@@ -704,6 +836,39 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
                                 priority=priority, long=long,
                                 adapter=adapter)
             records.append(rec)
+            _capture(rec, body)
+            await _http_post_sse(cfg.host, cfg.port, path, body, rec,
+                                 cfg.timeout_s, extra_headers=headers)
+
+    async def replay_one(idx: int, ev: TraceEvent) -> None:
+        async with sem:
+            prompt = _trace_prompt(ev, idx)
+            if cfg.chat:
+                path = "/v1/chat/completions"
+                body: dict = {"messages": [{"role": "user",
+                                            "content": prompt}]}
+            else:
+                path = "/v1/completions"
+                body = {"prompt": prompt}
+            body.update({"max_tokens": ev.max_tokens or cfg.max_tokens,
+                         "temperature": cfg.temperature,
+                         "stream": cfg.stream})
+            headers: dict = {}
+            if ev.tenant:
+                headers["X-Tenant"] = ev.tenant
+            if ev.priority:
+                body["priority"] = ev.priority
+            if ev.session:
+                headers["X-Session"] = ev.session
+            if ev.adapter:
+                headers["X-Adapter"] = ev.adapter
+            if ev.deadline_s and ev.deadline_s > 0:
+                body["deadline_s"] = ev.deadline_s
+            rec = RequestRecord(start=time.monotonic(), tenant=ev.tenant,
+                                priority=ev.priority, session=ev.session,
+                                adapter=ev.adapter)
+            records.append(rec)
+            _capture(rec, body)
             await _http_post_sse(cfg.host, cfg.port, path, body, rec,
                                  cfg.timeout_s, extra_headers=headers)
 
@@ -752,8 +917,23 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
                 await _http_post_sse(cfg.host, cfg.port, path, body, rec,
                                      cfg.timeout_s, extra_headers=headers)
 
+    replay_events: List[TraceEvent] = []
+    if cfg.trace:
+        _, replay_events = read_trace(cfg.trace)
+
     t0 = time.monotonic()
-    if cfg.sessions > 0:
+    if cfg.trace:
+        # Trace replay: fire each event at its recorded arrival offset —
+        # sleep up to the offset, never ahead; a late event fires
+        # immediately so offsets stay faithful under scheduler jitter.
+        tasks = []
+        for i, ev in enumerate(replay_events):
+            delay = t0 + ev.offset_s - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.create_task(replay_one(i, ev)))
+        await asyncio.gather(*tasks, return_exceptions=True)
+    elif cfg.sessions > 0:
         # Recurring-session mode: sessions run concurrently, each one's
         # turns strictly in order (turn t+1 needs t's prefix resident).
         await asyncio.gather(
@@ -771,6 +951,12 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         await asyncio.gather(*(one(i) for i in range(cfg.num_requests)),
                              return_exceptions=True)
     duration = time.monotonic() - t0
+    if cfg.record_trace and captured:
+        write_trace(cfg.record_trace, captured,
+                    meta={"source": "loadgen", "seed": cfg.seed,
+                          "mode": ("replay" if cfg.trace else
+                                   "sessions" if cfg.sessions > 0 else
+                                   "open" if cfg.qps else "closed")})
     server_hists = (await _scrape_histograms(cfg.host, cfg.port)
                     if cfg.scrape_server_metrics else {})
     watchdog_alerts, peak_queue = _watchdog_report(
@@ -780,6 +966,12 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     # best-effort like every other scrape; {} when absent/disabled.
     mem_snap = (await _http_get_json(cfg.host, cfg.port, "/debug/memory")
                 if cfg.scrape_debug_vars else None)
+    # End-of-run SLO state (telemetry.slo via /debug/slo) cross-checked
+    # against this run's own records — best-effort like every scrape.
+    slo_snap = (await _http_get_json(cfg.host, cfg.port, "/debug/slo")
+                if cfg.scrape_debug_vars else None)
+    slo = (_slo_report(slo_snap, records)
+           if slo_snap and slo_snap.get("objectives") else {})
     memory = {}
     if mem_snap:
         memory = {
@@ -866,6 +1058,7 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         cold_phases=_phase_means(cold),
         warm_phases=_phase_means(warm),
         memory=memory,
+        slo=slo,
     )
 
 

@@ -449,6 +449,42 @@ class WatchdogConfig:
     # breaker evicts a replica (the flaps counter grew across the
     # watchdog window). 0 = rule off.
     replica_flap_limit: int = 1
+    # slo_burn: fires when an SLO tracker reports a (objective, class)
+    # burning through its error budget on a fast+slow window pair
+    # (telemetry.slo). 0 = rule off even when a tracker is wired.
+    slo_burn_limit: int = 1
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Declarative SLO engine (``dlti_tpu.telemetry.slo``): objectives
+    over existing SLIs, rolling error budgets per (objective, tenant
+    class), multi-window multi-burn-rate alerts. Off by default; a zero
+    threshold/target disables that objective family individually."""
+
+    enabled: bool = False
+    # Rolling error-budget window. An hour by default; drills shrink it
+    # to seconds.
+    window_s: float = 3600.0
+    # Burn-rate alert tiers, "factor:long_s:short_s" comma-separated: a
+    # tier fires when the burn rate exceeds factor over BOTH windows.
+    burn_tiers: str = "14:60:5,6:300:30"
+    # Latency objectives over the request-lifecycle histograms; the
+    # threshold snaps to the nearest histogram bucket bound at/below it
+    # (server and client then classify with the identical cut). 0 = off.
+    ttft_threshold_s: float = 0.0
+    ttft_target: float = 0.99
+    tpot_threshold_s: float = 0.0
+    tpot_target: float = 0.99
+    queue_threshold_s: float = 0.0
+    queue_target: float = 0.99
+    # Admission availability per tenant class (admitted − shed over
+    # admitted + rejected, from the gateway's counters). 0 = off.
+    availability_target: float = 0.0
+    # Training goodput: wall time counts as good while the ledger's
+    # goodput fraction sits at/above the floor. 0 floor = off.
+    goodput_floor: float = 0.0
+    goodput_target: float = 0.99
 
 
 @dataclass(frozen=True)
@@ -513,6 +549,9 @@ class TelemetryConfig:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     flight_recorder: FlightRecorderConfig = field(
         default_factory=FlightRecorderConfig)
+    # Declarative SLOs + error-budget burn alerting (telemetry.slo; see
+    # the block's own docstring). Off by default.
+    slo: SLOConfig = field(default_factory=SLOConfig)
 
 
 @dataclass(frozen=True)
@@ -710,7 +749,7 @@ class Config:
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
-                    "disagg", "lifecycle",
+                    "disagg", "lifecycle", "slo",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -724,6 +763,7 @@ class Config:
                         "sentinel": SentinelConfig,
                         "disagg": DisaggConfig,
                         "lifecycle": ReplicaLifecycleConfig,
+                        "slo": SLOConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -275,10 +275,11 @@ class AdmissionGateway:
                 help="requests admitted through the gateway")
             self._m_rejected = registry.counter(
                 "dlti_gateway_rejected_total",
-                help="admissions refused (reason label)")
+                help="admissions refused (reason + priority labels)")
             self._m_shed = registry.counter(
                 "dlti_gateway_shed_total",
-                help="queued requests shed at deadline expiry before prefill")
+                help="queued requests shed at deadline expiry before "
+                     "prefill (priority label)")
             registry.add_scalar_source(
                 self._scalars,
                 gauge_keys=("gateway_queue_depth", "gateway_queued_tokens",
@@ -344,14 +345,14 @@ class AdmissionGateway:
             from dlti_tpu.serving.adapters import get_catalog
 
             if adapter not in get_catalog():
-                self._reject("unknown_adapter", tenant=tenant)
+                self._reject("unknown_adapter", priority, tenant=tenant)
                 raise AdmissionError(
                     404, f"unknown adapter {adapter!r}: register it via "
                          f"POST /v1/adapters first")
         n_tokens = len(prompt_token_ids)
         with self._cond:
             if self._draining or self._stop:
-                self._reject("draining")
+                self._reject("draining", priority)
                 # Retry-After derived from the expected drain time: the
                 # remaining SIGTERM grace window (a retrying client that
                 # honors it lands on the replacement process, not on the
@@ -372,13 +373,13 @@ class AdmissionGateway:
                     bucket = self._buckets[tenant] = _TokenBucket(burst)
                 wait = bucket.take(self.cfg.rate_limit_rps, burst)
                 if wait is not None:
-                    self._reject("rate_limited", tenant=tenant)
+                    self._reject("rate_limited", priority, tenant=tenant)
                     raise AdmissionError(
                         429, f"tenant {tenant!r} over rate limit "
                              f"({self.cfg.rate_limit_rps:g} req/s)",
                         retry_after=wait)
             if self._queued_requests + 1 > self.cfg.max_queued_requests:
-                self._reject("queue_full")
+                self._reject("queue_full", priority)
                 raise AdmissionError(
                     429, f"admission queue full "
                          f"({self.cfg.max_queued_requests} requests)",
@@ -386,7 +387,7 @@ class AdmissionGateway:
             if (self.cfg.max_queued_tokens > 0
                     and self._queued_tokens + n_tokens
                     > self.cfg.max_queued_tokens):
-                self._reject("queue_full")
+                self._reject("queue_full", priority)
                 raise AdmissionError(
                     429, f"admission queue full "
                          f"({self.cfg.max_queued_tokens} queued prompt "
@@ -417,11 +418,14 @@ class AdmissionGateway:
             self._cond.notify()
         return handle, entry.q
 
-    def _reject(self, reason: str, **labels) -> None:
+    def _reject(self, reason: str, priority: str = "interactive",
+                **labels) -> None:
+        # Priority rides every refusal so per-class availability SLIs
+        # (telemetry.slo) can difference admitted/rejected/shed per class.
         if self._m_rejected is not None:
-            self._m_rejected.labels(reason=reason).inc()
+            self._m_rejected.labels(reason=reason, priority=priority).inc()
         self._tracer.instant("gateway/rejected", cat="gateway",
-                             reason=reason, **labels)
+                             reason=reason, priority=priority, **labels)
 
     # -- scheduling -----------------------------------------------------
     def _engine_room(self) -> int:
@@ -467,7 +471,7 @@ class AdmissionGateway:
                     self._queued_requests -= 1
                     self._queued_tokens -= len(e.handle.prompt_token_ids)
                     if self._m_shed is not None:
-                        self._m_shed.inc()
+                        self._m_shed.labels(priority=prio).inc()
                     self._tracer.instant(
                         "gateway/shed", cat="gateway",
                         id=e.handle.request_id, tenant=tenant, queued_s=round(
@@ -515,7 +519,7 @@ class AdmissionGateway:
                     entry.handle.prompt_token_ids, entry.handle.params,
                     entry.handle.request_id, q=entry.q, **kw)
             except Exception as e:  # engine parked / all replicas dead
-                self._reject("engine_unavailable")
+                self._reject("engine_unavailable", entry.priority)
                 entry.q.put(("reject", 503, f"{type(e).__name__}: {e}"))
                 continue
             req.tenant = entry.tenant

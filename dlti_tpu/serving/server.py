@@ -153,6 +153,15 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     for metric in (_ml.hbm_bytes_gauge, _ml.hbm_peak_gauge,
                    _ml.hbm_headroom_gauge, _ml.hbm_untracked_gauge):
         registry.register(metric)
+    # SLO engine (telemetry.slo): compliance / error-budget / burn-rate
+    # gauges — module-level like the watchdog/flight counters, populated
+    # only when a tracker is wired (empty children cost nothing on
+    # exposition).
+    from dlti_tpu.telemetry import slo as _slo
+
+    for metric in (_slo.compliance_gauge, _slo.budget_remaining_gauge,
+                   _slo.burn_rate_gauge):
+        registry.register(metric)
     # Durable-writer health (utils.durable_io): free bytes on the
     # persistence filesystem plus path_class-labeled write-error /
     # degraded series — the watchdog's disk_pressure inputs on /metrics.
@@ -404,6 +413,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry: "MetricsRegistry"
     gateway = None  # AdmissionGateway when ServerConfig.gateway enables it
     sampler = None  # TimeSeriesSampler behind /debug/vars + /dashboard
+    slo = None  # SLOTracker behind /debug/slo (telemetry.slo)
     profile_lock = None  # threading.Lock guarding POST /debug/profile
 
     def log_message(self, fmt, *args):  # route through our logger
@@ -549,6 +559,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "phases": list(_REQUEST_PHASES),
                 "worst": worst,
             })
+        if path == "/debug/slo":
+            # Declared objectives vs reality (telemetry.slo): per-
+            # (objective, class) compliance, error budget remaining,
+            # burn rates per alert window, breaching tiers — the JSON
+            # twin of the flight dump's slo.json, and what loadgen's
+            # LoadReport.slo cross-checks itself against.
+            if self.slo is None:
+                return self._error(404, "slo engine disabled (start the "
+                                        "server with --slo)")
+            return self._json(200, self.slo.to_dict())
         if path == "/debug/memory":
             # Full "where the memory lives" map (telemetry.memledger):
             # per-owner bytes, untracked/residual buckets summing to
@@ -1207,6 +1227,27 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
         # "where the memory lives" series, and the watchdog's
         # hbm_pressure rule reads hbm_headroom_frac from here.
         sampler.add_source(engine.memledger.scalars)
+    # SLO engine (telemetry.slo): objectives over the SLIs the registry
+    # already carries — lifecycle histograms (bucket-snapped latency
+    # cuts), gateway admission counters (per-class availability). The
+    # tracker is pull-driven: the sampler's interval pull doubles as its
+    # evaluation cadence (ring series for /dashboard), the watchdog pulls
+    # active_burns, /debug/slo pulls to_dict.
+    slo_tracker = None
+    if tcfg is not None and getattr(tcfg, "slo", None) is not None:
+        from dlti_tpu.telemetry.slo import build_tracker as _build_slo
+
+        classes = ()
+        if gateway is not None:
+            from dlti_tpu.serving.gateway import PRIORITIES
+
+            classes = PRIORITIES
+        slo_tracker = _build_slo(
+            tcfg.slo, telemetry=engine.telemetry,
+            stats_fn=registry.stats_dict if gateway is not None else None,
+            classes=classes)
+        if slo_tracker is not None:
+            sampler.add_source(slo_tracker.scalars)
     sampler.start()
     recorder = None
     if tcfg is not None and tcfg.flight_recorder.enabled:
@@ -1227,11 +1268,13 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
         if getattr(engine, "memledger", None) is not None \
                 and engine.memledger.enabled:
             recorder.add_memory_source(engine.memledger.to_dict)
+        if slo_tracker is not None:
+            recorder.add_slo_source(slo_tracker.to_dict)
         recorder.note(role="serving", model=cfg.model_name)
         install_recorder(recorder)
     watchdog = None
     if wcfg is not None and wcfg.enabled:
-        watchdog = AnomalyWatchdog(wcfg, sampler)
+        watchdog = AnomalyWatchdog(wcfg, sampler, slo=slo_tracker)
         if recorder is not None:
             recorder.add_context_source(
                 lambda: {"watchdog_alerts": list(watchdog.alerts)})
@@ -1240,7 +1283,7 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     handler = type("BoundHandler", (_Handler,), {
         "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
         "registry": registry, "gateway": gateway, "sampler": sampler,
-        "profile_lock": threading.Lock(),
+        "slo": slo_tracker, "profile_lock": threading.Lock(),
     })
     httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
     httpd.daemon_threads = True
@@ -1248,6 +1291,7 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     httpd.sampler = sampler
     httpd.watchdog = watchdog
     httpd.flight_recorder = recorder
+    httpd.slo = slo_tracker
     return httpd, async_engine
 
 

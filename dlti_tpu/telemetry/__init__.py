@@ -34,6 +34,11 @@ reference-parity CSV in ``utils/metrics.py``, ``StepTimer`` in
   ``jax.live_arrays()``/``memory_stats()``), feeding ``GET
   /debug/memory``, ``memory.json`` OOM forensics, the watchdog's
   hbm_pressure rule, and the engine's headroom-aware admission.
+* :mod:`~dlti_tpu.telemetry.slo` — declarative SLO engine: objectives
+  over the SLIs above (latency histograms, gateway admission counters,
+  goodput fraction), rolling error budgets per (objective, tenant
+  class), multi-window multi-burn-rate alerting feeding the watchdog's
+  slo_burn rule, ``GET /debug/slo``, and ``slo.json`` flight forensics.
 """
 
 from dlti_tpu.telemetry.registry import (  # noqa: F401
@@ -80,6 +85,17 @@ from dlti_tpu.telemetry.ledger import (  # noqa: F401
     REQUEST_PHASES,
     request_breakdown,
     stitch_ledgers,
+)
+from dlti_tpu.telemetry.slo import (  # noqa: F401
+    Objective,
+    SLO_METRIC_NAMES,
+    SLOTracker,
+    availability_objective,
+    build_tracker as build_slo_tracker,
+    goodput_objective,
+    histogram_objective,
+    parse_burn_tiers,
+    standard_objectives,
 )
 from dlti_tpu.telemetry.memledger import (  # noqa: F401
     MEMLEDGER_METRIC_NAMES,

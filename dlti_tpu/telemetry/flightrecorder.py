@@ -22,6 +22,11 @@ atomically materializes a ``flight-<step|ts>/`` directory:
   (per owner per device + untracked/residual reconciliation): the "where
   the memory went" evidence an OOM postmortem needs. Always present —
   ``{}`` when no memory source is wired.
+* ``slo.json``        — the SLO tracker's full state at death (per-
+  objective compliance, error budget remaining, burn rates per window,
+  breaching tiers — ``telemetry.slo``): whether the process died *while
+  already failing its users* reframes any incident. Always present —
+  ``{}`` when no SLO source is wired.
 * ``MANIFEST.json``   — per-file sizes + SHA-256, written last; the dump
   stages into a ``.tmp-`` dir and renames, so a dump directory that
   exists is complete (same discipline as the checkpoint store).
@@ -64,7 +69,7 @@ _PREFIX = "flight-"
 _TMP = ".tmp-"
 MANIFEST = "MANIFEST.json"
 DUMP_FILES = ("context.json", "spans.json", "metrics.json",
-              "timeseries.json", "config.json", "memory.json")
+              "timeseries.json", "config.json", "memory.json", "slo.json")
 
 
 def config_fingerprint(config) -> Optional[str]:
@@ -102,6 +107,7 @@ class FlightRecorder:
         self._metrics_sources: List[Callable[[], dict]] = []
         self._context_sources: List[Callable[[], dict]] = []
         self._memory_sources: List[Callable[[], dict]] = []
+        self._slo_sources: List[Callable[[], dict]] = []
         self._last_dump_t = 0.0
         self.last_dump_path: Optional[str] = None
         self.dump_failures = 0
@@ -134,6 +140,12 @@ class FlightRecorder:
         """A callable snapshotted into ``memory.json`` at dump time
         (``MemoryLedger.to_dict`` — the full ownership map at death)."""
         self._memory_sources.append(fn)
+
+    def add_slo_source(self, fn: Callable[[], dict]) -> None:
+        """A callable snapshotted into ``slo.json`` at dump time
+        (``SLOTracker.to_dict`` — compliance/budget/burn state at
+        death)."""
+        self._slo_sources.append(fn)
 
     # -- the dump -------------------------------------------------------
     def dump(self, reason: str, exc: Optional[BaseException] = None,
@@ -222,6 +234,14 @@ class FlightRecorder:
             except Exception:
                 memory.setdefault("memory_source_errors", 0)
                 memory["memory_source_errors"] += 1
+        # Same contract for slo.json: always written, {} when unwired.
+        slo: dict = {}
+        for fn in self._slo_sources:
+            try:
+                slo.update(fn())
+            except Exception:
+                slo.setdefault("slo_source_errors", 0)
+                slo["slo_source_errors"] += 1
 
         label = (f"step{int(context['step']):08d}" if "step" in context
                  else time.strftime("%Y%m%dT%H%M%S"))
@@ -273,6 +293,7 @@ class FlightRecorder:
                             if hasattr(self.config, "to_dict")
                             else (self.config or {})),
             "memory.json": memory,
+            "slo.json": slo,
         }
         manifest: dict = {"format": 1, "reason": reason,
                           "created": time.time(), "files": {}}

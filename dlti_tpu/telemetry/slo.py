@@ -1,0 +1,469 @@
+"""Declarative SLO engine: objectives, error budgets, burn-rate alerts.
+
+The ledgers (PRs 1/5/9/10) measure everything — request-lifecycle
+histograms, gateway admission counters, the goodput ledger — but nothing
+declares what *good* looks like. This module closes that gap with the
+standard SRE machinery, computed entirely from SLIs the fleet already
+collects (**no new hot-path instrumentation**: every objective is a
+closure over an existing histogram snapshot, counter family, or ledger
+fraction):
+
+* :class:`Objective` — one declared target over an existing SLI. Two
+  kinds: ``events`` (a cumulative good/total counter pair, e.g. "TTFT
+  ≤ 250 ms for 99% of requests", "99.9% of admissions succeed per
+  class") and ``time`` (an instantaneous value integrated against a
+  floor, e.g. "goodput fraction ≥ 0.85 for 99% of wall time").
+* :class:`SLOTracker` — rolling windowed compliance and error-budget
+  accounting per (objective, tenant-class), plus **multi-window
+  multi-burn-rate** alerting: each tier is ``factor:long_s:short_s``
+  (SRE-style fast+slow pairs — the long window proves the burn is
+  sustained, the short window proves it is *still* happening, so a
+  recovered incident stops paging immediately). Timescales are plain
+  seconds, so a drill can shrink an "hour" to 30 s.
+* Burn rate is ``(bad/total over window) / (1 - target)`` — 1.0 means
+  the budget spends exactly at the sustainable rate, ``f`` means the
+  window's budget is gone in ``1/f`` of the budget window.
+
+Latency objectives snap their threshold to the largest histogram bucket
+bound ≤ the requested threshold: the server classifies with cumulative
+bucket counts and an external client (loadgen's ``LoadReport.slo``) can
+classify raw samples with the *identical* cut, so the two views agree
+exactly modulo requests in flight at scrape time.
+
+Surfaces: ``dlti_slo_*`` gauges (pinned in ``SLO_METRIC_NAMES``),
+``GET /debug/slo``, the ``slo_burn`` watchdog rule (via
+:meth:`SLOTracker.active_burns`), a ``/dashboard`` ring via
+:meth:`SLOTracker.scalars`, and ``slo.json`` in every flight dump (via
+:meth:`SLOTracker.to_dict`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlti_tpu.telemetry.registry import Gauge
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+SLO_METRIC_NAMES = (
+    "dlti_slo_compliance",
+    "dlti_slo_error_budget_remaining",
+    "dlti_slo_burn_rate",
+)
+
+compliance_gauge = Gauge(
+    SLO_METRIC_NAMES[0],
+    help="windowed SLI compliance per (objective, class), 0..1")
+budget_remaining_gauge = Gauge(
+    SLO_METRIC_NAMES[1],
+    help="fraction of the error budget left in the rolling window, 0..1")
+burn_rate_gauge = Gauge(
+    SLO_METRIC_NAMES[2],
+    help="error-budget burn rate per (objective, class, window); "
+         "1.0 = spending exactly at the sustainable rate")
+
+# Default multi-window multi-burn-rate tiers (factor:long_s:short_s).
+# The classic SRE page/ticket split scaled to a 1 h budget window:
+# 14x over 1 min (confirmed by 5 s) pages, 6x over 5 min tickets.
+DEFAULT_BURN_TIERS = "14:60:5,6:300:30"
+
+
+def parse_burn_tiers(spec: str) -> Tuple[Tuple[float, float, float], ...]:
+    """``"14:60:5,6:300:30"`` → ``((14, 60, 5), (6, 300, 30))``.
+
+    Each tier is ``factor:long_window_s:short_window_s``; a tier fires
+    only when the burn rate exceeds ``factor`` over BOTH windows. Raises
+    ``ValueError`` on malformed tiers (factor ≤ 0, short ≥ long)."""
+    tiers: List[Tuple[float, float, float]] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) != 3:
+            raise ValueError(f"burn tier {part!r}: want factor:long_s:short_s")
+        factor, long_s, short_s = (float(b) for b in bits)
+        if factor <= 0 or long_s <= 0 or short_s <= 0:
+            raise ValueError(f"burn tier {part!r}: all fields must be > 0")
+        if short_s >= long_s:
+            raise ValueError(
+                f"burn tier {part!r}: short window must be < long window")
+        tiers.append((factor, long_s, short_s))
+    return tuple(tiers)
+
+
+def _fmt_window(w: float) -> str:
+    return f"{format(w, 'g')}s"
+
+
+@dataclass
+class Objective:
+    """One declared target over an existing SLI.
+
+    ``events`` kind: ``counts_fn`` returns the cumulative ``(good,
+    total)`` event counts since process start; the tracker differences
+    them over its windows. ``time`` kind: ``value_fn`` returns the
+    instantaneous SLI and the tracker integrates wall time, counting a
+    second as *good* while the value sits at/above ``floor``.
+    """
+
+    name: str
+    target: float                                    # e.g. 0.99
+    cls: str = "all"                                 # tenant class label
+    kind: str = "events"                             # "events" | "time"
+    counts_fn: Optional[Callable[[], Tuple[float, float]]] = None
+    value_fn: Optional[Callable[[], float]] = None
+    floor: float = 0.0
+    threshold_s: Optional[float] = None              # effective (snapped) cut
+    description: str = ""
+
+    def __post_init__(self):
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(
+                f"objective {self.name!r}: target must be in (0, 1), "
+                f"got {self.target} (a target of exactly 1.0 has a zero "
+                f"error budget — burn rate is undefined)")
+        if self.kind == "events" and self.counts_fn is None:
+            raise ValueError(f"objective {self.name!r}: events kind "
+                             f"needs counts_fn")
+        if self.kind == "time" and self.value_fn is None:
+            raise ValueError(f"objective {self.name!r}: time kind "
+                             f"needs value_fn")
+        if self.kind not in ("events", "time"):
+            raise ValueError(f"objective {self.name!r}: unknown kind "
+                             f"{self.kind!r}")
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}/{self.cls}"
+
+
+class _ObjectiveState:
+    """Per-objective cumulative sample ring + time-kind integrator."""
+
+    __slots__ = ("samples", "good_cum", "total_cum", "last_t", "last_value")
+
+    def __init__(self):
+        # (t, good_cum, total_cum); the first sample is the zero point —
+        # history that predates the tracker never counts against it.
+        self.samples: deque = deque()
+        self.good_cum = 0.0     # time-kind integrators
+        self.total_cum = 0.0
+        self.last_t: Optional[float] = None
+        self.last_value: Optional[float] = None
+
+
+class SLOTracker:
+    """Rolling error-budget accounting + burn-rate evaluation.
+
+    Pull-driven and thread-safe: the time-series sampler pulls
+    :meth:`scalars` every interval, the watchdog pulls
+    :meth:`active_burns` every check, HTTP handlers pull
+    :meth:`to_dict` — each pull re-evaluates against ``clock()``. No
+    thread of its own, nothing on any hot path.
+    """
+
+    def __init__(self, objectives: Sequence[Objective] = (), *,
+                 window_s: float = 3600.0,
+                 burn_tiers: str = DEFAULT_BURN_TIERS,
+                 clock: Callable[[], float] = time.monotonic):
+        self.objectives: List[Objective] = list(objectives)
+        self.window_s = max(1.0, float(window_s))
+        self.tiers = parse_burn_tiers(burn_tiers) \
+            if isinstance(burn_tiers, str) else tuple(burn_tiers)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._states: Dict[str, _ObjectiveState] = {}
+        self._last: Dict[str, dict] = {}
+        horizon = self.window_s
+        for _, long_s, _ in self.tiers:
+            horizon = max(horizon, long_s)
+        self._horizon = horizon * 1.25 + 10.0
+
+    def add_objective(self, obj: Objective) -> None:
+        with self._lock:
+            self.objectives.append(obj)
+
+    # -- evaluation -----------------------------------------------------
+    def _sample(self, obj: Objective, st: _ObjectiveState,
+                now: float) -> None:
+        if obj.kind == "events":
+            good, total = obj.counts_fn()
+            st.samples.append((now, float(good), float(total)))
+        else:
+            value = float(obj.value_fn())
+            if st.last_t is not None:
+                dt = max(0.0, now - st.last_t)
+                st.total_cum += dt
+                # Left Riemann: the interval just elapsed is judged by
+                # the value that held at its start.
+                if (st.last_value or 0.0) >= obj.floor:
+                    st.good_cum += dt
+            st.last_t, st.last_value = now, value
+            st.samples.append((now, st.good_cum, st.total_cum))
+        while len(st.samples) > 2 and st.samples[1][0] < now - self._horizon:
+            st.samples.popleft()
+
+    @staticmethod
+    def _windowed(st: _ObjectiveState, now: float,
+                  window: float) -> Tuple[float, float]:
+        """(good, total) deltas over the trailing window.
+
+        Baseline = the latest sample at/older than the window edge; with
+        no sample that old yet, the first sample is the zero point (a
+        young tracker reports over its own lifetime, never over history
+        it did not witness). Deltas clamp at 0 so a counter reset reads
+        as quiet, not negative."""
+        if not st.samples:
+            return 0.0, 0.0
+        edge = now - window
+        base = st.samples[0]
+        for s in st.samples:
+            if s[0] <= edge:
+                base = s
+            else:
+                break
+        last = st.samples[-1]
+        return (max(0.0, last[1] - base[1]), max(0.0, last[2] - base[2]))
+
+    def _burn(self, obj: Objective, st: _ObjectiveState, now: float,
+              window: float) -> float:
+        good, total = self._windowed(st, now, window)
+        if total <= 0:
+            return 0.0
+        bad_frac = (total - good) / total
+        return bad_frac / max(1e-9, 1.0 - obj.target)
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """Sample every objective, recompute windows, update gauges;
+        returns ``{objective_key: state}`` (also kept for re-reads)."""
+        with self._lock:
+            now = self.clock() if now is None else now
+            out: Dict[str, dict] = {}
+            windows = sorted({w for _, long_s, short_s in self.tiers
+                              for w in (long_s, short_s)})
+            for obj in self.objectives:
+                st = self._states.setdefault(obj.key, _ObjectiveState())
+                self._sample(obj, st, now)
+                good, total = self._windowed(st, now, self.window_s)
+                bad = max(0.0, total - good)
+                compliance = 1.0 if total <= 0 else good / total
+                allowed = (1.0 - obj.target) * total
+                if allowed <= 0:
+                    remaining = 1.0 if bad <= 0 else 0.0
+                else:
+                    remaining = max(0.0, 1.0 - bad / allowed)
+                burns = {_fmt_window(w): self._burn(obj, st, now, w)
+                         for w in windows}
+                burning = []
+                for factor, long_s, short_s in self.tiers:
+                    b_long = burns[_fmt_window(long_s)]
+                    b_short = burns[_fmt_window(short_s)]
+                    if b_long >= factor and b_short >= factor:
+                        burning.append({
+                            "factor": factor, "long_s": long_s,
+                            "short_s": short_s, "burn_long": round(b_long, 3),
+                            "burn_short": round(b_short, 3),
+                        })
+                state = {
+                    "objective": obj.name, "class": obj.cls,
+                    "kind": obj.kind, "target": obj.target,
+                    "threshold_s": obj.threshold_s,
+                    "description": obj.description,
+                    "window_s": self.window_s,
+                    "good": good, "bad": bad, "total": total,
+                    "compliance": compliance,
+                    "error_budget_remaining": remaining,
+                    "burn_rates": burns,
+                    "burning": burning,
+                    "breaching": bool(burning),
+                }
+                out[obj.key] = state
+                labels = {"objective": obj.name, "class": obj.cls}
+                compliance_gauge.labels(**labels).set(compliance)
+                budget_remaining_gauge.labels(**labels).set(remaining)
+                for wname, b in burns.items():
+                    burn_rate_gauge.labels(window=wname, **labels).set(b)
+            self._last = out
+            return out
+
+    # -- consumers ------------------------------------------------------
+    def active_burns(self, now: Optional[float] = None) -> List[dict]:
+        """Currently-breaching (objective, class, tier) triples — the
+        watchdog's ``slo_burn`` rule input. Re-evaluates first."""
+        state = self.evaluate(now)
+        out = []
+        for key, s in state.items():
+            for tier in s["burning"]:
+                out.append({
+                    "objective": s["objective"], "class": s["class"],
+                    "budget_remaining": s["error_budget_remaining"],
+                    "compliance": s["compliance"], **tier,
+                })
+        return out
+
+    def scalars(self, now: Optional[float] = None) -> dict:
+        """Flat numeric summary for the time-series ring / dashboard."""
+        state = self.evaluate(now)
+        if not state:
+            return {"slo_objectives": 0}
+        worst_burn = 0.0
+        for s in state.values():
+            for b in s["burn_rates"].values():
+                worst_burn = max(worst_burn, b)
+        return {
+            "slo_objectives": len(state),
+            "slo_breaching": sum(1 for s in state.values()
+                                 if s["breaching"]),
+            "slo_worst_burn_rate": round(worst_burn, 4),
+            "slo_min_budget_remaining": round(
+                min(s["error_budget_remaining"] for s in state.values()), 4),
+            "slo_compliance": {k: round(s["compliance"], 6)
+                               for k, s in state.items()},
+            "slo_budget_remaining": {
+                k: round(s["error_budget_remaining"], 4)
+                for k, s in state.items()},
+        }
+
+    def to_dict(self, now: Optional[float] = None) -> dict:
+        """The ``GET /debug/slo`` payload / flight-dump ``slo.json``."""
+        state = self.evaluate(now)
+        return {
+            "window_s": self.window_s,
+            "burn_tiers": [{"factor": f, "long_s": l, "short_s": s}
+                           for f, l, s in self.tiers],
+            "num_objectives": len(state),
+            "breaching": sorted(k for k, s in state.items()
+                                if s["breaching"]),
+            "objectives": state,
+        }
+
+
+# ----------------------------------------------------------------------
+# Objective builders over the SLIs the fleet already has.
+# ----------------------------------------------------------------------
+
+def snap_threshold(buckets: Sequence[float], threshold_s: float) -> float:
+    """Largest histogram bucket bound ≤ the requested threshold (the
+    smallest bound when the request undercuts them all): server-side
+    cumulative bucket counts and client-side raw-sample cuts then
+    classify with the identical boundary."""
+    eligible = [b for b in buckets if b <= threshold_s]
+    return eligible[-1] if eligible else buckets[0]
+
+
+def histogram_objective(name: str, histogram, threshold_s: float,
+                        target: float, cls: str = "all",
+                        description: str = "") -> Objective:
+    """Latency objective over a registry Histogram: good = observations
+    ≤ the (bucket-snapped) threshold, total = all observations."""
+    effective = snap_threshold(histogram.buckets, threshold_s)
+    cut = histogram.buckets.index(effective)
+
+    def counts() -> Tuple[float, float]:
+        bucket_counts, _, total = histogram.snapshot()
+        return float(sum(bucket_counts[:cut + 1])), float(total)
+
+    return Objective(
+        name=name, cls=cls, target=target, kind="events",
+        counts_fn=counts, threshold_s=effective,
+        description=description or
+        f"{histogram.name} <= {format(effective, 'g')}s "
+        f"for {target:.4g} of requests")
+
+
+def _sum_counter_family(stats: dict, name: str, cls: str) -> float:
+    """Sum every child of a labeled counter out of a ``stats_dict()``
+    snapshot (keys are ``name`` or ``name{k="v",...}``), optionally
+    restricted to one ``priority`` class."""
+    total = 0.0
+    for k, v in stats.items():
+        if not k.startswith(name):
+            continue
+        rest = k[len(name):]
+        if rest and not rest.startswith("{"):
+            continue            # a different, longer metric name
+        if cls != "all" and f'priority="{cls}"' not in rest:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        total += v
+    return total
+
+
+def availability_objective(stats_fn: Callable[[], dict], target: float,
+                           cls: str = "all") -> Objective:
+    """Admission availability per tenant class from the gateway's
+    counters: total = admitted + rejected, good = admitted − shed (an
+    admitted-then-shed request broke its promise)."""
+
+    def counts() -> Tuple[float, float]:
+        stats = stats_fn()
+        admitted = _sum_counter_family(
+            stats, "dlti_gateway_admitted_total", cls)
+        rejected = _sum_counter_family(
+            stats, "dlti_gateway_rejected_total", cls)
+        shed = _sum_counter_family(stats, "dlti_gateway_shed_total", cls)
+        return max(0.0, admitted - shed), admitted + rejected
+
+    return Objective(
+        name="availability", cls=cls, target=target, kind="events",
+        counts_fn=counts,
+        description=f"admissions neither rejected nor shed "
+                    f"for {target:.4g} of requests (class={cls})")
+
+
+def goodput_objective(value_fn: Callable[[], float], floor: float,
+                      target: float) -> Objective:
+    """Training goodput-fraction objective: wall time counts as good
+    while the ledger's instantaneous fraction sits at/above ``floor``."""
+    return Objective(
+        name="goodput", cls="all", target=target, kind="time",
+        value_fn=value_fn, floor=floor,
+        description=f"goodput_fraction >= {floor:.4g} "
+                    f"for {target:.4g} of wall time")
+
+
+def standard_objectives(cfg, *, telemetry=None,
+                        stats_fn: Optional[Callable[[], dict]] = None,
+                        goodput_fn: Optional[Callable[[], float]] = None,
+                        classes: Sequence[str] = ()) -> List[Objective]:
+    """The declarative config → objective list used by both entry points
+    (serving wires telemetry + stats_fn; training wires goodput_fn). A
+    zero threshold/target disables that objective family."""
+    out: List[Objective] = []
+    if telemetry is not None:
+        for attr, label, threshold, target in (
+                ("ttft", "ttft", cfg.ttft_threshold_s, cfg.ttft_target),
+                ("tpot", "tpot", cfg.tpot_threshold_s, cfg.tpot_target),
+                ("queue_time", "queue_delay",
+                 cfg.queue_threshold_s, cfg.queue_target)):
+            if threshold > 0 and target > 0:
+                out.append(histogram_objective(
+                    label, getattr(telemetry, attr), threshold, target))
+    if stats_fn is not None and cfg.availability_target > 0:
+        for cls in ("all",) + tuple(classes):
+            out.append(availability_objective(
+                stats_fn, cfg.availability_target, cls=cls))
+    if goodput_fn is not None and cfg.goodput_floor > 0 \
+            and cfg.goodput_target > 0:
+        out.append(goodput_objective(goodput_fn, cfg.goodput_floor,
+                                     cfg.goodput_target))
+    return out
+
+
+def build_tracker(cfg, **kwargs) -> Optional["SLOTracker"]:
+    """``SLOConfig`` → tracker (None when disabled or no objective
+    resolved — callers wire nothing rather than a dead engine)."""
+    if not getattr(cfg, "enabled", False):
+        return None
+    objectives = standard_objectives(cfg, **{
+        k: v for k, v in kwargs.items() if k != "clock"})
+    if not objectives:
+        return None
+    return SLOTracker(objectives, window_s=cfg.window_s,
+                      burn_tiers=cfg.burn_tiers or DEFAULT_BURN_TIERS,
+                      clock=kwargs.get("clock", time.monotonic))

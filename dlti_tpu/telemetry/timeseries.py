@@ -174,6 +174,10 @@ _DASH_PREFERRED = (
     # "where the memory lives" panel (telemetry.memledger scalars).
     "hbm_bytes_in_use", "hbm_headroom_bytes",
     "hbm_tracked_bytes", "hbm_untracked_bytes",
+    # SLO panel (telemetry.slo scalars): objectives breaching, the worst
+    # burn rate across every (objective, class, window), the tightest
+    # remaining error budget.
+    "slo_breaching", "slo_worst_burn_rate", "slo_min_budget_remaining",
 )
 
 _DASHBOARD_HTML = """<!doctype html>

@@ -60,6 +60,11 @@ alert, not one per check interval):
   replica cycled live → quarantined → live too many times inside the
   flap window, so self-healing gave up on it — capacity is now down a
   replica until an operator intervenes.
+* ``slo_burn``            — an SLO tracker (``telemetry.slo``, wired via
+  the ``slo=`` constructor arg) reports an (objective, class) burning
+  its error budget past a fast+slow window tier: the alert carries the
+  burn rates and the budget still remaining, so it lands *before*
+  exhaustion. Re-arms when that (objective, class) stops breaching.
 
 The module-level :func:`log_event` appends structured non-alert events
 (e.g. the flight recorder's ``dump_failed``) to the same JSONL event log
@@ -97,7 +102,7 @@ RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
          "nonfinite_step", "loss_spike", "sdc_mismatch",
          "goodput_collapse", "hbm_pressure", "disk_pressure",
-         "replica_flap")
+         "replica_flap", "slo_burn")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -171,7 +176,7 @@ class AnomalyWatchdog:
     """Rule engine over a sampler ring; see module docstring."""
 
     def __init__(self, cfg, sampler: TimeSeriesSampler, *,
-                 heartbeat=None, tracer=None,
+                 heartbeat=None, tracer=None, slo=None,
                  on_dump: Optional[Callable[[dict], Optional[str]]] = None,
                  clock: Callable[[], float] = time.monotonic):
         if cfg.action not in ACTIONS:
@@ -180,6 +185,9 @@ class AnomalyWatchdog:
         self.cfg = cfg
         self.sampler = sampler
         self.heartbeat = heartbeat
+        # SLO tracker (telemetry.slo.SLOTracker) for the slo_burn rule;
+        # None = rule dormant.
+        self.slo = slo
         self.logger = get_logger()
         self._tracer = tracer if tracer is not None else get_tracer()
         self._on_dump = on_dump
@@ -469,6 +477,40 @@ class AnomalyWatchdog:
                         fired.append(a)
                 elif prev is not None:
                     self._active.discard("replica_flap")
+
+        # slo_burn: an (objective, class) is burning its error budget --
+        if self.slo is not None \
+                and getattr(self.cfg, "slo_burn_limit", 1) > 0:
+            try:
+                burns = self.slo.active_burns(now)
+            except Exception:
+                burns = []
+            burning_keys = set()
+            for b in burns:
+                key = f"slo_burn:{b['objective']}:{b['class']}"
+                burning_keys.add(key)
+                a = self._fire(
+                    "slo_burn", key,
+                    f"SLO {b['objective']} (class {b['class']}) burning "
+                    f"{b['burn_long']:.1f}x over {b['long_s']:g}s / "
+                    f"{b['burn_short']:.1f}x over {b['short_s']:g}s "
+                    f"(tier {b['factor']:g}x) — "
+                    f"{b['budget_remaining'] * 100:.1f}% of the error "
+                    f"budget remains",
+                    objective=b["objective"], cls=b["class"],
+                    factor=b["factor"],
+                    burn_long=b["burn_long"], burn_short=b["burn_short"],
+                    budget_remaining=round(b["budget_remaining"], 4),
+                    compliance=round(b["compliance"], 6))
+                if a:
+                    fired.append(a)
+            # Re-arm every (objective, class) that stopped breaching.
+            with self._lock:
+                stale = [k for k in self._active
+                         if k.startswith("slo_burn:")
+                         and k not in burning_keys]
+                for k in stale:
+                    self._active.discard(k)
         return fired
 
     def _throughput_series(self):

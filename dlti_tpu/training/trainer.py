@@ -32,8 +32,8 @@ from dlti_tpu.parallel.mesh import build_mesh
 from dlti_tpu.parallel.sharding import make_sharded_train_step, shard_train_state
 from dlti_tpu.telemetry import (
     AnomalyWatchdog, FlightRecorder, GoodputLedger, Heartbeat,
-    StepLogWriter, TimeSeriesSampler, configure_tracer, get_recorder,
-    get_tracer, install_recorder, schedule_lr,
+    StepLogWriter, TimeSeriesSampler, build_slo_tracker, configure_tracer,
+    get_recorder, get_tracer, install_recorder, schedule_lr,
 )
 from dlti_tpu.telemetry.ledger import (
     goodput_fraction_gauge, goodput_mfu_gauge, goodput_seconds_total,
@@ -623,9 +623,22 @@ class Trainer:
                     lambda mode, where, step: flight.dump(
                         reason=f"chaos_{mode}", force=True,
                         extra={"where": where, "injected_at_step": step})
+        # Training-side SLO tracker: the goodput-fraction objective over
+        # the ledger's own SLI (telemetry.slo) — burn-rate state rides
+        # the ring, the watchdog's slo_burn rule, and slo.json in every
+        # flight dump.
+        slo_tracker = None
+        if ledger.enabled and getattr(tcfg, "slo", None) is not None:
+            slo_tracker = build_slo_tracker(
+                tcfg.slo, goodput_fn=ledger.goodput_fraction)
+        if slo_tracker is not None:
+            if sampler is not None:
+                sampler.add_source(slo_tracker.scalars)
+            if flight is not None:
+                flight.add_slo_source(slo_tracker.to_dict)
         if wcfg.enabled:
             watchdog = AnomalyWatchdog(wcfg, sampler, heartbeat=heartbeat,
-                                       tracer=tracer)
+                                       tracer=tracer, slo=slo_tracker)
             if flight is not None:
                 flight.add_context_source(
                     lambda: {"watchdog_alerts": list(watchdog.alerts)})

@@ -91,6 +91,18 @@ def main() -> None:
                    help="adapter draw: zipf (1/(i+1) skew — hot adapters "
                         "stay pool-resident, the tail exercises eviction) "
                         "or uniform")
+    p.add_argument("--trace", default="",
+                   help="replay a dlti-trace/1 JSONL workload trace "
+                        "(benchmarks.traces): each event fires at its "
+                        "recorded arrival offset with its own tenant / "
+                        "priority / session / adapter / lengths / "
+                        "deadline; num-requests, qps, tenants and "
+                        "priority-mix are ignored")
+    p.add_argument("--record-trace", default="",
+                   help="write every request this run submits back out "
+                        "as a dlti-trace/1 JSONL file, making the run a "
+                        "replayable fixture (works in any drive mode, "
+                        "replay included)")
     p.add_argument("--scrape-server-metrics", action="store_true",
                    help="attach the server's on-engine histogram "
                         "summaries (/metrics) to the report")
@@ -119,6 +131,7 @@ def main() -> None:
         long_prompt_frac=args.long_prompt_frac,
         long_prompt_tokens=args.long_prompt_tokens,
         adapters=args.adapters, adapter_mix=args.adapter_mix,
+        trace=args.trace, record_trace=args.record_trace,
     )
     report = run_load_test(cfg)
     d = report.to_dict()

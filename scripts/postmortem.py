@@ -150,6 +150,33 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
             "top_untracked_arrays": (mem.get("top_untracked_arrays")
                                      or [])[:5],
         }
+    # SLO engine (telemetry.slo): every dump carries slo.json — the
+    # per-(objective, class) compliance / error-budget / burn state at
+    # death. "Were we already burning budget when it died, and on which
+    # objective" is the first SLO question an incident review asks.
+    slo_file = data.get("slo.json") or {}
+    slo = None
+    if slo_file.get("objectives"):
+        per_obj = {}
+        for key, st in slo_file["objectives"].items():
+            per_obj[key] = {
+                "compliance": st.get("compliance"),
+                "error_budget_remaining": st.get(
+                    "error_budget_remaining"),
+                "target": st.get("target"),
+                "breaching": bool(st.get("breaching")),
+                "worst_burn": max(
+                    (v for v in (st.get("burn_rates") or {}).values()
+                     if isinstance(v, (int, float))), default=0.0),
+            }
+        slo = {
+            "window_s": slo_file.get("window_s"),
+            "breaching": list(slo_file.get("breaching") or []),
+            "objectives": dict(sorted(
+                per_obj.items(),
+                key=lambda kv: kv[1]["error_budget_remaining"]
+                if kv[1]["error_budget_remaining"] is not None else 1.0)),
+        }
     # Numeric-fault evidence: sentinel dumps carry their verdict in
     # context.json's top level (rollback streak / SDC alert), and any
     # dump may carry the last anomaly the trainer noted.
@@ -202,6 +229,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "sentinel": sentinel or None,
         "goodput": goodput,
         "memory": memory,
+        "slo": slo,
         "disagg": disagg,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
@@ -395,6 +423,21 @@ def render(summary: dict) -> str:
         for a in m.get("top_untracked_arrays") or []:
             w(f"    untracked: {a.get('nbytes', 0) / gib:9.3f} GiB  "
               f"{a.get('shape')} {a.get('dtype')}")
+    if summary.get("slo"):
+        s = summary["slo"]
+        breaching = s.get("breaching") or []
+        w("SLO state at death:" + (
+            f"   (!! BURNING: {', '.join(breaching)})" if breaching
+            else "   (no objective burning)"))
+        for key, o in s["objectives"].items():
+            comp = o.get("compliance")
+            budget = o.get("error_budget_remaining")
+            mark = "  << BREACHING" if o.get("breaching") else ""
+            w(f"    {key:24s} compliance "
+              + (f"{100 * comp:6.2f}%" if comp is not None else "     ?")
+              + f" (target {100 * (o.get('target') or 0):.2f}%)  budget "
+              + (f"{100 * budget:6.1f}%" if budget is not None else "    ?")
+              + f"  worst burn {o.get('worst_burn', 0):.1f}x{mark}")
     if summary.get("disagg"):
         d = summary["disagg"]
         alive = d.get("replicas_alive") or {}

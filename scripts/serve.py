@@ -277,6 +277,41 @@ def parse_args():
     p.add_argument("--watchdog-shed-rate", type=float, default=1.0,
                    help="shed_buildup rule threshold (gateway "
                         "sheds+rejections per second; 0 = rule off)")
+    # -- SLO engine (dlti_tpu.telemetry.slo) ---------------------------
+    p.add_argument("--slo", action="store_true",
+                   help="enable the SLO engine: objectives over the "
+                        "request SLIs, rolling error budgets, "
+                        "multi-window burn-rate alerting (watchdog "
+                        "slo_burn rule), GET /debug/slo, dlti_slo_* "
+                        "gauges, slo.json in flight dumps")
+    p.add_argument("--slo-window", type=float, default=3600.0,
+                   help="SLO compliance / error-budget window seconds")
+    p.add_argument("--slo-burn-tiers", default="14:60:5,6:300:30",
+                   help="burn-rate alert tiers 'factor:long_s:short_s,"
+                        "...' — fires when the budget burns >= factor x "
+                        "over BOTH windows of a tier")
+    p.add_argument("--slo-ttft-s", type=float, default=0.0,
+                   help="TTFT objective threshold seconds (snapped to "
+                        "the nearest histogram bucket bound; 0 = off)")
+    p.add_argument("--slo-ttft-target", type=float, default=0.99,
+                   help="fraction of requests that must meet the TTFT "
+                        "threshold")
+    p.add_argument("--slo-tpot-s", type=float, default=0.0,
+                   help="per-token decode latency objective threshold "
+                        "seconds (0 = off)")
+    p.add_argument("--slo-tpot-target", type=float, default=0.99,
+                   help="fraction of requests that must meet the TPOT "
+                        "threshold")
+    p.add_argument("--slo-queue-s", type=float, default=0.0,
+                   help="engine queue-delay objective threshold seconds "
+                        "(0 = off)")
+    p.add_argument("--slo-queue-target", type=float, default=0.99,
+                   help="fraction of requests that must meet the "
+                        "queue-delay threshold")
+    p.add_argument("--slo-availability-target", type=float, default=0.0,
+                   help="fraction of gateway arrivals that must be "
+                        "served (not shed/rejected), per priority class "
+                        "and overall; needs --gateway; 0 = off")
     p.add_argument("--flight-dir", default="",
                    help="enable the flight recorder: on engine fault, "
                         "replica death, SIGTERM, or watchdog escalation, "
@@ -444,12 +479,23 @@ def main() -> None:
             affinity_prefix_tokens=args.affinity_prefix_tokens,
             adapter_map=args.adapter_map)
     from dlti_tpu.config import (
-        FlightRecorderConfig, TelemetryConfig, WatchdogConfig,
+        FlightRecorderConfig, SLOConfig, TelemetryConfig, WatchdogConfig,
     )
 
     tel_cfg = TelemetryConfig(
         trace_dir=args.trace_dir,
         trace_capacity=args.trace_capacity,
+        slo=SLOConfig(
+            enabled=args.slo,
+            window_s=args.slo_window,
+            burn_tiers=args.slo_burn_tiers,
+            ttft_threshold_s=args.slo_ttft_s,
+            ttft_target=args.slo_ttft_target,
+            tpot_threshold_s=args.slo_tpot_s,
+            tpot_target=args.slo_tpot_target,
+            queue_threshold_s=args.slo_queue_s,
+            queue_target=args.slo_queue_target,
+            availability_target=args.slo_availability_target),
         watchdog=WatchdogConfig(
             enabled=args.watchdog,
             action=args.watchdog_action,

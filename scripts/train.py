@@ -232,6 +232,20 @@ def parse_args():
                         "fraction, per-phase steplog fields, or stitched "
                         "elastic ledger — every site drops to one "
                         "attribute read")
+    p.add_argument("--slo-goodput-floor", type=float, default=0.0,
+                   help="training SLO (telemetry.slo): goodput fraction "
+                        "the run must hold; time below the floor burns "
+                        "the error budget, burn-rate alerts fire the "
+                        "watchdog's slo_burn rule and land in slo.json "
+                        "flight dumps (0 = SLO engine off)")
+    p.add_argument("--slo-goodput-target", type=float, default=0.99,
+                   help="fraction of wall-clock that must sit at or "
+                        "above --slo-goodput-floor")
+    p.add_argument("--slo-window", type=float, default=3600.0,
+                   help="SLO compliance / error-budget window seconds")
+    p.add_argument("--slo-burn-tiers", default="14:60:5,6:300:30",
+                   help="burn-rate alert tiers 'factor:long_s:short_s,"
+                        "...' (SRE multi-window multi-burn-rate)")
     p.add_argument("--no-memory-ledger", action="store_true",
                    help="disable the HBM memory ledger "
                         "(telemetry.memledger): no per-owner attribution, "
@@ -279,8 +293,8 @@ def build_config(args):
 
     from dlti_tpu.config import (
         CheckpointConfig, DataConfig, FlightRecorderConfig, LoRAConfig,
-        OptimizerConfig, SentinelConfig, TelemetryConfig, TrainConfig,
-        WatchdogConfig, ZeROStage, preset,
+        OptimizerConfig, SentinelConfig, SLOConfig, TelemetryConfig,
+        TrainConfig, WatchdogConfig, ZeROStage, preset,
     )
 
     cfg = preset(args.preset, model=args.model)
@@ -410,6 +424,12 @@ def build_config(args):
             goodput_ledger=not args.no_goodput_ledger,
             memory_ledger=not args.no_memory_ledger,
             hbm_budget_bytes=args.hbm_budget_bytes,
+            slo=SLOConfig(
+                enabled=args.slo_goodput_floor > 0,
+                window_s=args.slo_window,
+                burn_tiers=args.slo_burn_tiers,
+                goodput_floor=args.slo_goodput_floor,
+                goodput_target=args.slo_goodput_target),
             watchdog=WatchdogConfig(
                 enabled=args.watchdog,
                 action=args.watchdog_action,

@@ -198,8 +198,31 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
         "goodput_collapse", "hbm_pressure", "disk_pressure",
-        "replica_flap",
+        "replica_flap", "slo_burn",
     )
+
+
+def test_slo_metric_names_are_schema_stable():
+    """SLO gauge names are a scrape contract like the watchdog/gateway
+    sets: compliance, error-budget-remaining, and windowed burn rate,
+    all (objective, class)-labeled and registered by the server
+    registry."""
+    from dlti_tpu.telemetry import SLO_METRIC_NAMES
+    from dlti_tpu.telemetry import slo
+
+    assert SLO_METRIC_NAMES == (
+        "dlti_slo_compliance",
+        "dlti_slo_error_budget_remaining",
+        "dlti_slo_burn_rate",
+    )
+    assert slo.compliance_gauge.name == SLO_METRIC_NAMES[0]
+    assert slo.budget_remaining_gauge.name == SLO_METRIC_NAMES[1]
+    assert slo.burn_rate_gauge.name == SLO_METRIC_NAMES[2]
+    # The default burn tiers are the SRE fast/slow pairing dashboards
+    # and runbooks key on; changing them re-tunes every deployment.
+    assert slo.DEFAULT_BURN_TIERS == "14:60:5,6:300:30"
+    assert slo.parse_burn_tiers(slo.DEFAULT_BURN_TIERS) == (
+        (14.0, 60.0, 5.0), (6.0, 300.0, 30.0))
 
 
 def test_disk_metric_names_are_schema_stable():
@@ -484,7 +507,8 @@ def test_debug_vars_and_dump_surface_contract():
     assert {"now", "interval_s", "capacity", "num_samples",
             "source_errors", "latest", "samples"} <= set(snap)
     assert DUMP_FILES == ("context.json", "spans.json", "metrics.json",
-                          "timeseries.json", "config.json", "memory.json")
+                          "timeseries.json", "config.json", "memory.json",
+                          "slo.json")
     assert MANIFEST == "MANIFEST.json"
 
 
@@ -526,9 +550,33 @@ def test_load_report_schema_includes_gateway_fields():
         # Replica-lifecycle era: tail-of-the-tail percentiles plus the
         # per-run migration/retry disturbance totals.
         "ttft_p999_s", "tpot_p999_ms", "migrations_total", "retries_total",
+        # SLO era: the /debug/slo scrape cross-checked against the
+        # client's own records (server/client/agreement sections).
+        "slo",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"
+
+
+def test_percentile_linear_interpolation():
+    """_percentile interpolates between closest ranks (numpy's default
+    method) — nearest-rank rounding snapped p99 and p99.9 to the same
+    max sample at bench-sized n, hiding tail regressions."""
+    from dlti_tpu.benchmarks.loadgen import _percentile
+
+    xs = [1.0, 2.0, 3.0, 4.0]
+    assert _percentile(xs, 0) == 1.0
+    assert _percentile(xs, 100) == 4.0
+    assert _percentile(xs, 50) == 2.5
+    assert _percentile(xs, 25) == 1.75
+    hundred = [float(i) for i in range(1, 101)]
+    assert abs(_percentile(hundred, 99) - 99.01) < 1e-9
+    assert abs(_percentile(hundred, 99.9) - 99.901) < 1e-9
+    # p99 and p99.9 must now be distinguishable at n=100.
+    assert _percentile(hundred, 99.9) > _percentile(hundred, 99)
+    # Degenerate cases: single sample (any p) and empty.
+    assert _percentile([0.25], 50) == 0.25
+    assert _percentile([], 99) == 0.0
 
 
 def test_per_class_summary_keys():

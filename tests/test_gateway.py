@@ -202,7 +202,8 @@ def test_queued_deadline_shed_before_prefill():
         assert "deadline" in ev[2]
         assert fake.submitted == []  # shed BEFORE any prefill
         shed = registry.counter("dlti_gateway_shed_total")
-        assert shed.value >= 1
+        # Sheds carry the priority label (per-class availability SLIs).
+        assert shed.labels(priority="interactive").value >= 1
         stats = registry.stats_dict()
         assert stats["gateway_queue_depth"] == 0
         assert stats["gateway_queued_tokens"] == 0
@@ -266,12 +267,14 @@ def test_gateway_metric_names_exposed():
                   priority="interactive")
         with pytest.raises(AdmissionError):
             gw.submit([1], SamplingParams(), "r1")
-        gw._m_shed.inc(0)  # force the (unlabeled) shed series to exist
+        # Force the (labeled) shed series to exist without a real shed.
+        gw._m_shed.labels(priority="interactive").inc(0)
         text = registry.render_prometheus()
         for name in GATEWAY_METRIC_NAMES:
             assert name in text, f"{name} missing from exposition"
         assert 'dlti_gateway_admitted_total{priority="interactive",tenant="T"} 1' in text
-        assert 'dlti_gateway_rejected_total{reason="queue_full"} 1' in text
+        assert ('dlti_gateway_rejected_total'
+                '{priority="interactive",reason="queue_full"} 1') in text
     finally:
         gw.shutdown()
 

@@ -44,7 +44,8 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
     from dlti_tpu.telemetry import (
         FLIGHT_METRIC_NAMES, LEDGER_METRIC_NAMES,
-        REQUEST_PHASE_METRIC_NAMES, WATCHDOG_METRIC_NAMES,
+        REQUEST_PHASE_METRIC_NAMES, SLO_METRIC_NAMES,
+        WATCHDOG_METRIC_NAMES,
     )
     from dlti_tpu.telemetry.heartbeat import HEARTBEAT_METRIC_NAMES
     from dlti_tpu.telemetry.memledger import MEMLEDGER_METRIC_NAMES
@@ -67,6 +68,7 @@ def test_pinned_name_tuples_follow_convention():
                        (LEDGER_METRIC_NAMES, "ledger"),
                        (REQUEST_PHASE_METRIC_NAMES, "request_phase"),
                        (MEMLEDGER_METRIC_NAMES, "memledger"),
+                       (SLO_METRIC_NAMES, "slo"),
                        (HEARTBEAT_METRIC_NAMES, "heartbeat"),
                        (POOL_METRIC_NAMES, "disagg-pools"),
                        (KV_HANDOFF_METRIC_NAMES, "kv-handoff"),
@@ -78,7 +80,9 @@ def test_pinned_name_tuples_follow_convention():
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
     from dlti_tpu.serving import adapters, lifecycle
-    from dlti_tpu.telemetry import flightrecorder, ledger, memledger, watchdog
+    from dlti_tpu.telemetry import (
+        flightrecorder, ledger, memledger, slo, watchdog,
+    )
     from dlti_tpu.training import elastic, sentinel
     from dlti_tpu.utils import durable_io
 
@@ -102,6 +106,8 @@ def test_module_level_metric_objects_follow_convention():
             ledger.phase_requests_total,
             memledger.hbm_bytes_gauge, memledger.hbm_peak_gauge,
             memledger.hbm_headroom_gauge, memledger.hbm_untracked_gauge,
+            slo.compliance_gauge, slo.budget_remaining_gauge,
+            slo.burn_rate_gauge,
             durable_io.free_bytes_gauge, durable_io.write_errors_total,
             durable_io.degraded_gauge)
     _assert_convention([m.name for m in objs], "module-level metrics")
@@ -175,6 +181,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_request_phase_seconds_total",
                      "dlti_hbm_bytes",
                      "dlti_hbm_headroom_bytes",
+                     "dlti_slo_compliance",
+                     "dlti_slo_error_budget_remaining",
+                     "dlti_slo_burn_rate",
                      "dlti_disk_free_bytes",
                      "dlti_disk_write_errors_total",
                      "dlti_disk_degraded",

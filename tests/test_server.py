@@ -72,6 +72,16 @@ def test_health_models_stats(live_server):
     assert status == 200 and "free_blocks" in stats
 
 
+def test_debug_slo_404_when_disabled(live_server):
+    # This server was started without TelemetryConfig.slo — the route
+    # must say so instead of returning an empty objectives dict (the
+    # live-agreement path in test_traces.py covers the enabled side).
+    host, port = live_server
+    status, body = _get(host, port, "/debug/slo")
+    assert status == 404
+    assert "slo" in body.get("error", {}).get("message", "").lower()
+
+
 def test_metrics_prometheus_exposition(live_server):
     """GET /metrics renders the /stats counters in Prometheus text
     format (vLLM-parity observability): TYPE lines + numeric samples,

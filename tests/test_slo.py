@@ -1,0 +1,374 @@
+"""SLO engine tests (telemetry.slo): window math, budgets, burn alerts.
+
+Fake-clock unit tests for the tracker's multi-window multi-burn-rate
+machinery (the fast/slow edge, watchdog re-arm, exact budget
+conservation), the objective builders over existing SLIs (bucket-snapped
+latency cuts, labeled gateway counter families, time-kind goodput), and
+a live tiny-model server cross-check: ``LoadReport.slo`` must agree with
+``GET /debug/slo`` because both classify at the identical snapped
+threshold.
+"""
+
+import threading
+
+import pytest
+
+from dlti_tpu.config import SLOConfig, WatchdogConfig
+from dlti_tpu.telemetry.slo import (
+    Objective,
+    SLOTracker,
+    availability_objective,
+    build_tracker,
+    goodput_objective,
+    histogram_objective,
+    parse_burn_tiers,
+    snap_threshold,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class Counts:
+    """Controllable cumulative (good, total) SLI."""
+
+    def __init__(self):
+        self.good = 0.0
+        self.total = 0.0
+
+    def __call__(self):
+        return self.good, self.total
+
+    def ok(self, n: float):
+        self.good += n
+        self.total += n
+
+    def bad(self, n: float):
+        self.total += n
+
+
+def _tracker(counts, clock, *, target=0.9, window=100.0, tiers="4:10:2"):
+    obj = Objective(name="ttft", target=target, counts_fn=counts)
+    return SLOTracker([obj], window_s=window, burn_tiers=tiers, clock=clock)
+
+
+# ----------------------------------------------------------------------
+# Burn-rate window math
+# ----------------------------------------------------------------------
+
+def test_burn_fires_only_when_fast_and_slow_windows_agree():
+    """The SRE fast/slow pairing: the short window reacts first (burst
+    onset), but the tier fires only once the long window confirms the
+    burn is sustained — and stops as soon as the short window goes
+    quiet, even while the long window still remembers the burst."""
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock)  # target .9, tier 4x over 10s confirmed by 2s
+    tr.evaluate()  # zero point at t=0
+    for t in range(1, 11):  # 10 healthy seconds, 10 req/s
+        clock.t = float(t)
+        c.ok(10)
+        state = tr.evaluate()["ttft/all"]
+    assert state["compliance"] == 1.0
+    assert state["error_budget_remaining"] == 1.0
+    assert not state["breaching"]
+
+    # Burst onset: 1 s of fully-bad traffic. The 2 s window sees it
+    # (burn 5x >= 4x) but the 10 s window is still mostly healthy.
+    clock.t = 11.0
+    c.bad(10)
+    state = tr.evaluate()["ttft/all"]
+    assert state["burn_rates"]["2s"] >= 4.0
+    assert state["burn_rates"]["10s"] < 4.0
+    assert not state["breaching"]
+
+    # Sustained burst: by t=14 the long window crosses the factor too.
+    for t in (12, 13, 14):
+        clock.t = float(t)
+        c.bad(10)
+        state = tr.evaluate()["ttft/all"]
+    assert state["burn_rates"]["10s"] >= 4.0
+    assert state["burn_rates"]["2s"] >= 4.0
+    assert state["breaching"]
+    burns = tr.active_burns(clock.t)
+    assert len(burns) == 1
+    assert burns[0]["objective"] == "ttft" and burns[0]["class"] == "all"
+
+    # Recovery: healthy traffic drains the SHORT window in 2 s, so the
+    # alert clears immediately even though the long window still burns.
+    for t in (15, 16, 17):
+        clock.t = float(t)
+        c.ok(10)
+        state = tr.evaluate()["ttft/all"]
+    assert state["burn_rates"]["10s"] >= 4.0   # burst still in long window
+    assert state["burn_rates"]["2s"] < 4.0
+    assert not state["breaching"]
+    assert tr.active_burns(clock.t) == []
+
+
+def test_young_tracker_never_counts_pre_history():
+    """The first sample is the zero point: cumulative counters that
+    predate the tracker (a server that served millions of requests
+    before --slo was hot-enabled) must not count against the budget."""
+    clock, c = FakeClock(100.0), Counts()
+    c.good, c.total = 10.0, 1000.0   # terrible history, pre-tracker
+    tr = _tracker(c, clock)
+    tr.evaluate()
+    clock.t = 101.0
+    c.ok(10)
+    state = tr.evaluate()["ttft/all"]
+    assert state["total"] == 10.0    # only post-construction events
+    assert state["compliance"] == 1.0
+    assert not state["breaching"]
+
+
+def test_counter_reset_reads_as_quiet_not_negative():
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock)
+    tr.evaluate()
+    clock.t = 1.0
+    c.ok(50)
+    tr.evaluate()
+    clock.t = 2.0
+    c.good, c.total = 0.0, 0.0       # process-restart-shaped reset
+    state = tr.evaluate()["ttft/all"]
+    assert state["good"] == 0.0 and state["total"] == 0.0
+    assert state["compliance"] == 1.0
+    assert state["error_budget_remaining"] == 1.0
+
+
+# ----------------------------------------------------------------------
+# Budget conservation
+# ----------------------------------------------------------------------
+
+def test_error_budget_conservation_exact():
+    """At every evaluation: good + bad == total, compliance == good /
+    total, and budget spent == bad / ((1 - target) * total) — exactly,
+    not approximately (integer event counts, exact float sums)."""
+    target = 0.9
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock, target=target, window=10_000.0,
+                  tiers="4:10:2")
+    tr.evaluate()
+    seq = [(9, 1), (10, 0), (7, 3), (10, 0), (0, 2), (25, 5), (10, 0)]
+    for i, (ok_n, bad_n) in enumerate(seq, start=1):
+        clock.t = float(i)
+        c.ok(ok_n)
+        c.bad(bad_n)
+        s = tr.evaluate()["ttft/all"]
+        assert s["good"] + s["bad"] == s["total"]
+        assert s["compliance"] == pytest.approx(s["good"] / s["total"])
+        allowed = (1.0 - target) * s["total"]
+        expect = max(0.0, 1.0 - s["bad"] / allowed)
+        assert s["error_budget_remaining"] == pytest.approx(expect)
+        # Cross-identity: (1 - compliance) * total is exactly the bad
+        # count the budget was charged for.
+        assert (1.0 - s["compliance"]) * s["total"] == \
+            pytest.approx(s["bad"])
+    # Totals over the run: 71 ok + 11 bad.
+    s = tr.evaluate(clock.t)["ttft/all"]
+    assert s["total"] == 82.0 and s["bad"] == 11.0
+
+
+# ----------------------------------------------------------------------
+# Watchdog slo_burn rule: edge trigger + re-arm
+# ----------------------------------------------------------------------
+
+def test_watchdog_slo_burn_edge_trigger_and_rearm():
+    from dlti_tpu.telemetry import AnomalyWatchdog, TimeSeriesSampler
+
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock)
+    wd = AnomalyWatchdog(WatchdogConfig(enabled=True),
+                         TimeSeriesSampler(interval_s=60.0),
+                         slo=tr, clock=clock)
+
+    def slo_alerts(now):
+        return [a for a in wd.check_now(now) if a["rule"] == "slo_burn"]
+
+    # The tracker is pull-driven: in production the time-series sampler
+    # pulls scalars() every interval, giving the windows their sample
+    # cadence. Simulate that 1 Hz pull alongside the traffic.
+    tr.evaluate()
+    for t in range(1, 11):
+        clock.t = float(t)
+        c.ok(10)
+        tr.evaluate()
+    assert slo_alerts(clock.t) == []           # healthy
+    for t in range(11, 15):
+        clock.t = float(t)
+        c.bad(10)
+        tr.evaluate()
+    fired = slo_alerts(clock.t)
+    assert len(fired) == 1                     # burst: one alert
+    assert "ttft" in fired[0]["message"]
+    assert fired[0]["objective"] == "ttft"
+    assert fired[0]["cls"] == "all"
+    assert slo_alerts(clock.t) == []           # edge-triggered: no repeat
+    for t in (15, 16, 17):                     # recovery clears + re-arms
+        clock.t = float(t)
+        c.ok(10)
+        tr.evaluate()
+    assert slo_alerts(clock.t) == []
+    for t in (18, 19, 20, 21):                 # second burst: fires again
+        clock.t = float(t)
+        c.bad(10)
+        tr.evaluate()
+    assert len(slo_alerts(clock.t)) == 1
+
+
+# ----------------------------------------------------------------------
+# Objective builders
+# ----------------------------------------------------------------------
+
+def test_snap_threshold_picks_largest_bound_at_or_below():
+    buckets = (0.1, 0.25, 0.5)
+    assert snap_threshold(buckets, 0.3) == 0.25
+    assert snap_threshold(buckets, 0.25) == 0.25
+    assert snap_threshold(buckets, 10.0) == 0.5
+    assert snap_threshold(buckets, 0.05) == 0.1   # undercuts all: smallest
+
+
+def test_histogram_objective_counts_at_snapped_cut():
+    from dlti_tpu.telemetry.registry import Histogram
+
+    h = Histogram("dlti_test_slo_ttft_seconds", (0.1, 0.25, 0.5),
+                  help="test histogram")
+    obj = histogram_objective("ttft", h, 0.3, 0.99)
+    assert obj.threshold_s == 0.25            # snapped down to a bound
+    for v in (0.05, 0.2, 0.25, 0.4, 9.0):
+        h.observe(v)
+    good, total = obj.counts_fn()
+    assert (good, total) == (3.0, 5.0)        # <= 0.25 is good; 0.4, 9 bad
+
+
+def test_availability_objective_sums_labeled_counter_families():
+    stats = {
+        'dlti_gateway_admitted_total{priority="interactive",tenant="a"}': 5,
+        'dlti_gateway_admitted_total{priority="batch",tenant="a"}': 3,
+        'dlti_gateway_rejected_total{priority="interactive",'
+        'reason="queue_full"}': 2,
+        'dlti_gateway_shed_total{priority="batch"}': 1,
+        "dlti_gateway_queue_depth": 7,        # different metric: ignored
+    }
+    good, total = availability_objective(
+        lambda: stats, 0.99).counts_fn()
+    assert (good, total) == (7.0, 10.0)       # 8 admitted - 1 shed / 8 + 2
+    good, total = availability_objective(
+        lambda: stats, 0.99, cls="interactive").counts_fn()
+    assert (good, total) == (5.0, 7.0)
+    good, total = availability_objective(
+        lambda: stats, 0.99, cls="batch").counts_fn()
+    assert (good, total) == (2.0, 3.0)
+
+
+def test_time_kind_goodput_objective_integrates_left_riemann():
+    cell = {"v": 0.9}
+    clock = FakeClock()
+    tr = SLOTracker([goodput_objective(lambda: cell["v"],
+                                       floor=0.8, target=0.9)],
+                    window_s=1000.0, burn_tiers="4:10:2", clock=clock)
+    for t in range(0, 9):                     # value >= floor for 8 s
+        clock.t = float(t)
+        tr.evaluate()
+    cell["v"] = 0.5                           # dips below the floor
+    clock.t = 9.0
+    tr.evaluate()   # interval (8,9] judged by the 0.9 that held at t=8
+    clock.t = 10.0
+    s = tr.evaluate()["goodput/all"]          # (9,10] judged by the 0.5
+    assert s["total"] == pytest.approx(10.0)
+    assert s["good"] == pytest.approx(9.0)
+    assert s["compliance"] == pytest.approx(0.9)
+
+
+# ----------------------------------------------------------------------
+# Validation + config gating
+# ----------------------------------------------------------------------
+
+def test_objective_and_tier_validation():
+    with pytest.raises(ValueError):           # target 1.0: zero budget
+        Objective(name="x", target=1.0, counts_fn=lambda: (0, 0))
+    with pytest.raises(ValueError):
+        Objective(name="x", target=0.0, counts_fn=lambda: (0, 0))
+    with pytest.raises(ValueError):           # events kind needs counts_fn
+        Objective(name="x", target=0.9)
+    with pytest.raises(ValueError):           # short must be < long
+        parse_burn_tiers("4:10:10")
+    with pytest.raises(ValueError):
+        parse_burn_tiers("4:10")
+    with pytest.raises(ValueError):
+        parse_burn_tiers("0:10:2")
+    assert parse_burn_tiers(" 14:60:5 , 6:300:30 ") == (
+        (14.0, 60.0, 5.0), (6.0, 300.0, 30.0))
+
+
+def test_build_tracker_gating():
+    from dlti_tpu.telemetry import RequestTelemetry, SpanTracer
+
+    assert build_tracker(SLOConfig(enabled=False)) is None
+    # Enabled but nothing resolves to an objective: no dead engine.
+    assert build_tracker(SLOConfig(enabled=True)) is None
+    tel = RequestTelemetry(tracer=SpanTracer(enabled=False))
+    tr = build_tracker(SLOConfig(enabled=True, ttft_threshold_s=0.25),
+                       telemetry=tel)
+    assert tr is not None
+    assert [o.key for o in tr.objectives] == ["ttft/all"]
+    # Availability needs a stats_fn AND a nonzero target.
+    tr = build_tracker(
+        SLOConfig(enabled=True, availability_target=0.999),
+        stats_fn=lambda: {}, classes=("interactive", "batch"))
+    assert [o.key for o in tr.objectives] == [
+        "availability/all", "availability/interactive",
+        "availability/batch"]
+
+
+def test_scalars_and_to_dict_shapes():
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock)
+    c.ok(4)
+    clock.t = 1.0
+    sc = tr.scalars(clock.t)
+    assert sc["slo_objectives"] == 1
+    assert sc["slo_breaching"] == 0
+    assert sc["slo_compliance"] == {"ttft/all": 1.0}
+    assert 0.0 <= sc["slo_min_budget_remaining"] <= 1.0
+    d = tr.to_dict(clock.t)
+    assert d["num_objectives"] == 1 and d["breaching"] == []
+    assert d["burn_tiers"] == [
+        {"factor": 4.0, "long_s": 10.0, "short_s": 2.0}]
+    assert d["objectives"]["ttft/all"]["kind"] == "events"
+    # Empty tracker still produces a well-formed scalar dict.
+    assert SLOTracker(clock=clock).scalars(0.0) == {"slo_objectives": 0}
+
+
+def test_tracker_thread_safety_smoke():
+    """Concurrent pulls (sampler / watchdog / HTTP all pull the same
+    tracker) must not corrupt state or raise."""
+    clock, c = FakeClock(), Counts()
+    tr = _tracker(c, clock, window=50.0)
+    stop = threading.Event()
+    errors = []
+
+    def pull():
+        try:
+            while not stop.is_set():
+                tr.scalars()
+                tr.active_burns()
+                tr.to_dict()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=pull) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        clock.t += 0.01
+        c.ok(1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors

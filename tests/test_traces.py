@@ -1,0 +1,278 @@
+"""Trace format + replay tests (dlti_tpu.benchmarks.traces / loadgen).
+
+Three contracts pinned here:
+
+1. **Byte determinism** — the same seed yields a byte-identical trace
+   file (sorted-key compact JSON, µs-rounded offsets), so committed
+   traces are diffable fixtures and drills are reproducible.
+2. **Replay fidelity** — loadgen's ``--trace`` drive fires each event at
+   (or just after, never before) its recorded offset; ``--record-trace``
+   of a replay round-trips the workload descriptors unchanged.
+3. **Live agreement** — ``LoadReport.slo``'s client-side recomputation
+   of the server's objectives matches ``GET /debug/slo`` within 1% per
+   (objective, class) pair, end-to-end against a real tiny-model server.
+"""
+
+import json
+import socket
+import sys
+import threading
+
+import pytest
+
+from dlti_tpu.benchmarks.loadgen import LoadGenConfig, run_load_test
+from dlti_tpu.benchmarks.traces import (
+    GENERATORS, TRACE_FORMAT, TraceEvent, main as traces_main, read_trace,
+    synthesize, trace_summary, write_trace,
+)
+
+
+def _free_dead_port() -> int:
+    """A port nothing is listening on (bind, read it off, close)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ----------------------------------------------------------------------
+# Format: determinism, round-trip, schema tolerance
+# ----------------------------------------------------------------------
+
+def test_same_seed_byte_identical_files(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    for path in (a, b):
+        meta, events = synthesize("flash_crowd", duration_s=10.0, rate=8.0,
+                                  seed=7, session_frac=0.3,
+                                  adapters=("lora-a", "lora-b"),
+                                  adapter_frac=0.25)
+        write_trace(str(path), events, meta)
+    assert a.read_bytes() == b.read_bytes()
+    assert a.stat().st_size > 0
+    # ... and a different seed is actually a different trace.
+    meta, events = synthesize("flash_crowd", duration_s=10.0, rate=8.0,
+                              seed=8)
+    c = tmp_path / "c.jsonl"
+    write_trace(str(c), events, meta)
+    assert a.read_bytes() != c.read_bytes()
+
+
+def test_write_read_round_trip_sorts_and_rounds(tmp_path):
+    path = tmp_path / "t.jsonl"
+    events = [
+        TraceEvent(offset_s=2.0000004, prompt_tokens=10, max_tokens=4,
+                   tenant="t1", priority="batch", session="t1/s0",
+                   adapter="lora-x", deadline_s=1.5),
+        TraceEvent(offset_s=0.5, prompt_tokens=3, max_tokens=2),
+    ]
+    write_trace(str(path), events, meta={"generator": "hand", "seed": 0})
+    header, back = read_trace(str(path))
+    assert header["format"] == TRACE_FORMAT
+    assert header["num_events"] == 2
+    assert header["generator"] == "hand"
+    # Events come back offset-sorted with µs-rounded offsets; every
+    # workload descriptor survives the trip.
+    assert [e.offset_s for e in back] == [0.5, 2.0]
+    assert back[0] == events[1]
+    e = back[1]
+    assert (e.prompt_tokens, e.max_tokens) == (10, 4)
+    assert (e.tenant, e.priority, e.session, e.adapter) == \
+        ("t1", "batch", "t1/s0", "lora-x")
+    assert e.deadline_s == 1.5
+
+
+def test_from_dict_ignores_unknown_keys_so_format_can_grow():
+    e = TraceEvent.from_dict({"offset_s": 1.0, "prompt_tokens": 2,
+                              "max_tokens": 3, "some_future_field": "x"})
+    assert (e.offset_s, e.prompt_tokens, e.max_tokens) == (1.0, 2, 3)
+    assert e.tenant == "t0" and e.priority == "interactive"
+
+
+def test_headerless_file_gets_synthesized_header(tmp_path):
+    path = tmp_path / "bare.jsonl"
+    path.write_text(json.dumps({"offset_s": 0.25, "prompt_tokens": 5,
+                                "max_tokens": 6}) + "\n")
+    header, events = read_trace(str(path))
+    assert header["format"] == TRACE_FORMAT
+    assert header["num_events"] == 1
+    assert events[0].offset_s == 0.25
+
+
+# ----------------------------------------------------------------------
+# Generators
+# ----------------------------------------------------------------------
+
+def test_generators_produce_well_formed_events():
+    for gen in GENERATORS:
+        meta, events = synthesize(gen, duration_s=20.0, rate=6.0, seed=3,
+                                  session_frac=0.5)
+        assert meta["generator"] == gen and meta["seed"] == 3
+        assert events, gen
+        offsets = [e.offset_s for e in events]
+        assert offsets == sorted(offsets)
+        assert 0.0 <= offsets[0] and offsets[-1] < 20.0
+        for e in events:
+            assert e.prompt_tokens >= 1 and e.max_tokens >= 1
+            assert e.priority in ("interactive", "batch")
+            if e.session:
+                assert e.session.startswith(e.tenant + "/")
+
+
+def test_flash_crowd_surges_inside_the_burst_window():
+    meta, events = synthesize("flash_crowd", duration_s=60.0, rate=4.0,
+                              seed=11, flash_at_s=20.0,
+                              flash_duration_s=10.0, flash_factor=8.0)
+    assert meta["flash_at_s"] == 20.0 and meta["flash_factor"] == 8.0
+    in_burst = sum(1 for e in events if 20.0 <= e.offset_s < 30.0)
+    before = sum(1 for e in events if e.offset_s < 20.0)
+    burst_rate = in_burst / 10.0
+    base_rate = before / 20.0
+    # 8x surge with a fixed seed: well clear of a 3x statistical wobble.
+    assert burst_rate > 3.0 * base_rate, (burst_rate, base_rate)
+
+
+def test_zipf_tenants_skew_toward_t0():
+    _, events = synthesize("poisson", duration_s=60.0, rate=8.0, seed=5,
+                           tenants=4, zipf_alpha=1.1)
+    counts = {}
+    for e in events:
+        counts[e.tenant] = counts.get(e.tenant, 0) + 1
+    assert set(counts) <= {"t0", "t1", "t2", "t3"}
+    assert counts["t0"] == max(counts.values())
+
+
+def test_trace_summary_shape():
+    assert trace_summary([]) == {"num_events": 0}
+    _, events = synthesize("poisson", duration_s=30.0, rate=6.0, seed=2,
+                           interactive_frac=0.8)
+    s = trace_summary(events)
+    assert s["num_events"] == len(events)
+    assert 0.0 <= s["interactive_frac"] <= 1.0
+    assert s["tenants"] >= 1 and s["top_tenant_frac"] <= 1.0
+    assert s["mean_prompt_tokens"] >= 1
+
+
+def test_cli_main_writes_readable_trace(tmp_path, capsys, monkeypatch):
+    out = tmp_path / "cli.jsonl"
+    monkeypatch.setattr(sys, "argv", [
+        "traces", "--out", str(out), "--generator", "flash_crowd",
+        "--duration-s", "8", "--rate", "6", "--seed", "4"])
+    traces_main()
+    header, events = read_trace(str(out))
+    assert header["generator"] == "flash_crowd" and events
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["num_events"] == len(events)
+
+
+# ----------------------------------------------------------------------
+# Replay (no server needed: a dead port refuses fast; the dispatch
+# timing and --record-trace capture happen client-side regardless)
+# ----------------------------------------------------------------------
+
+def test_replay_offsets_faithful_and_never_early(tmp_path):
+    src = tmp_path / "src.jsonl"
+    out = tmp_path / "rerecorded.jsonl"
+    meta, events = synthesize("poisson", duration_s=1.5, rate=8.0, seed=9,
+                              session_frac=0.5)
+    assert events
+    write_trace(str(src), events, meta)
+    report = run_load_test(LoadGenConfig(
+        host="127.0.0.1", port=_free_dead_port(), trace=str(src),
+        record_trace=str(out), concurrency=64, timeout_s=2.0,
+        scrape_server_metrics=False, scrape_debug_vars=False))
+    # Every event was submitted (the dead port errors them, but the
+    # submission — and its capture — happened).
+    assert report.num_requests == len(events)
+    header, rec = read_trace(str(out))
+    assert header["mode"] == "replay" and header["source"] == "loadgen"
+    assert len(rec) == len(events)
+    for s, r in zip(events, rec):
+        # Never ahead of the recorded arrival; close behind it (the
+        # dispatch loop sleeps to the offset, then stamps at task start).
+        assert r.offset_s >= s.offset_s - 1e-3, (s.offset_s, r.offset_s)
+        assert r.offset_s - s.offset_s < 1.0, (s.offset_s, r.offset_s)
+        # Workload descriptors round-trip through the replay body.
+        assert (r.tenant, r.priority, r.session) == \
+            (s.tenant, s.priority, s.session)
+        assert r.prompt_tokens == s.prompt_tokens
+        assert r.max_tokens == s.max_tokens
+
+
+# ----------------------------------------------------------------------
+# Live agreement: LoadReport.slo vs GET /debug/slo on a real server
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def slo_server():
+    import jax
+    import jax.numpy as jnp
+
+    from dlti_tpu.config import MODEL_PRESETS, SLOConfig, TelemetryConfig
+    from dlti_tpu.data.tokenizer import ByteTokenizer
+    from dlti_tpu.models import LlamaForCausalLM
+    from dlti_tpu.serving import EngineConfig, InferenceEngine, SamplingParams
+    from dlti_tpu.serving.server import ServerConfig, make_server
+
+    cfg = MODEL_PRESETS["llama_tiny"]
+    model = LlamaForCausalLM(cfg, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ec = EngineConfig(max_seqs=4, block_size=8, num_blocks=128,
+                      max_model_len=128, cache_dtype="float32",
+                      eos_token_id=-1)
+    engine = InferenceEngine(cfg, params, ec)
+    # Generous thresholds + an hour-long budget window: on CPU every
+    # request is "good", so server and client both report 100% and the
+    # agreement check exercises the full pipeline without flakiness.
+    tel = TelemetryConfig(slo=SLOConfig(
+        enabled=True, window_s=3600.0, ttft_threshold_s=30.0,
+        ttft_target=0.5, tpot_threshold_s=30.0, tpot_target=0.5))
+    httpd, async_engine = make_server(
+        engine, ByteTokenizer(),
+        ServerConfig(host="127.0.0.1", port=0, telemetry=tel,
+                     default_params=SamplingParams(max_tokens=8)))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield "127.0.0.1", port
+    httpd.shutdown()
+    httpd.sampler.stop()
+    async_engine.shutdown()
+    httpd.server_close()
+
+
+def test_debug_slo_endpoint_live(slo_server):
+    import http.client
+
+    host, port = slo_server
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", "/debug/slo")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert set(body["objectives"]) == {"ttft/all", "tpot/all"}
+    assert body["objectives"]["ttft/all"]["objective"] == "ttft"
+    assert body["window_s"] == 3600.0
+    assert isinstance(body["burn_tiers"], list) and body["burn_tiers"]
+
+
+def test_loadreport_slo_matches_debug_slo(slo_server):
+    host, port = slo_server
+    report = run_load_test(LoadGenConfig(
+        host=host, port=port, num_requests=12, concurrency=4,
+        max_tokens=8, stream=True, prompt="agreement check prompt",
+        scrape_server_metrics=False))
+    assert not report.errors and report.num_ok == 12
+    assert report.slo, "server advertises SLOs; LoadReport.slo must fill"
+    # Per-pair server-vs-client agreement within 1% — the acceptance
+    # bar for the whole cross-check (ISSUE acceptance criterion).
+    assert report.slo["max_delta"] <= 0.01, report.slo["agreement"]
+    agreement = report.slo["agreement"]
+    assert set(agreement) == {"ttft/all", "tpot/all"}
+    for key, pair in agreement.items():
+        assert pair["server"] == pytest.approx(pair["client"], abs=0.01)
+    assert report.slo["breaching"] == []
+    for key, srv in report.slo["server"].items():
+        assert srv["error_budget_remaining"] == pytest.approx(1.0, abs=0.05)
