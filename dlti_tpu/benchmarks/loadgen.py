@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import random
+import re
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
@@ -239,6 +240,17 @@ class LoadReport:
     # headroom" alongside the latency numbers. Empty when the scrape is
     # off, the route is absent, or the server's ledger is disabled.
     memory: dict = field(default_factory=dict)
+    # Multi-process fleet federation cross-check (serving.fleet): when
+    # the end-of-run /metrics scrape finds per-worker federated series
+    # (dlti_fleet_w{i}_requests, ...), sum each counter across workers
+    # and compare against the gateway-level dlti_<key> total — the two
+    # are computed from the same per-worker snapshots, so any delta
+    # means the federation lost or double-counted a worker (e.g. across
+    # a respawn). {"per_worker": {id: {key: v}}, "checks": {key:
+    # {per_worker_sum, fleet_total, delta}}, "consistent": bool, plus
+    # the fleet liveness/respawn counters}. Empty against a
+    # single-process server.
+    fleet_federation: dict = field(default_factory=dict)
     # SLO cross-check (telemetry.slo via GET /debug/slo): the server's
     # per-(objective, class) compliance / error-budget / breaching state
     # at run end, the client's own compliance recomputed from this run's
@@ -794,6 +806,92 @@ async def _scrape_adapter_hit_rate(cfg: LoadGenConfig) -> float:
     return round(hits / (hits + misses), 4) if hits + misses else 0.0
 
 
+# Per-worker counters the fleet supervisor federates (must mirror
+# dlti_tpu.serving.fleet.WORKER_COUNTER_KEYS — pinned by the fleet tests;
+# not imported so the loadgen stays usable against a remote server
+# without pulling in the engine stack).
+_FLEET_COUNTER_KEYS = ("requests", "generated_tokens", "prefill_tokens",
+                       "preemptions", "decode_steps")
+_FLEET_SERIES_RE = re.compile(r"^dlti_fleet_w(\d+)_([a-z_]+) (\S+)$")
+
+
+def _fleet_federation_report(metrics_text: str) -> dict:
+    """LoadReport.fleet_federation from a raw /metrics exposition: sum
+    each per-worker federated counter (``dlti_fleet_w{i}_<key>``) across
+    workers and check it equals the gateway-level ``dlti_<key>`` total.
+    {} when the exposition carries no fleet series (single-process
+    server)."""
+    scalars: dict = {}
+    per_worker: dict = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _FLEET_SERIES_RE.match(line)
+        if m:
+            wid, key, val = int(m.group(1)), m.group(2), m.group(3)
+            try:
+                per_worker.setdefault(wid, {})[key] = float(val)
+            except ValueError:
+                pass
+            continue
+        name, _, value = line.partition(" ")
+        try:
+            scalars[name] = float(value)
+        except ValueError:
+            pass
+    if not per_worker:
+        return {}
+    checks: dict = {}
+    for key in _FLEET_COUNTER_KEYS:
+        rows = [w[key] for w in per_worker.values() if key in w]
+        if not rows or f"dlti_{key}" not in scalars:
+            continue
+        total = scalars[f"dlti_{key}"]
+        checks[key] = {
+            "per_worker_sum": sum(rows),
+            "fleet_total": total,
+            "delta": total - sum(rows),
+        }
+    return {
+        "workers": sorted(per_worker),
+        "workers_alive": scalars.get("dlti_fleet_workers_alive"),
+        "respawns_total": scalars.get("dlti_fleet_respawns_total"),
+        "per_worker": per_worker,
+        "checks": checks,
+        "max_abs_delta": max((abs(c["delta"]) for c in checks.values()),
+                             default=0.0),
+        "consistent": all(c["delta"] == 0 for c in checks.values()),
+    }
+
+
+async def _scrape_fleet_federation(cfg: LoadGenConfig) -> dict:
+    """GET /metrics and run the fleet federation cross-check.
+    Best-effort like every scrape: {} on any failure or against a
+    server with no fleet series."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(cfg.host, cfg.port), 10.0)
+        req = (f"GET /metrics HTTP/1.1\r\nHost: {cfg.host}:{cfg.port}\r\n"
+               f"Connection: close\r\n\r\n").encode()
+        writer.write(req)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), 10.0)
+        if b" 200" not in status_line:
+            return {}
+        headers: dict = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = b"".join([c async for c in _iter_body(reader, headers, 10.0)])
+        writer.close()
+    except Exception:
+        return {}
+    return _fleet_federation_report(raw.decode(errors="replace"))
+
+
 async def _scrape_cache_hit_rate(cfg: LoadGenConfig) -> float:
     """Prefix-cache hit rate from the server's own /stats counters:
     tokens served from cache (HBM hits + lower-tier restores) over all
@@ -970,6 +1068,10 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     # against this run's own records — best-effort like every scrape.
     slo_snap = (await _http_get_json(cfg.host, cfg.port, "/debug/slo")
                 if cfg.scrape_debug_vars else None)
+    # End-of-run fleet federation cross-check (serving.fleet) — rides
+    # the same best-effort gate; {} against a single-process server.
+    fleet_federation = (await _scrape_fleet_federation(cfg)
+                        if cfg.scrape_debug_vars else {})
     slo = (_slo_report(slo_snap, records)
            if slo_snap and slo_snap.get("objectives") else {})
     memory = {}
@@ -1059,6 +1161,7 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         warm_phases=_phase_means(warm),
         memory=memory,
         slo=slo,
+        fleet_federation=fleet_federation,
     )
 
 

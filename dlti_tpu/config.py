@@ -689,6 +689,34 @@ class ReplicaLifecycleConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-process serving fleet (``dlti_tpu.serving.fleet``): a
+    supervisor process spawns N engine worker processes and drives them
+    over the TCP wire protocol (``serving.wire``). Off by default — the
+    in-process engine/replica paths are untouched."""
+
+    workers: int = 2
+    host: str = "127.0.0.1"
+    # Worker startup bound: spawn -> jax import -> model build -> warmup
+    # -> port published. Generous because warmup compiles the decode
+    # ladder (first boot, cold compilation cache).
+    startup_timeout_s: float = 600.0
+    # Per-RPC socket timeout. A step can include a first-use prefill
+    # bucket compile, so this is a liveness bound, not a latency target.
+    rpc_timeout_s: float = 300.0
+    # Idle heartbeat: refresh a worker's health/metrics snapshot when its
+    # last contact is older than this (piggybacked on the step loop).
+    health_interval_s: float = 2.0
+    # Respawn backoff after a worker death (exponential, capped) and the
+    # total respawns allowed per worker (elastic-launcher pattern).
+    respawn_backoff_s: float = 0.5
+    respawn_backoff_max_s: float = 30.0
+    restart_budget: int = 8
+    term_grace_s: float = 5.0
+    max_frame_bytes: int = 256 * 1024 * 1024
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
@@ -698,6 +726,7 @@ class ServingConfig:
     disagg: DisaggConfig = field(default_factory=DisaggConfig)
     lifecycle: ReplicaLifecycleConfig = field(
         default_factory=ReplicaLifecycleConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 @dataclass(frozen=True)
@@ -749,7 +778,7 @@ class Config:
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
-                    "disagg", "lifecycle", "slo",
+                    "disagg", "lifecycle", "slo", "fleet",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -764,6 +793,7 @@ class Config:
                         "disagg": DisaggConfig,
                         "lifecycle": ReplicaLifecycleConfig,
                         "slo": SLOConfig,
+                        "fleet": FleetConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

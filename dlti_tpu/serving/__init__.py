@@ -18,6 +18,10 @@ from scratch for TPU:
   fault isolation and retry-capped failover
 * :mod:`dlti_tpu.serving.disagg` — prefill/decode disaggregation: split
   engine pools with paged-KV handoff and phase-aware routing
+* :mod:`dlti_tpu.serving.wire` / :mod:`dlti_tpu.serving.worker` /
+  :mod:`dlti_tpu.serving.fleet` — multi-process fleet: length-prefixed
+  digest-verified TCP protocol, engine worker processes, and a
+  spawning/healing supervisor behind the ReplicatedEngine facade
 * :mod:`dlti_tpu.serving.server` — OpenAI-compatible HTTP server
 """
 
@@ -32,6 +36,10 @@ from dlti_tpu.serving.engine import (  # noqa: F401
 )
 from dlti_tpu.serving.replicas import ReplicatedEngine  # noqa: F401
 from dlti_tpu.serving.disagg import DisaggController  # noqa: F401
+from dlti_tpu.serving.fleet import (  # noqa: F401
+    FleetSupervisor,
+    make_subprocess_spawner,
+)
 from dlti_tpu.serving.gateway import (  # noqa: F401
     AdmissionError,
     AdmissionGateway,

@@ -1,0 +1,1216 @@
+"""Multi-process serving fleet: a supervisor over N engine worker processes.
+
+PRs 12–15 built disaggregation, self-healing, KV migration and rolling
+reloads as a same-process simulation (``ReplicatedEngine`` "replicas"
+share a GIL, a host, and a failure domain). This module is the real
+distribution layer: :class:`FleetSupervisor` spawns N
+``scripts/engine_worker.py`` processes, drives them over the TCP wire
+protocol (``serving.wire``), and presents the exact
+``ReplicatedEngine``-compatible facade (submit / step / generate / stats
+/ failover / affinity / lifecycle) the gateway and HTTP server already
+speak — ``serve.py --fleet-workers N`` serves multi-process traffic with
+no changes above this layer.
+
+Design constraints inherited from the stack above:
+
+* **Thread safety.** ``AsyncEngine.submit`` runs concurrently with
+  ``step()`` (submit holds the server lock; the stepper thread does not),
+  and the engine contract is that ``submit`` must be GIL-atomic. So
+  :meth:`FleetSupervisor.submit` does NO socket I/O — it appends the
+  mirror request to a local deque; the stepper thread dispatches it over
+  the wire at the next :meth:`step`. Every socket lives on the stepper
+  thread (plus the constructor and ``close()``, which run before/after
+  the stepper exists).
+
+* **Mirror requests.** The supervisor keeps a host-side mirror
+  ``Request`` per in-flight client request; FT_STEP replies carry
+  per-request token/logprob deltas which are appended to the mirrors, so
+  ``AsyncEngine._drain_events`` (which walks ``slots`` + ``finished``)
+  streams tokens unchanged. Failover resubmits and drain fallbacks are
+  serialized FROM the mirror — it always holds everything streamed so
+  far.
+
+* **Self-healing = respawn.** Where ``ReplicatedEngine`` rebuilds a
+  quarantined replica's engine in place, the fleet's unit of healing is
+  the process: a faulted/SIGKILL'd worker is killed, its in-flight work
+  failed over to survivors, and a replacement process spawned after an
+  exponential backoff (the elastic launcher's heartbeat/respawn pattern).
+  The replacement is canary-gated through the PR 15 lifecycle state
+  machine before taking dispatch, exactly like an in-process reinstate.
+
+Byte-identity with the single-process engine holds because every worker
+builds identical weights from the shared spec (PRNGKey(0) preset init or
+the same exported checkpoint), per-request sampling is batch-composition
+independent, and cross-process migration ships the ``export_handoff``
+snapshot as a verbatim binary envelope (``wire.pack_handoff``) — the
+adopting process continues the rng stream byte-exactly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from types import SimpleNamespace
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+from dlti_tpu.config import FleetConfig, ReplicaLifecycleConfig
+from dlti_tpu.serving import wire
+from dlti_tpu.serving.engine import (
+    EngineConfig, GenerationResult, Request, SamplingParams,
+)
+from dlti_tpu.serving.lifecycle import ReplicaLifecycle, canary_digest
+from dlti_tpu.telemetry import RequestTelemetry
+from dlti_tpu.telemetry.registry import Counter, Gauge
+from dlti_tpu.utils import durable_io
+from dlti_tpu.utils.logging import get_logger
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+FLEET_METRIC_NAMES = (
+    "dlti_fleet_workers_alive",
+    "dlti_fleet_respawns_total",
+)
+workers_alive_gauge = Gauge(
+    FLEET_METRIC_NAMES[0],
+    help="fleet worker processes currently live (taking dispatch)")
+respawns_total = Counter(
+    FLEET_METRIC_NAMES[1],
+    help="worker processes respawned after a fault or kill")
+
+# Per-worker federated series exposed through fleet_scalars() as
+# dlti_fleet_w{idx}_{key}: the counter keys must sum across workers to
+# the fleet-level dlti_{key} totals (loadgen asserts this), the gauge
+# keys are point-in-time per-process state.
+WORKER_COUNTER_KEYS = ("requests", "generated_tokens", "prefill_tokens",
+                       "preemptions", "decode_steps")
+WORKER_GAUGE_KEYS = ("up", "active", "waiting", "free_blocks")
+
+
+class _WorkerHandle:
+    """Supervisor-side bookkeeping for one worker process + connection.
+
+    Doubles as the ``live_engines()`` element the gateway's headroom
+    arithmetic reads (``cfg.max_seqs - num_active - len(waiting)``), so
+    it exposes ``cfg`` / ``num_active`` / ``waiting`` with the last
+    reported gauges.
+    """
+
+    def __init__(self, idx: int, cfg: EngineConfig, fleet_cfg: FleetConfig):
+        self.idx = idx
+        self.cfg = cfg
+        self.generation = 0
+        self.handle = None           # spawner handle (process)
+        self.sock = None             # connected wire socket
+        self.pid: Optional[int] = None
+        self.owned: Set[str] = set()  # request ids dispatched to this worker
+        # Last reported gauges (FT_STEP / FT_HEALTH replies).
+        self.active = 0
+        self.waiting_count = 0
+        self.free_blocks = 0
+        self.stats: dict = {}        # current process's engine counters
+        self.stats_carry: dict = {}  # accumulated at death: keeps per-worker
+        self.metrics: dict = {}      # totals monotonic across respawns
+        self.last_health = 0.0
+        # Respawn machinery (elastic-launcher pattern).
+        self.restarts_left = fleet_cfg.restart_budget
+        self.backoff = fleet_cfg.respawn_backoff_s
+        self.pending_respawn = False  # waiting out the backoff
+        self.starting = False         # spawned, awaiting port + handshake
+        self.next_respawn_t = 0.0
+        self.spawn_deadline = 0.0
+
+    @property
+    def num_active(self) -> int:
+        return self.active
+
+    @property
+    def waiting(self) -> tuple:
+        # len()-compatible stand-in for the engine's waiting deque.
+        return tuple(range(self.waiting_count))
+
+    def totals(self) -> dict:
+        keys = set(self.stats_carry) | set(self.stats)
+        return {k: self.stats_carry.get(k, 0) + self.stats.get(k, 0)
+                for k in keys}
+
+
+class _SubprocessHandle:
+    """One spawned engine-worker process + its port file."""
+
+    def __init__(self, proc: subprocess.Popen, port_file: str,
+                 generation: int):
+        self.proc = proc
+        self.pid = proc.pid
+        self._port_file = port_file
+        self._generation = generation
+
+    def port(self) -> Optional[int]:
+        """The worker's published port once it is ready to serve (the
+        port file is written atomically AFTER engine build + warmup, and
+        carries the generation so a stale file from the previous
+        incarnation is never trusted)."""
+        try:
+            with open(self._port_file, encoding="utf-8") as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if info.get("generation") != self._generation:
+            return None
+        return int(info["port"])
+
+    def poll(self):
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        return self.proc.wait(timeout=timeout)
+
+    def terminate(self) -> None:
+        self.proc.terminate()
+
+    def kill(self) -> None:
+        self.proc.kill()
+
+
+def make_subprocess_spawner(spec: dict, runtime_dir: str, *,
+                            host: str = "127.0.0.1",
+                            python: str = sys.executable,
+                            extra_env: Optional[dict] = None,
+                            ) -> Callable[[int, int], _SubprocessHandle]:
+    """Build the default spawner: launches ``scripts/engine_worker.py``
+    with the shared build ``spec`` (written once to ``runtime_dir``) and
+    a per-(worker, generation) port file. Worker stdout/stderr go to
+    per-incarnation log files in ``runtime_dir``. The spawner signature
+    ``(idx, generation) -> handle`` is also the test seam — unit tests
+    inject thread-based fakes instead of real processes."""
+    os.makedirs(runtime_dir, exist_ok=True)
+    spec_path = os.path.join(runtime_dir, "worker_spec.json")
+    durable_io.write_json_atomic(spec_path, spec, path_class="fleet_runtime")
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "..", "scripts", "engine_worker.py")
+    script = os.path.abspath(script)
+
+    def spawn(idx: int, generation: int) -> _SubprocessHandle:
+        port_file = os.path.join(runtime_dir,
+                                 f"worker{idx}.g{generation}.port")
+        try:
+            os.unlink(port_file)
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env["DLTI_PROCESS_ID"] = str(idx)
+        env["DLTI_GENERATION"] = str(generation)
+        if extra_env:
+            env.update(extra_env)
+        log_path = os.path.join(runtime_dir, f"worker{idx}.g{generation}.log")
+        log_f = open(log_path, "ab")  # noqa: SIM115 — outlives this scope
+        proc = subprocess.Popen(
+            [python, script, "--spec", spec_path, "--host", host,
+             "--port-file", port_file, "--worker-id", str(idx),
+             "--generation", str(generation)],
+            stdout=log_f, stderr=subprocess.STDOUT, env=env)
+        log_f.close()  # the child holds its own fd
+        return _SubprocessHandle(proc, port_file, generation)
+
+    return spawn
+
+
+class FleetSupervisor:
+    """N worker processes behind a ``ReplicatedEngine``-compatible facade.
+
+    ``engine_cfg`` is the config every worker runs (used locally only for
+    headroom arithmetic — the workers build their engines from the
+    spawner's spec, which must agree). ``spawner(idx, generation)``
+    launches one worker process; :func:`make_subprocess_spawner` is the
+    real one, tests inject fakes.
+    """
+
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        *,
+        workers: int = 2,
+        spawner: Callable[[int, int], object],
+        fleet_cfg: Optional[FleetConfig] = None,
+        lifecycle_cfg: Optional[ReplicaLifecycleConfig] = None,
+        max_retries: int = 2,
+        affinity_spill_threshold: int = 4,
+        telemetry: Optional[RequestTelemetry] = None,
+        canary_vocab: int = 32000,
+    ):
+        if workers < 1:
+            raise ValueError(f"workers ({workers}) must be >= 1")
+        self._engine_cfg = engine_cfg
+        self.fleet_cfg = fleet_cfg if fleet_cfg is not None else FleetConfig()
+        self._spawner = spawner
+        self.logger = get_logger()
+        self.telemetry = telemetry if telemetry is not None \
+            else RequestTelemetry()
+        self.max_retries = max_retries
+        self.affinity_spill_threshold = affinity_spill_threshold
+        self.canary_vocab = canary_vocab
+        # Same counter contracts as ReplicatedEngine (gateway metrics
+        # read these names directly off the engine facade).
+        self.failover = {"retries": 0, "replica_faults": 0,
+                         "failover_errors": 0}
+        self.affinity = {"sticky": 0, "spill": 0}
+        self.failover_fallback = None
+        self.lifecycle_cfg = lifecycle_cfg if lifecycle_cfg is not None \
+            else ReplicaLifecycleConfig(enabled=True)
+        self._heal = self.lifecycle_cfg.enabled
+        self.lifecycle = ReplicaLifecycle(self.lifecycle_cfg, workers)
+        self._req_counter = itertools.count()
+        self._rr = 0
+        self._dead: Set[int] = set()
+        self._draining: Set[int] = set()
+        # Client-facing mirrors: request_id -> mirror Request. Pending
+        # submits wait here for the stepper thread to dispatch them
+        # (submit() must not touch sockets — see module docstring).
+        self._mirror: dict = {}
+        self._pending_submits: deque = deque()  # (req, affinity_key)
+        self._cancel_sent: Set[str] = set()
+        self._finished: deque = deque(maxlen=256)
+        self._reload: Optional[dict] = None
+        self._reload_tree = None  # post-reload weights for respawned workers
+        self._canary_digest: Optional[str] = None
+        self._respawns = 0
+        self._closed = False
+
+        self._workers = [_WorkerHandle(i, engine_cfg, self.fleet_cfg)
+                         for i in range(workers)]
+        # Boot: spawn everyone first (engine builds run concurrently in
+        # the children), then handshake each in turn.
+        for w in self._workers:
+            w.handle = self._spawner(w.idx, w.generation)
+            w.starting = True
+            w.spawn_deadline = (time.monotonic()
+                                + self.fleet_cfg.startup_timeout_s)
+        try:
+            for w in self._workers:
+                self._await_ready(w)
+        except Exception:
+            self.close()
+            raise
+        if self._heal:
+            toks = None
+            try:
+                toks = self._wire_canary(self._workers[0])
+            except (wire.WireError, OSError) as e:
+                self.logger.warning("fleet: boot canary rpc failed: %s", e)
+            if toks is not None:
+                self._canary_digest = canary_digest(toks)
+            else:
+                self.logger.warning(
+                    "fleet: canary digest could not be pinned at "
+                    "construction; probes will gate on generation "
+                    "success only")
+        self._update_alive_gauge()
+
+    # -- wire plumbing (stepper thread only) ----------------------------
+    def _rpc(self, w: _WorkerHandle, ftype: int, obj) -> dict:
+        return wire.request_reply(w.sock, ftype, obj,
+                                  max_frame_bytes=self.fleet_cfg
+                                  .max_frame_bytes)
+
+    def _connect(self, w: _WorkerHandle, port: int,
+                 timeout_s: float) -> None:
+        sock = wire.connect_with_retry(self.fleet_cfg.host, port,
+                                       timeout_s=timeout_s)
+        sock.settimeout(self.fleet_cfg.rpc_timeout_s)
+        w.sock = sock
+
+    def _await_ready(self, w: _WorkerHandle) -> None:
+        """Block until ``w``'s process publishes its port and answers a
+        health frame (boot path; respawns use the non-blocking
+        :meth:`_respawn_tick` instead)."""
+        deadline = w.spawn_deadline
+        while True:
+            if w.handle.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {w.idx} exited with code "
+                    f"{w.handle.poll()} before serving")
+            port = w.handle.port()
+            if port is not None:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"fleet worker {w.idx} did not publish a port within "
+                    f"{self.fleet_cfg.startup_timeout_s}s")
+            time.sleep(0.05)
+        self._connect(w, port, max(1.0, deadline - time.monotonic()))
+        reply = self._rpc(w, wire.FT_HEALTH, {})
+        self._apply_health(w, reply)
+        w.starting = False
+        self.logger.info("fleet worker %d (gen %d, pid %s) ready on port %d",
+                         w.idx, w.generation, w.pid, port)
+
+    def _close_sock(self, w: _WorkerHandle) -> None:
+        if w.sock is not None:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+            w.sock = None
+
+    def _kill_proc(self, w: _WorkerHandle) -> None:
+        if w.handle is None:
+            return
+        try:
+            if w.handle.poll() is None:
+                w.handle.kill()
+                w.handle.wait(timeout=5.0)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+    def _carry_stats(self, w: _WorkerHandle) -> None:
+        """Fold the dying process's counters into the carry so per-worker
+        totals stay monotonic across respawns (federation depends on
+        this: the sum over workers must equal what clients saw)."""
+        for k, v in w.stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.stats_carry[k] = w.stats_carry.get(k, 0) + v
+        w.stats = {}
+        w.active = w.waiting_count = w.free_blocks = 0
+
+    def _update_alive_gauge(self) -> None:
+        workers_alive_gauge.set(self.num_live)
+
+    # -- routing & submission -------------------------------------------
+    def _load(self, w: _WorkerHandle) -> int:
+        return len(w.owned)
+
+    def _live_for_dispatch(self) -> List[_WorkerHandle]:
+        return [w for w in self._workers
+                if w.idx not in self._dead and w.idx not in self._draining
+                and w.sock is not None]
+
+    def live_engines(self) -> List[_WorkerHandle]:
+        return self._live_for_dispatch()
+
+    def live_workers(self) -> List[_WorkerHandle]:
+        return self._live_for_dispatch()
+
+    @property
+    def num_live(self) -> int:
+        return len(self._live_for_dispatch())
+
+    def _reviving(self) -> bool:
+        """True while some worker is between death and reinstatement —
+        the window where new work should queue rather than hard-fail."""
+        return any(w.pending_respawn or w.starting for w in self._workers)
+
+    def _sticky_target(self, key: str,
+                       live: List[_WorkerHandle]) -> _WorkerHandle:
+        def score(w: _WorkerHandle) -> bytes:
+            return hashlib.sha256(f"{key}:{w.idx}".encode()).digest()
+
+        return max(live, key=score)
+
+    def submit(self, prompt_token_ids: Sequence[int],
+               params: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None,
+               affinity_key: Optional[str] = None,
+               adapter: str = "") -> Request:
+        """Create the client-facing mirror request and queue it for the
+        stepper thread to dispatch (no socket I/O here — this runs
+        concurrently with step())."""
+        if not self._live_for_dispatch() and not self._reviving():
+            raise RuntimeError("all fleet workers dead; "
+                               "engine cannot accept requests")
+        if params is None:
+            params = SamplingParams()
+        if request_id is None:
+            request_id = f"fleet-req-{next(self._req_counter)}"
+        req = Request(request_id=request_id,
+                      prompt_token_ids=list(prompt_token_ids),
+                      params=params, arrival_time=time.monotonic())
+        req.adapter = adapter
+        self.telemetry.on_submitted(req)
+        self._mirror[request_id] = req
+        self._pending_submits.append((req, affinity_key))
+        return req
+
+    def _route(self, affinity_key: Optional[str],
+               live: List[_WorkerHandle]) -> _WorkerHandle:
+        if affinity_key:
+            sticky = self._sticky_target(affinity_key, live)
+            backlog = self._load(sticky) - sticky.cfg.max_seqs
+            if backlog <= self.affinity_spill_threshold:
+                self.affinity["sticky"] += 1
+                return sticky
+            self.affinity["spill"] += 1
+        order = live[self._rr % len(live):] + live[:self._rr % len(live)]
+        self._rr = (self._rr + 1) % len(live)
+        return min(order, key=self._load)
+
+    def _finish_error(self, req: Request) -> Request:
+        req.finish_reason = "error"
+        req.finish_time = time.monotonic()
+        self.failover["failover_errors"] += 1
+        self.telemetry.on_finished(req)
+        self._mirror.pop(req.request_id, None)
+        self._finished.append(req)
+        return req
+
+    def _dispatch_pending(self) -> List[Request]:
+        errored: List[Request] = []
+        while self._pending_submits:
+            live = self._live_for_dispatch()
+            if not live:
+                if self._reviving():
+                    return errored  # hold the queue for the respawn
+                req, _ = self._pending_submits.popleft()
+                errored.append(self._finish_error(req))
+                continue
+            req, affinity_key = self._pending_submits.popleft()
+            target = self._route(affinity_key, live)
+            desc = wire.request_to_wire(req)
+            dispatched = False
+            while not dispatched:
+                try:
+                    self._rpc(target, wire.FT_SUBMIT,
+                              {"request": desc, "resubmit": False})
+                except (wire.WireError, OSError) as e:
+                    errored.extend(self._fail_worker(target, e))
+                    live = self._live_for_dispatch()
+                    if not live:
+                        if self._reviving():
+                            self._pending_submits.appendleft(
+                                (req, affinity_key))
+                        else:
+                            errored.append(self._finish_error(req))
+                        return errored
+                    target = min(live, key=self._load)
+                    continue
+                dispatched = True
+            target.owned.add(req.request_id)
+            target.waiting_count += 1
+            req.replica = target.idx
+        return errored
+
+    # -- stepping --------------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        return bool(self._mirror) or bool(self._pending_submits)
+
+    def step(self) -> List[Request]:
+        """One supervision round: dispatch queued submits, step every
+        worker holding work (piggybacking cancels and collecting token
+        deltas), heartbeat idle workers, then run one lifecycle action
+        (reload roll / respawn / probe). Worker faults never escape —
+        they fail over exactly like a replica fault."""
+        finished: List[Request] = []
+        finished.extend(self._dispatch_pending())
+        now = time.monotonic()
+        for w in self._workers:
+            if w.sock is None or w.idx in self._dead:
+                continue
+            try:
+                if w.owned:
+                    cancels = [rid for rid in w.owned
+                               if rid in self._mirror
+                               and self._mirror[rid].cancel_requested
+                               and rid not in self._cancel_sent]
+                    reply = self._rpc(w, wire.FT_STEP, {"cancels": cancels})
+                    self._cancel_sent.update(cancels)
+                    w.last_health = now
+                    finished.extend(self._apply_step_reply(w, reply))
+                elif now - w.last_health >= self.fleet_cfg.health_interval_s:
+                    self._apply_health(w, self._rpc(w, wire.FT_HEALTH, {}))
+            except (wire.WireError, OSError) as e:
+                finished.extend(self._fail_worker(w, e))
+        self._lifecycle_tick()
+        return finished
+
+    def _apply_gauges(self, w: _WorkerHandle, reply: dict) -> None:
+        w.active = int(reply.get("active", w.active))
+        w.waiting_count = int(reply.get("waiting", w.waiting_count))
+        w.free_blocks = int(reply.get("free_blocks", w.free_blocks))
+        if isinstance(reply.get("stats"), dict):
+            w.stats = reply["stats"]
+
+    def _apply_health(self, w: _WorkerHandle, reply: dict) -> None:
+        self._apply_gauges(w, reply)
+        w.pid = reply.get("pid", w.pid)
+        if isinstance(reply.get("metrics"), dict):
+            w.metrics = reply["metrics"]
+        w.last_health = time.monotonic()
+
+    def _apply_step_reply(self, w: _WorkerHandle,
+                          reply: dict) -> List[Request]:
+        self._apply_gauges(w, reply)
+        finished: List[Request] = []
+        now = time.monotonic()
+        for ev in reply.get("events") or ():
+            rid = ev["id"]
+            req = self._mirror.get(rid)
+            if req is None:
+                # Canary traffic, or a request already errored out by a
+                # racing failover — nothing to mirror.
+                w.owned.discard(rid)
+                continue
+            if ev["tokens"]:
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    self.telemetry.on_first_token(req)
+                req.output_token_ids.extend(ev["tokens"])
+                req.output_logprobs.extend(ev["logprobs"])
+            req.num_preemptions = ev.get("preemptions",
+                                         req.num_preemptions)
+            if "finish_reason" in ev:
+                req.finish_reason = ev["finish_reason"]
+                req.finish_time = now
+                self.telemetry.on_finished(req)
+                self._mirror.pop(rid, None)
+                self._cancel_sent.discard(rid)
+                w.owned.discard(rid)
+                self._finished.append(req)
+                finished.append(req)
+        return finished
+
+    # -- failure handling -------------------------------------------------
+    def _fail_worker(self, w: _WorkerHandle, exc: Exception) -> List[Request]:
+        """A worker's process or connection died (or spoke garbage): mark
+        it dead, fail its in-flight work over to survivors, and — with
+        healing on and restart budget left — schedule a respawn."""
+        if w.idx in self._dead and w.sock is None:
+            return []  # already torn down (re-entry via nested failover)
+        self._dead.add(w.idx)
+        self._draining.discard(w.idx)
+        self.failover["replica_faults"] += 1
+        if self._heal:
+            self.lifecycle.on_fault(w.idx)
+        else:
+            self.lifecycle.mark_dead(w.idx)
+        from dlti_tpu.telemetry import get_recorder
+
+        rec = get_recorder()
+        if rec is not None:
+            rec.dump(reason="worker_fault", exc=exc, force=True,
+                     extra={"worker": w.idx, "generation": w.generation,
+                            "pid": w.pid, "in_flight": len(w.owned),
+                            "survivors": self.num_live})
+        self.logger.error(
+            "fleet worker %d (gen %d, pid %s) failed (%s: %s); failing "
+            "over %d request(s) to %d survivor(s)", w.idx, w.generation,
+            w.pid, type(exc).__name__, exc, len(w.owned), self.num_live)
+        self._carry_stats(w)
+        self._close_sock(w)
+        self._kill_proc(w)
+        stranded = [self._mirror[rid] for rid in sorted(w.owned)
+                    if rid in self._mirror]
+        w.owned.clear()
+        errored: List[Request] = []
+        for req in stranded:
+            errored.extend(self._rehome(req, kind="failover"))
+        if (self._heal and self.lifecycle.state(w.idx) != "evicted"
+                and w.restarts_left > 0):
+            w.restarts_left -= 1
+            w.pending_respawn = True
+            w.next_respawn_t = time.monotonic() + w.backoff
+            self.logger.warning(
+                "fleet worker %d respawn scheduled in %.1fs "
+                "(%d restart(s) left)", w.idx, w.backoff, w.restarts_left)
+            w.backoff = min(w.backoff * 2,
+                            self.fleet_cfg.respawn_backoff_max_s)
+        else:
+            self.lifecycle.mark_dead(w.idx)
+        self._update_alive_gauge()
+        return errored
+
+    def _rehome(self, req: Request, *, kind: str) -> List[Request]:
+        """Failover-style resubmit of one mirror request onto a survivor
+        (recompute-on-readmit from the mirror's tokens); errors it out
+        past the retry cap or with no survivors. Returns the request iff
+        it errored."""
+        from dlti_tpu.telemetry.ledger import note_requeue
+
+        while True:
+            live = self._live_for_dispatch()
+            if not live and self._reviving() \
+                    and req.num_retries < self.max_retries:
+                # Total-outage window with a respawn pending: requeue as
+                # a pending submit rather than erroring the request.
+                req.num_retries += 1
+                self.failover["retries"] += 1
+                note_requeue(req, kind)
+                self._pending_submits.append((req, None))
+                return []
+            if not live or req.num_retries >= self.max_retries:
+                if (not live and req.num_retries < self.max_retries
+                        and self.failover_fallback is not None):
+                    note_requeue(req, kind)
+                    if self.failover_fallback(req):
+                        req.num_retries += 1
+                        self.failover["retries"] += 1
+                        return []
+                return [self._finish_error(req)]
+            req.num_retries += 1
+            self.failover["retries"] += 1
+            note_requeue(req, kind)
+            target = min(live, key=self._load)
+            try:
+                self._rpc(target, wire.FT_SUBMIT,
+                          {"request": wire.request_to_wire(req),
+                           "resubmit": True})
+            except (wire.WireError, OSError) as e:
+                self._fail_worker(target, e)
+                continue
+            target.owned.add(req.request_id)
+            target.waiting_count += 1
+            req.replica = target.idx
+            return []
+
+    # -- drain / migration ------------------------------------------------
+    def drain_replica(self, idx: int, *, kind: str = "preempt",
+                      quarantine: bool = True) -> List[Request]:
+        """Planned drain of one worker: its in-flight decodes migrate to
+        survivors as verbatim handoff envelopes (FT_DRAIN exports them,
+        FT_ADOPT hands the SAME bytes to the adopter — byte-exact
+        continuation), with failover-resubmit fallback; queued and
+        mid-prefill work resubmits from the mirror. With ``quarantine``
+        the worker then enters the lifecycle (its process stays up; a
+        canary probe over the live connection reinstates it)."""
+        w = self._workers[idx]
+        if w.sock is None:
+            return []
+        self.lifecycle.begin_drain(idx)
+        self._dead.add(idx)
+        self._draining.discard(idx)
+        try:
+            reply = self._rpc(w, wire.FT_DRAIN, {})
+        except (wire.WireError, OSError) as e:
+            self._dead.discard(idx)  # let _fail_worker do full accounting
+            return self._fail_worker(w, e)
+        from dlti_tpu.telemetry.ledger import note_requeue
+
+        migrated = fallbacks = 0
+        errored: List[Request] = []
+        for env in reply.get("handoffs") or ():
+            try:
+                rid = wire.unpack_handoff(env)["request"].request_id
+            except wire.WireError:
+                continue  # worker-side bug; nothing safe to do with it
+            req = self._mirror.get(rid)
+            w.owned.discard(rid)
+            if req is not None:
+                note_requeue(req, kind)
+            adopted = False
+            for target in sorted(self._live_for_dispatch(), key=self._load):
+                try:
+                    r = self._rpc(target, wire.FT_ADOPT, {"envelope": env})
+                except (wire.WireError, OSError) as e:
+                    self._fail_worker(target, e)
+                    continue
+                if r.get("adopted"):
+                    adopted = True
+                    migrated += 1
+                    target.owned.add(rid)
+                    if req is not None:
+                        req.num_migrations += 1
+                        req.replica = target.idx
+                    break
+            if not adopted:
+                fallbacks += 1
+                if req is not None:
+                    errored.extend(self._rehome(req, kind=kind))
+        for desc in reply.get("resubmits") or ():
+            rid = desc.get("request_id")
+            req = self._mirror.get(rid)
+            w.owned.discard(rid)
+            if req is not None:
+                errored.extend(self._rehome(req, kind=kind))
+        w.owned.clear()
+        w.active = w.waiting_count = 0
+        if migrated:
+            self.lifecycle.note_migration(migrated)
+        if fallbacks:
+            self.lifecycle.note_migration_fallback(fallbacks)
+        self.logger.warning(
+            "fleet worker %d drained (%s): %d decode(s) migrated via KV "
+            "handoff envelope, %d fallback(s), %d errored", idx, kind,
+            migrated, fallbacks, len(errored))
+        if quarantine:
+            if self._heal:
+                self.lifecycle.on_fault(idx)
+            else:
+                self.lifecycle.mark_dead(idx)
+        self._update_alive_gauge()
+        return errored
+
+    # -- canary / probe / respawn ----------------------------------------
+    def _wire_canary(self, w: _WorkerHandle) -> Optional[List[int]]:
+        """Short greedy canary generation driven over the wire (only on a
+        worker carrying no client traffic). Returns token ids, or None
+        when generation itself fails; wire errors propagate — the caller
+        decides between reschedule and failover."""
+        cfg = self.lifecycle_cfg
+        vocab = max(2, self.canary_vocab)
+        prompt = [(i % min(97, vocab - 1)) + 1
+                  for i in range(max(1, cfg.canary_prompt_tokens))]
+        sp = SamplingParams(temperature=0.0,
+                            max_tokens=max(1, cfg.canary_max_tokens))
+        rid = f"fleet-canary-{next(self._req_counter)}"
+        req = Request(request_id=rid, prompt_token_ids=prompt, params=sp,
+                      arrival_time=time.monotonic())
+        self._rpc(w, wire.FT_SUBMIT,
+                  {"request": wire.request_to_wire(req), "resubmit": False})
+        toks: List[int] = []
+        for _ in range(1000):
+            reply = self._rpc(w, wire.FT_STEP, {"cancels": []})
+            for ev in reply.get("events") or ():
+                if ev["id"] != rid:
+                    continue
+                toks.extend(ev["tokens"])
+                if "finish_reason" in ev:
+                    if ev["finish_reason"] == "error":
+                        return None
+                    return toks
+            if not reply.get("has_work"):
+                # Engine went idle without finishing the canary: verdict.
+                return None
+        return None
+
+    def _canary_ok(self, w: _WorkerHandle,
+                   digest: Optional[str]) -> bool:
+        toks = self._wire_canary(w)
+        return toks is not None and (digest is None
+                                     or canary_digest(toks) == digest)
+
+    def _probe_worker(self, w: _WorkerHandle) -> None:
+        """Probation elapsed for a drained-but-alive worker: canary over
+        the existing connection gates reinstatement."""
+        self.lifecycle.begin_probe(w.idx)
+        try:
+            ok = self._canary_ok(w, self._canary_digest)
+        except (wire.WireError, OSError) as e:
+            # The idle process died under quarantine — full failover
+            # accounting (it owns nothing, so this just schedules the
+            # respawn).
+            self._fail_worker(w, e)
+            return
+        if self.lifecycle.on_probe_result(w.idx, ok) == "live":
+            self._dead.discard(w.idx)
+            self._update_alive_gauge()
+
+    def _respawn_tick(self, now: float) -> None:
+        for w in self._workers:
+            if w.starting:
+                self._poll_starting(w, now)
+            elif w.pending_respawn and now >= w.next_respawn_t:
+                self._launch_respawn(w, now)
+
+    def _launch_respawn(self, w: _WorkerHandle, now: float) -> None:
+        w.pending_respawn = False
+        w.generation += 1
+        try:
+            w.handle = self._spawner(w.idx, w.generation)
+        except Exception as e:  # noqa: BLE001 — spawner failure reschedules
+            self.logger.error("fleet worker %d respawn spawn failed: %s",
+                              w.idx, e)
+            self._reschedule_or_evict(w, now)
+            return
+        w.starting = True
+        w.spawn_deadline = now + self.fleet_cfg.startup_timeout_s
+        self.logger.info("fleet worker %d respawning (gen %d, pid %s)",
+                         w.idx, w.generation, w.handle.pid)
+
+    def _reschedule_or_evict(self, w: _WorkerHandle, now: float) -> None:
+        w.starting = False
+        self._close_sock(w)
+        self._kill_proc(w)
+        if w.restarts_left > 0 and self.lifecycle.state(w.idx) != "evicted":
+            w.restarts_left -= 1
+            w.pending_respawn = True
+            w.next_respawn_t = now + w.backoff
+            w.backoff = min(w.backoff * 2,
+                            self.fleet_cfg.respawn_backoff_max_s)
+            return
+        self.lifecycle.evict(w.idx)
+        self.logger.error("fleet worker %d evicted: restart budget "
+                          "exhausted", w.idx)
+
+    def _poll_starting(self, w: _WorkerHandle, now: float) -> None:
+        """Non-blocking respawn progression: exit/timeout reschedules;
+        a published port leads to connect → (optional reload) → canary →
+        reinstate through the lifecycle machine."""
+        if w.handle.poll() is not None:
+            self.logger.error(
+                "fleet worker %d (gen %d) exited with code %s during "
+                "startup", w.idx, w.generation, w.handle.poll())
+            self._reschedule_or_evict(w, now)
+            return
+        if now > w.spawn_deadline:
+            self.logger.error("fleet worker %d (gen %d) startup timed out",
+                              w.idx, w.generation)
+            self._reschedule_or_evict(w, now)
+            return
+        port = w.handle.port()
+        if port is None:
+            return  # still building its engine
+        try:
+            self._connect(w, port, timeout_s=5.0)
+            self._apply_health(w, self._rpc(w, wire.FT_HEALTH, {}))
+            if self._reload_tree is not None:
+                # The fleet completed a rolling reload after this spec
+                # was written: bring the replacement onto the current
+                # weights before the canary judges it.
+                self._rpc(w, wire.FT_RELOAD, {"params": self._reload_tree})
+            if self._heal:
+                self.lifecycle.begin_probe(w.idx)
+                ok = self._canary_ok(w, self._canary_digest)
+                if self.lifecycle.on_probe_result(w.idx, ok) != "live":
+                    self.logger.error(
+                        "fleet worker %d (gen %d) respawn canary failed",
+                        w.idx, w.generation)
+                    self._reschedule_or_evict(w, now)
+                    return
+        except (wire.WireError, OSError) as e:
+            self.logger.error(
+                "fleet worker %d (gen %d) respawn handshake failed: %s",
+                w.idx, w.generation, e)
+            self._reschedule_or_evict(w, now)
+            return
+        w.starting = False
+        w.backoff = self.fleet_cfg.respawn_backoff_s
+        w.pid = w.handle.pid
+        self._dead.discard(w.idx)
+        self._respawns += 1
+        respawns_total.inc()
+        self._update_alive_gauge()
+        self.logger.warning(
+            "fleet worker %d respawned (gen %d, pid %s) and reinstated",
+            w.idx, w.generation, w.pid)
+
+    # -- rolling reload ----------------------------------------------------
+    def request_reload(self, weights_provider) -> bool:
+        """Enqueue a rolling weight reload (thread-safe: one GIL-atomic
+        attribute write; the roll runs on the stepper thread). The
+        provider must return a host param tree; it is converted to plain
+        numpy dicts and shipped to each worker over FT_RELOAD after a
+        drain-via-migration. Returns False if a roll is in progress."""
+        if self._reload is not None:
+            return False
+        self._reload = {"provider": weights_provider, "tree": None,
+                        "queue": None, "digest": None}
+        return True
+
+    @staticmethod
+    def _tree_to_wire(tree):
+        """Host param tree -> nested plain dicts of numpy arrays (the
+        only tree shape the wire serializer carries)."""
+        import numpy as np
+
+        if hasattr(tree, "items"):
+            return {str(k): FleetSupervisor._tree_to_wire(v)
+                    for k, v in tree.items()}
+        import jax
+
+        return np.asarray(jax.device_get(tree))
+
+    def _reload_tick(self) -> None:
+        """One rolling-reload action per step: drain one worker via KV
+        migration, swap its weights over the wire, canary, reinstate.
+        The first upgraded worker pins the new digest with a determinism
+        double-run; a canary failure aborts the roll (that worker is
+        killed and respawns onto the OLD weights — the fleet stays
+        consistent)."""
+        st = self._reload
+        if st["tree"] is None:
+            try:
+                st["tree"] = self._tree_to_wire(st["provider"]())
+            except Exception as e:  # noqa: BLE001 — bad checkpoint aborts
+                self.logger.error(
+                    "fleet rolling reload aborted: weights provider "
+                    "failed: %s", e)
+                self._reload = None
+                return
+            st["queue"] = [w.idx for w in self._workers
+                           if self.lifecycle.state(w.idx) != "evicted"
+                           and not w.pending_respawn and not w.starting
+                           and w.sock is not None]
+            self.logger.info("fleet rolling reload: %d worker(s) queued",
+                             len(st["queue"]))
+        if not st["queue"]:
+            if st["digest"] is not None:
+                self._canary_digest = st["digest"]
+            self._reload_tree = st["tree"]
+            self._reload = None
+            self.logger.info("fleet rolling reload complete")
+            return
+        idx = st["queue"][0]
+        w = self._workers[idx]
+        others = [v for v in self._live_for_dispatch() if v.idx != idx]
+        if others:
+            self.drain_replica(idx, kind="reload", quarantine=False)
+        else:
+            # Sole live worker: lame-duck it (stop dispatch, keep
+            # stepping) until its in-flight work finishes; the gateway
+            # queues/sheds meanwhile.
+            if idx not in self._draining and idx not in self._dead:
+                self.lifecycle.begin_drain(idx)
+                self._draining.add(idx)
+            if w.owned:
+                return
+            self._draining.discard(idx)
+            self._dead.add(idx)
+        ok = False
+        try:
+            self._rpc(w, wire.FT_RELOAD, {"params": st["tree"]})
+            toks = self._wire_canary(w)
+            ok = toks is not None
+            if ok and st["digest"] is None:
+                # First worker on the new weights: gate on determinism
+                # (two identical greedy runs) and pin the roll's digest.
+                ok = self._wire_canary(w) == toks
+                if ok:
+                    st["digest"] = canary_digest(toks)
+            elif ok:
+                ok = canary_digest(toks) == st["digest"]
+        except (wire.WireError, OSError) as e:
+            self.logger.error("fleet worker %d reload rpc failed: %s",
+                              idx, e)
+            st["queue"].pop(0)
+            self._reload = None
+            self._dead.discard(idx)
+            self._fail_worker(w, e)
+            return
+        st["queue"].pop(0)
+        if self.lifecycle.on_probe_result(idx, ok) == "live":
+            self._dead.discard(idx)
+            self._update_alive_gauge()
+        if not ok:
+            self.logger.error(
+                "fleet rolling reload aborted: worker %d failed canary on "
+                "new weights; fleet stays on previous weights", idx)
+            self._reload = None
+            # The inconsistent worker is torn down; it respawns onto the
+            # boot/previous weights and canaries back in.
+            self._dead.discard(idx)
+            self._fail_worker(w, RuntimeError("reload canary failed"))
+
+    # -- lifecycle tick ----------------------------------------------------
+    def _lifecycle_tick(self) -> None:
+        if self._reload is not None:
+            self._reload_tick()
+            return
+        if not self._heal:
+            return
+        now = time.monotonic()
+        self._respawn_tick(now)
+        for idx in self.lifecycle.due_probes():
+            w = self._workers[idx]
+            if (w.sock is None or w.pending_respawn or w.starting):
+                continue  # the respawn path owns this worker
+            self._probe_worker(w)
+            break  # at most one heavy action per tick
+
+    @property
+    def lifecycle_pending(self) -> bool:
+        """True when the stepper must keep ticking without client work:
+        queued submits, a rolling reload, a pending/in-flight respawn, or
+        a quarantined worker awaiting its probe."""
+        if self._pending_submits or self._reload is not None:
+            return True
+        if any(w.pending_respawn or w.starting for w in self._workers):
+            return True
+        if not self._heal:
+            return False
+        return any(s in ("quarantined", "probing")
+                   for s in self.lifecycle.states().values())
+
+    def lifecycle_counts(self) -> dict:
+        c = self.lifecycle.counts()
+        return {"live": c["live"],
+                "quarantined": c["quarantined"] + c["probing"],
+                "draining": c["draining"],
+                "dead": c["evicted"]}
+
+    def worker_states(self) -> dict:
+        """Per-worker liveness for /health: the lifecycle state with the
+        respawn machinery overlaid (``respawning`` = a replacement
+        process is scheduled or starting; ``dead`` = evicted/budget
+        exhausted)."""
+        out = {}
+        for w in self._workers:
+            s = self.lifecycle.state(w.idx)
+            if s == "evicted":
+                label = "dead"
+            elif w.pending_respawn or w.starting:
+                label = "respawning"
+            elif s in ("quarantined", "probing"):
+                label = "quarantined"
+            elif s == "draining" or w.idx in self._draining:
+                label = "draining"
+            else:
+                label = "live"
+            out[str(w.idx)] = label
+        return out
+
+    @property
+    def respawn_retry_after_s(self) -> float:
+        """Backoff-derived Retry-After hint: how long until the next
+        scheduled respawn attempt (0 when none is pending — a starting
+        worker is imminent, so advertise a short wait)."""
+        now = time.monotonic()
+        pending = [w.next_respawn_t - now for w in self._workers
+                   if w.pending_respawn]
+        if pending:
+            return max(0.0, min(pending))
+        if any(w.starting for w in self._workers):
+            return 1.0
+        return 0.0
+
+    # -- metrics federation ------------------------------------------------
+    def fleet_scalars(self) -> dict:
+        """Flat snapshot for the server registry (the ``pool_scalars``
+        pattern): fleet-level gauges plus per-worker federated series
+        (``fleet_w{i}_{key}``) whose counter keys sum to the fleet
+        totals — the equality loadgen's federation check asserts."""
+        out = {"fleet_workers": float(len(self._workers)),
+               "fleet_workers_live": float(self.num_live),
+               "fleet_respawns": float(self._respawns)}
+        for w in self._workers:
+            totals = w.totals()
+            for k in WORKER_COUNTER_KEYS:
+                out[f"fleet_w{w.idx}_{k}"] = totals.get(k, 0)
+            out[f"fleet_w{w.idx}_up"] = float(
+                w.idx not in self._dead and w.sock is not None)
+            out[f"fleet_w{w.idx}_active"] = float(w.active)
+            out[f"fleet_w{w.idx}_waiting"] = float(w.waiting_count)
+            out[f"fleet_w{w.idx}_free_blocks"] = float(w.free_blocks)
+        return out
+
+    @property
+    def fleet_gauge_keys(self) -> tuple:
+        keys = ["fleet_workers", "fleet_workers_live"]
+        for w in self._workers:
+            keys.extend(f"fleet_w{w.idx}_{k}" for k in WORKER_GAUGE_KEYS)
+        return tuple(keys)
+
+    # -- InferenceEngine-compat surface ------------------------------------
+    @property
+    def cfg(self) -> EngineConfig:
+        return self._engine_cfg
+
+    @property
+    def slots(self) -> list:
+        # Mirror requests presented slot-shaped for AsyncEngine's event
+        # drain (it only reads slot.request).
+        return [SimpleNamespace(request=r) for r in self._mirror.values()]
+
+    @property
+    def finished(self) -> List[Request]:
+        return list(self._finished)
+
+    @property
+    def waiting(self) -> List[Request]:
+        return [req for req, _ in self._pending_submits]
+
+    @property
+    def num_active(self) -> int:
+        return sum(w.active for w in self._live_for_dispatch())
+
+    @property
+    def num_free_blocks(self) -> int:
+        return sum(w.free_blocks for w in self._live_for_dispatch())
+
+    def abort_all(self, reason: str = "abort") -> List[Request]:
+        for w in self._workers:
+            if w.sock is None:
+                continue
+            try:
+                reply = self._rpc(w, wire.FT_ABORT, {"reason": reason})
+                self._apply_gauges(w, reply)
+            except (wire.WireError, OSError) as e:
+                self._fail_worker(w, e)
+            w.owned.clear()
+        aborted: List[Request] = []
+        self._pending_submits.clear()  # pending reqs are mirrored too
+        now = time.monotonic()
+        for req in list(self._mirror.values()):
+            if req.done:
+                continue
+            req.finish_reason = reason
+            req.finish_time = now
+            self.telemetry.on_finished(req)
+            self._finished.append(req)
+            aborted.append(req)
+        self._mirror.clear()
+        self._cancel_sent.clear()
+        return aborted
+
+    @property
+    def stats(self) -> dict:
+        """Aggregated counters across workers, carry-corrected so totals
+        stay monotonic across respawns (per-worker under 'replicas')."""
+        per_worker = [w.totals() for w in self._workers]
+        keys: Set[str] = set()
+        for t in per_worker:
+            keys.update(t)
+        agg = {k: sum(t.get(k, 0) for t in per_worker) for k in keys}
+        agg["replicas"] = per_worker
+        return agg
+
+    def warmup_decode_ladder(self) -> None:
+        # Workers warm their own decode ladders at startup (spec
+        # "warmup"); by construction time they already answered health.
+        return None
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 params: Optional[SamplingParams] = None,
+                 ) -> List[GenerationResult]:
+        reqs = [self.submit(p, params) for p in prompts]
+        while self.has_work:
+            self.step()
+        out = []
+        for r in reqs:
+            out.append(GenerationResult(
+                request_id=r.request_id,
+                prompt_token_ids=r.prompt_token_ids,
+                output_token_ids=r.output_token_ids,
+                output_logprobs=r.output_logprobs,
+                finish_reason=r.finish_reason or "abort",
+                ttft_s=((r.first_token_time or r.arrival_time)
+                        - r.arrival_time),
+                latency_s=((r.finish_time or time.monotonic())
+                           - r.arrival_time),
+            ))
+        return out
+
+    # -- teardown ----------------------------------------------------------
+    def close(self) -> None:
+        """Shut every worker down (clean FT_SHUTDOWN, then the
+        terminate/kill ladder). Safe to call twice; runs on whatever
+        thread owns the supervisor after the stepper stopped."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers:
+            if w.sock is not None:
+                try:
+                    w.sock.settimeout(2.0)
+                    self._rpc(w, wire.FT_SHUTDOWN, {})
+                except (wire.WireError, OSError):
+                    pass
+                self._close_sock(w)
+            if w.handle is None:
+                continue
+            try:
+                if w.handle.poll() is None:
+                    w.handle.terminate()
+                    try:
+                        w.handle.wait(timeout=self.fleet_cfg.term_grace_s)
+                    except Exception:  # noqa: BLE001 — escalate to kill
+                        w.handle.kill()
+                        w.handle.wait(timeout=5.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self._update_alive_gauge()
+
+    shutdown = close

@@ -313,6 +313,15 @@ class AdmissionGateway:
     def draining(self) -> bool:
         return self._draining
 
+    def _retry_after_s(self) -> float:
+        """Static backoff, floored by the fleet supervisor's respawn
+        backoff when one is pending — a client that honors the header
+        retries when capacity is actually expected back, instead of
+        landing on the next refusal."""
+        backoff = getattr(self.async_engine.engine,
+                          "respawn_retry_after_s", 0.0)
+        return max(self.cfg.retry_after_s, backoff)
+
     def adapter_for(self, tenant: str) -> str:
         """The tenant's configured LoRA adapter (``adapter_map``); ""
         routes to the base model. An ``X-Adapter`` header overrides."""
@@ -357,11 +366,11 @@ class AdmissionGateway:
                 # remaining SIGTERM grace window (a retrying client that
                 # honors it lands on the replacement process, not on the
                 # next refusal), floored at the static backoff.
-                retry_after = self.cfg.retry_after_s
+                retry_after = self._retry_after_s()
                 if self._drain_t0 is not None:
                     remaining = self.cfg.drain_grace_s - (
                         time.monotonic() - self._drain_t0)
-                    retry_after = max(self.cfg.retry_after_s, remaining)
+                    retry_after = max(retry_after, remaining)
                 raise AdmissionError(
                     503, "server is draining; not accepting new requests",
                     retry_after=retry_after)
@@ -383,7 +392,7 @@ class AdmissionGateway:
                 raise AdmissionError(
                     429, f"admission queue full "
                          f"({self.cfg.max_queued_requests} requests)",
-                    retry_after=self.cfg.retry_after_s)
+                    retry_after=self._retry_after_s())
             if (self.cfg.max_queued_tokens > 0
                     and self._queued_tokens + n_tokens
                     > self.cfg.max_queued_tokens):
@@ -392,7 +401,7 @@ class AdmissionGateway:
                     429, f"admission queue full "
                          f"({self.cfg.max_queued_tokens} queued prompt "
                          f"tokens)",
-                    retry_after=self.cfg.retry_after_s)
+                    retry_after=self._retry_after_s())
 
             handle = GatewayRequest(request_id, prompt_token_ids, params)
             entry = _Pending(

@@ -190,6 +190,21 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
                                    gauge_keys=_disagg.POOL_GAUGE_KEYS,
                                    prefix="dlti_")
         registry.register(_disagg.handoff_seconds)
+    # Multi-process fleet (serving.fleet): per-worker federated series
+    # (dlti_fleet_w{i}_*) + fleet-level gauges ride in via the
+    # supervisor's fleet_scalars source; the module-level wire-protocol
+    # and respawn counters register alongside.
+    if hasattr(async_engine.engine, "fleet_scalars"):
+        from dlti_tpu.serving import fleet as _fleet
+        from dlti_tpu.serving import wire as _wire
+
+        registry.add_scalar_source(
+            async_engine.engine.fleet_scalars,
+            gauge_keys=tuple(async_engine.engine.fleet_gauge_keys),
+            prefix="dlti_")
+        for metric in (_wire.frames_total, _wire.wire_bytes_total,
+                       _fleet.workers_alive_gauge, _fleet.respawns_total):
+            registry.register(metric)
     return registry
 
 
@@ -594,17 +609,33 @@ class _Handler(BaseHTTPRequestHandler):
             # must read unhealthy so traffic routes elsewhere — 200 here
             # while submits 503 kept corpses in rotation.
             body = {}
-            counts = getattr(self.async_engine.engine,
-                             "lifecycle_counts", None)
+            eng = self.async_engine.engine
+            counts = getattr(eng, "lifecycle_counts", None)
             if counts is not None:
                 # Fleet lifecycle detail: "quarantined" replicas are
                 # healing (probe pending) and expected back; "dead" ones
                 # are gone for good — a balancer weighs them differently.
                 body.update(counts())
+            states = getattr(eng, "worker_states", None)
+            if states is not None:
+                # Multi-process fleet: per-worker liveness
+                # (live/quarantined/draining/respawning/dead).
+                body["workers"] = states()
             if self.async_engine.dead:
                 self._json(503, {"status": "dead", **body})
             elif self.gateway is not None and self.gateway.draining:
                 self._json(503, {"status": "draining", **body})
+            elif states is not None and not any(
+                    s == "live" for s in body["workers"].values()):
+                # No worker live: unhealthy — but a respawn may be
+                # imminent, so advertise its backoff as Retry-After
+                # (a degraded fleet with ANY live worker stays 200).
+                headers = {}
+                ra = getattr(eng, "respawn_retry_after_s", 0.0)
+                if ra > 0:
+                    headers["Retry-After"] = str(max(1, int(-(-ra // 1))))
+                self._json(503, {"status": "no_live_workers", **body},
+                           headers=headers)
             else:
                 self._json(200, {"status": "ok", **body})
         elif self.path == "/stats":

@@ -1,0 +1,449 @@
+"""Fleet wire protocol: length-prefixed, digest-verified frames over TCP.
+
+The multi-process serving fleet (``serving.fleet`` supervisor ↔
+``serving.worker`` engine workers) speaks a deliberately small binary
+protocol so cross-process behavior stays byte-exact and debuggable:
+
+Frame layout (network byte order)::
+
+    +--------+---------+------+-----+-------------+----------+------------+
+    | magic  | version | type | pad | payload_len | payload  | digest     |
+    | 4B     | u16     | u8   | u8  | u32         | N bytes  | 16B sha256 |
+    +--------+---------+------+-----+-------------+----------+------------+
+
+``digest`` is the first 16 bytes of SHA-256 over the payload — a torn or
+bit-flipped frame surfaces as :class:`WireDigestMismatch` instead of a
+corrupted adoption. Every malformed-input case has its own exception type
+so callers can distinguish "peer died mid-frame" (fail the worker over)
+from "peer spoke garbage" (protocol bug / wrong port — evict).
+
+Payloads are encoded with a self-contained tagged binary serializer
+(:func:`pack_obj` / :func:`unpack_obj`) whose numpy encoding round-trips
+dtype + shape + raw bytes exactly — the property the paged-KV handoff
+envelope (:func:`pack_handoff`) needs for byte-identical cross-process
+migration (same guarantee as the in-process ``adopt_handoff`` path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from dlti_tpu.telemetry.registry import Counter
+
+MAGIC = b"DLTW"
+WIRE_VERSION = 1
+# Handoff envelopes carry whole paged-KV payload sets; a 7B-class request
+# stays far under this, and anything larger is a protocol bug, not data.
+DEFAULT_MAX_FRAME = 256 * 1024 * 1024
+
+_HEADER = struct.Struct("!4sHBxI")  # magic, version, frame type, pad, len
+_DIGEST_BYTES = 16
+
+# -- frame types -------------------------------------------------------------
+FT_SUBMIT = 1        # supervisor -> worker: new/failover request descriptor
+FT_STEP = 2          # supervisor -> worker: run one engine step
+FT_STEP_RESULT = 3   # worker -> supervisor: per-request token deltas
+FT_DRAIN = 4         # supervisor -> worker: export handoffs + queued work
+FT_ADOPT = 5         # supervisor -> worker: adopt one handoff envelope
+FT_RELOAD = 6        # supervisor -> worker: swap weights (rolling reload)
+FT_HEALTH = 7        # supervisor -> worker: liveness + metrics snapshot
+FT_ABORT = 8         # supervisor -> worker: abort all in-flight work
+FT_SHUTDOWN = 9      # supervisor -> worker: clean exit
+FT_OK = 10           # worker -> supervisor: success reply (packed object)
+FT_ERROR = 11        # worker -> supervisor: handler failure (message)
+
+FRAME_NAMES = {
+    FT_SUBMIT: "submit", FT_STEP: "step", FT_STEP_RESULT: "step_result",
+    FT_DRAIN: "drain", FT_ADOPT: "adopt", FT_RELOAD: "reload",
+    FT_HEALTH: "health", FT_ABORT: "abort", FT_SHUTDOWN: "shutdown",
+    FT_OK: "ok", FT_ERROR: "error",
+}
+
+WIRE_METRIC_NAMES = (
+    "dlti_fleet_frames_total",
+    "dlti_fleet_wire_bytes_total",
+)
+frames_total = Counter(
+    WIRE_METRIC_NAMES[0],
+    help="fleet wire-protocol frames sent, by frame kind")
+wire_bytes_total = Counter(
+    WIRE_METRIC_NAMES[1],
+    help="fleet wire-protocol bytes sent (headers + payloads + digests)")
+
+
+# -- errors ------------------------------------------------------------------
+class WireError(RuntimeError):
+    """Base for every wire-protocol failure."""
+
+
+class WireClosed(WireError):
+    """Peer closed the connection cleanly at a frame boundary."""
+
+
+class WireTruncated(WireError):
+    """Peer died (or the stream was cut) mid-frame."""
+
+
+class WireBadMagic(WireError):
+    """Stream does not start with the protocol magic — wrong port/peer."""
+
+
+class WireVersionMismatch(WireError):
+    """Frame or envelope written by an incompatible protocol version."""
+
+
+class WireFrameTooLarge(WireError):
+    """Declared payload length exceeds the frame-size bound."""
+
+
+class WireDigestMismatch(WireError):
+    """Payload digest check failed — corrupt or tampered frame."""
+
+
+class WireRemoteError(WireError):
+    """Peer replied with an FT_ERROR frame; message is the remote reason."""
+
+
+# -- frame I/O ---------------------------------------------------------------
+def _recv_exact(sock: socket.socket, n: int, *,
+                at_boundary: bool = False) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (ConnectionResetError, BrokenPipeError) as e:
+            raise WireTruncated(f"connection reset mid-frame: {e}") from e
+        if not chunk:
+            if at_boundary and not buf:
+                raise WireClosed("peer closed the connection")
+            raise WireTruncated(
+                f"peer died mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, ftype: int, payload: bytes = b"") -> None:
+    digest = hashlib.sha256(payload).digest()[:_DIGEST_BYTES]
+    header = _HEADER.pack(MAGIC, WIRE_VERSION, ftype, len(payload))
+    try:
+        sock.sendall(header + payload + digest)
+    except (ConnectionResetError, BrokenPipeError, OSError) as e:
+        raise WireTruncated(f"send failed: {e}") from e
+    frames_total.labels(kind=FRAME_NAMES.get(ftype, str(ftype))).inc()
+    wire_bytes_total.inc(len(header) + len(payload) + _DIGEST_BYTES)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = DEFAULT_MAX_FRAME,
+               ) -> Tuple[int, bytes]:
+    header = _recv_exact(sock, _HEADER.size, at_boundary=True)
+    magic, version, ftype, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise WireBadMagic(f"bad magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise WireVersionMismatch(
+            f"peer speaks wire version {version}, this side {WIRE_VERSION}")
+    if length > max_frame_bytes:
+        raise WireFrameTooLarge(
+            f"declared payload {length}B exceeds bound {max_frame_bytes}B")
+    payload = _recv_exact(sock, length)
+    digest = _recv_exact(sock, _DIGEST_BYTES)
+    if hashlib.sha256(payload).digest()[:_DIGEST_BYTES] != digest:
+        raise WireDigestMismatch(
+            f"payload digest mismatch on {FRAME_NAMES.get(ftype, ftype)} "
+            f"frame ({length}B)")
+    return ftype, payload
+
+
+def request_reply(sock: socket.socket, ftype: int, obj: Any = None, *,
+                  max_frame_bytes: int = DEFAULT_MAX_FRAME) -> Any:
+    """One strict request/response round trip: send ``obj``, return the
+    FT_OK reply object; an FT_ERROR reply raises :class:`WireRemoteError`
+    (the handler failed remotely, the connection itself is still good)."""
+    send_frame(sock, ftype, pack_obj(obj))
+    rtype, payload = recv_frame(sock, max_frame_bytes)
+    if rtype == FT_ERROR:
+        err = unpack_obj(payload)
+        raise WireRemoteError(str(err.get("error", "unknown remote error"))
+                              if isinstance(err, dict) else str(err))
+    if rtype != FT_OK:
+        raise WireError(
+            f"expected ok/error reply, got {FRAME_NAMES.get(rtype, rtype)}")
+    return unpack_obj(payload)
+
+
+# -- tagged binary object serializer ----------------------------------------
+# Tags: N none, T/F bool, i int64, I bigint, f float64, s str, y bytes,
+# l list, t tuple, d dict, a ndarray (dtype + shape + raw C-order bytes).
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _pack_into(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out += b"N"
+    elif obj is True:
+        out += b"T"
+    elif obj is False:
+        out += b"F"
+    elif isinstance(obj, int) and not isinstance(obj, bool):
+        if _I64_MIN <= obj <= _I64_MAX:
+            out += b"i"
+            out += struct.pack("!q", obj)
+        else:
+            enc = str(obj).encode("ascii")
+            out += b"I"
+            out += struct.pack("!I", len(enc))
+            out += enc
+    elif isinstance(obj, float):
+        out += b"f"
+        out += struct.pack("!d", obj)
+    elif isinstance(obj, str):
+        enc = obj.encode("utf-8")
+        out += b"s"
+        out += struct.pack("!I", len(enc))
+        out += enc
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        data = bytes(obj)
+        out += b"y"
+        out += struct.pack("!I", len(data))
+        out += data
+    elif isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        dt_spec = arr.dtype.str
+        if arr.dtype.kind == "V":
+            # ml_dtypes extension types (bfloat16, float8_*) stringify as
+            # anonymous void ("<V2"); their .name is what round-trips.
+            dt_spec = arr.dtype.name
+        dt = dt_spec.encode("ascii")
+        out += b"a"
+        out += struct.pack("!H", len(dt))
+        out += dt
+        out += struct.pack("!B", arr.ndim)
+        out += struct.pack(f"!{arr.ndim}q", *arr.shape)
+        raw = arr.tobytes()
+        out += struct.pack("!Q", len(raw))
+        out += raw
+    elif isinstance(obj, np.generic):
+        _pack_into(obj.item(), out)
+    elif isinstance(obj, (list, tuple)):
+        out += b"l" if isinstance(obj, list) else b"t"
+        out += struct.pack("!I", len(obj))
+        for item in obj:
+            _pack_into(item, out)
+    elif isinstance(obj, dict):
+        out += b"d"
+        out += struct.pack("!I", len(obj))
+        for k, v in obj.items():
+            _pack_into(k, out)
+            _pack_into(v, out)
+    else:
+        raise TypeError(f"unserializable type for wire: {type(obj)!r}")
+
+
+def _resolve_dtype(spec: str) -> np.dtype:
+    try:
+        return np.dtype(spec)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes  # jax dependency: bfloat16 / float8 families
+
+        return np.dtype(getattr(ml_dtypes, spec))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise WireError(f"corrupt wire object: unknown dtype {spec!r}") from e
+
+
+def pack_obj(obj: Any) -> bytes:
+    out = bytearray()
+    _pack_into(obj, out)
+    return bytes(out)
+
+
+def _unpack_from(buf: bytes, pos: int) -> Tuple[Any, int]:
+    tag = buf[pos:pos + 1]
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag == b"i":
+        return struct.unpack_from("!q", buf, pos)[0], pos + 8
+    if tag == b"I":
+        (n,) = struct.unpack_from("!I", buf, pos)
+        pos += 4
+        return int(buf[pos:pos + n].decode("ascii")), pos + n
+    if tag == b"f":
+        return struct.unpack_from("!d", buf, pos)[0], pos + 8
+    if tag == b"s":
+        (n,) = struct.unpack_from("!I", buf, pos)
+        pos += 4
+        return buf[pos:pos + n].decode("utf-8"), pos + n
+    if tag == b"y":
+        (n,) = struct.unpack_from("!I", buf, pos)
+        pos += 4
+        return buf[pos:pos + n], pos + n
+    if tag == b"a":
+        (dn,) = struct.unpack_from("!H", buf, pos)
+        pos += 2
+        dt = _resolve_dtype(buf[pos:pos + dn].decode("ascii"))
+        pos += dn
+        (ndim,) = struct.unpack_from("!B", buf, pos)
+        pos += 1
+        shape = struct.unpack_from(f"!{ndim}q", buf, pos)
+        pos += 8 * ndim
+        (nbytes,) = struct.unpack_from("!Q", buf, pos)
+        pos += 8
+        arr = np.frombuffer(buf[pos:pos + nbytes], dtype=dt).reshape(shape)
+        return arr.copy(), pos + nbytes
+    if tag in (b"l", b"t"):
+        (n,) = struct.unpack_from("!I", buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _unpack_from(buf, pos)
+            items.append(item)
+        return (items if tag == b"l" else tuple(items)), pos
+    if tag == b"d":
+        (n,) = struct.unpack_from("!I", buf, pos)
+        pos += 4
+        d: Dict[Any, Any] = {}
+        for _ in range(n):
+            k, pos = _unpack_from(buf, pos)
+            v, pos = _unpack_from(buf, pos)
+            d[k] = v
+        return d, pos
+    raise WireError(f"corrupt wire object: unknown tag {tag!r} at {pos - 1}")
+
+
+def unpack_obj(data: bytes) -> Any:
+    try:
+        obj, pos = _unpack_from(data, 0)
+    except (struct.error, IndexError, UnicodeDecodeError, ValueError) as e:
+        raise WireError(f"corrupt wire object: {e}") from e
+    if pos != len(data):
+        raise WireError(
+            f"corrupt wire object: {len(data) - pos} trailing bytes")
+    return obj
+
+
+# -- request descriptor ------------------------------------------------------
+# Only cross-process-meaningful fields travel; monotonic timestamps are
+# process-local clocks and are re-anchored on the receiving side (byte
+# identity is about tokens/logprobs, not wall-clock bookkeeping).
+_PARAM_FIELDS = ("temperature", "top_k", "top_p", "max_tokens",
+                 "stop_token_ids", "seed", "logprobs")
+
+
+def request_to_wire(req) -> dict:
+    return {
+        "request_id": req.request_id,
+        "prompt_token_ids": list(req.prompt_token_ids),
+        "params": {f: getattr(req.params, f) for f in _PARAM_FIELDS},
+        "output_token_ids": list(req.output_token_ids),
+        "output_logprobs": (list(req.output_logprobs)
+                            if req.output_logprobs is not None else None),
+        "finish_reason": req.finish_reason,
+        "num_preemptions": req.num_preemptions,
+        "num_retries": req.num_retries,
+        "num_migrations": req.num_migrations,
+        "tenant": req.tenant,
+        "priority": req.priority,
+        "adapter": req.adapter,
+        "cancel_requested": req.cancel_requested,
+    }
+
+
+def request_from_wire(d: dict):
+    from dlti_tpu.serving.engine import Request
+    from dlti_tpu.serving.sampling import SamplingParams
+
+    pd = dict(d["params"])
+    if pd.get("stop_token_ids") is not None:
+        pd["stop_token_ids"] = tuple(pd["stop_token_ids"])
+    req = Request(
+        request_id=d["request_id"],
+        prompt_token_ids=list(d["prompt_token_ids"]),
+        params=SamplingParams(**pd),
+        arrival_time=time.monotonic(),
+    )
+    req.output_token_ids = list(d.get("output_token_ids") or [])
+    if d.get("output_logprobs") is not None:
+        req.output_logprobs = list(d["output_logprobs"])
+    req.finish_reason = d.get("finish_reason")
+    req.num_preemptions = int(d.get("num_preemptions", 0))
+    req.num_retries = int(d.get("num_retries", 0))
+    req.num_migrations = int(d.get("num_migrations", 0))
+    req.tenant = d.get("tenant", "")
+    req.priority = d.get("priority", req.priority)
+    req.adapter = d.get("adapter", "")
+    req.cancel_requested = bool(d.get("cancel_requested", False))
+    return req
+
+
+# -- versioned handoff envelope ----------------------------------------------
+HANDOFF_VERSION = 1
+
+
+def pack_handoff(snap: dict) -> bytes:
+    """Serialize an ``export_handoff`` snapshot (request descriptor,
+    per-block paged-KV payloads, rng key bytes, gen_count) as a versioned
+    binary envelope. The numpy payloads round-trip byte-exactly, so a
+    cross-process ``adopt_handoff`` continues the decode stream with the
+    same tokens the exporting worker would have produced."""
+    body = dict(snap)
+    body["request"] = request_to_wire(body["request"])
+    return pack_obj({"v": HANDOFF_VERSION, "kind": "kv-handoff",
+                     "snap": body})
+
+
+def unpack_handoff(data: bytes) -> dict:
+    obj = unpack_obj(data)
+    if not isinstance(obj, dict) or obj.get("kind") != "kv-handoff":
+        raise WireError("not a handoff envelope")
+    if obj.get("v") != HANDOFF_VERSION:
+        raise WireVersionMismatch(
+            f"handoff envelope version {obj.get('v')!r}, "
+            f"this side {HANDOFF_VERSION}")
+    snap = obj["snap"]
+    snap["request"] = request_from_wire(snap["request"])
+    return snap
+
+
+# -- shared test/tooling helper ----------------------------------------------
+def ephemeral_port(host: str = "127.0.0.1") -> int:
+    """Pick a currently-free TCP port on ``host``.
+
+    The single helper every socket-binding test (gateway / server / traces
+    / fleet) uses instead of hand-rolled ``bind(0)`` copies, so port
+    allocation behavior is uniform and collision handling has one home.
+    """
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def connect_with_retry(host: str, port: int, *, timeout_s: float,
+                       interval_s: float = 0.1) -> socket.socket:
+    """TCP connect, retrying until the listener is up or the deadline
+    passes (worker processes bind only after their engine is built)."""
+    deadline = time.monotonic() + timeout_s
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            last = e
+            time.sleep(interval_s)
+    raise WireError(f"could not connect to {host}:{port} "
+                    f"within {timeout_s}s: {last}")

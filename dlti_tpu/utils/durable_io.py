@@ -20,9 +20,10 @@ sentinel skip-list, watchdog alert logs — goes through one writer with
   dropped, and reclaimed.
 * **Persistent** (anything else, or retries exhausted): degrade by the
   path class's criticality instead of crashing. ``checkpoint`` /
-  ``adapter`` / ``prefix_tier`` / ``flight`` writes re-raise the final
-  ``OSError`` so their callers run the protocol-level fallback (skip the
-  save and alert; flip the tier memory-only; record ``dump_failed``);
+  ``adapter`` / ``prefix_tier`` / ``flight`` / ``fleet_runtime`` writes
+  re-raise the final ``OSError`` so their callers run the protocol-level
+  fallback (skip the save and alert; flip the tier memory-only; record
+  ``dump_failed``; let the fleet supervisor's startup timeout respawn);
   telemetry-stream classes (``steplog``, ``elastic``, ``sentinel``,
   ``watchdog``) drop-and-count — a lost log line must never abort a
   training step.
@@ -88,6 +89,10 @@ _POLICY: Dict[str, tuple] = {
     "adapter":     (True, 2),
     "prefix_tier": (True, 1),
     "flight":      (True, 1),
+    # Fleet worker port files: the supervisor polls for them, so a
+    # persistent failure must surface in the worker (its process exits
+    # and the supervisor's startup timeout takes over).
+    "fleet_runtime": (True, 1),
     "steplog":     (False, 0),
     "elastic":     (False, 1),
     "sentinel":    (False, 1),

@@ -16,6 +16,9 @@ Usage:
     python scripts/postmortem.py ... --json                    # machine-readable
     python scripts/postmortem.py runs/flightrecords --all      # elastic job:
         # one incident summary across every per-rank flight-*-gG-rR dump
+    python scripts/postmortem.py runs/flightrecords --all      # fleet run:
+        # also walks one level of subdirs (worker0/, worker1/, ...) — the
+        # per-process dump namespaces a multi-process serving fleet writes
 """
 
 from __future__ import annotations
@@ -64,12 +67,31 @@ _KEY_METRICS = (
     "dlti_replica_lifecycle_flaps_total",
     "dlti_replica_lifecycle_migrations_total",
     "dlti_replica_lifecycle_migration_fallbacks_total",
+    # Multi-process fleet (dlti_tpu.serving.fleet).
+    "fleet_workers", "fleet_workers_live", "fleet_respawns",
 )
 
 # Sentinel dump reasons / context keys surfaced as their own report
 # section (a numeric incident reads differently from a crash: the
 # process is healthy, the NUMBERS died).
 _SENTINEL_REASONS = ("sentinel_rollback", "sdc_mismatch")
+
+
+def discover_dumps(path: str) -> list:
+    """Flight dumps under ``path`` and ONE level of subdirectories,
+    oldest first. An elastic training job writes its per-rank dumps flat
+    (``flight-*-gG-rR/``); a multi-process serving fleet namespaces each
+    process — the supervisor dumps at the root, every worker under its
+    own ``worker{N}/`` subdir — and ``--all`` merges the whole tree into
+    one incident."""
+    path = os.path.abspath(path)
+    dumps = list(list_dumps(path))
+    if os.path.isdir(path):
+        for entry in sorted(os.listdir(path)):
+            sub = os.path.join(path, entry)
+            if os.path.isdir(sub) and not entry.startswith("flight-"):
+                dumps.extend(list_dumps(sub))
+    return sorted(dumps, key=os.path.getmtime)
 
 
 def _resolve_dump(path: str) -> str:
@@ -220,6 +242,10 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "pid": ctx_file.get("pid"),
         "process_id": ctx_file.get("process_id"),
         "generation": ctx_file.get("generation"),
+        # Fleet worker id (engine_worker.py notes it into the recorder
+        # context; == process_id for fleet dumps, None for training
+        # ranks) — the incident view groups on it when present.
+        "worker": context.get("worker"),
         "role": context.get("role"),
         "config_fingerprint": ctx_file.get("config_fingerprint"),
         "last_completed_step": context.get("last_completed_step",
@@ -295,9 +321,17 @@ def summarize_incident(dump_dirs: list, span_tail: int = 15,
     root = (failures or dumps)[0] if dumps else None
     by_gen: dict = {}
     for s in dumps:
+        # Fleet dumps live in per-process subdirs (worker{N}/flight-*);
+        # keep the namespace in the label so two workers' same-named
+        # dumps stay distinguishable in one incident.
+        parent = os.path.basename(os.path.dirname(s["dump"]))
+        label = os.path.basename(s["dump"])
+        if parent.startswith("worker"):
+            label = os.path.join(parent, label)
         by_gen.setdefault(s.get("generation"), []).append({
-            "dump": os.path.basename(s["dump"]),
+            "dump": label,
             "rank": s.get("process_id"),
+            "worker": s.get("worker"),
             "reason": s.get("reason"),
             "when": s.get("when"),
             "last_completed_step": s.get("last_completed_step"),
@@ -325,7 +359,13 @@ def render_incident(incident: dict) -> str:
         w(f"generation {gen}:")
         for r in rows:
             dmg = "  !!DAMAGED" if r["damaged"] else ""
-            w(f"    rank {r['rank'] if r['rank'] is not None else '?':>3}  "
+            # A fleet worker identifies as "worker N" (its supervisor
+            # slot), a training process as "rank N".
+            if r.get("worker") is not None:
+                who = f"worker {r['worker']!s:>3}"
+            else:
+                who = f"rank {r['rank'] if r['rank'] is not None else '?':>3}"
+            w(f"    {who}  "
               f"{(r['reason'] or '?'):24s} last step "
               f"{r['last_completed_step']!s:>6}  "
               f"phase {(r['phase_at_death'] or '?')}{dmg}")
@@ -499,7 +539,9 @@ def main() -> None:
     p.add_argument("--all", action="store_true",
                    help="treat PATH as a directory of per-rank dumps "
                         "(elastic/multi-process job) and render ONE "
-                        "incident summary across all of them")
+                        "incident summary across all of them; also walks "
+                        "one level of subdirs (a fleet's per-worker "
+                        "dump namespaces)")
     p.add_argument("--ledger", default=None, metavar="PATH",
                    help="stitched goodput ledger (the elastic "
                         "supervisor's ledger_stitched.json) for the "
@@ -507,7 +549,7 @@ def main() -> None:
                         "near PATH when omitted")
     args = p.parse_args()
     if args.all:
-        dumps = list_dumps(args.path)
+        dumps = discover_dumps(args.path)
         if not dumps:
             raise SystemExit(f"no flight-*/ dump under {args.path}")
         stitched = load_stitched_ledger(

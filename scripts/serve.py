@@ -78,6 +78,25 @@ def parse_args():
                    help="data-parallel engine replicas (each tensor-wide); "
                         "a replica whose step faults is excluded and its "
                         "requests fail over to survivors")
+    # -- multi-process fleet (dlti_tpu.serving.fleet) -------------------
+    p.add_argument("--fleet-workers", type=int, default=0,
+                   help="serve from N engine WORKER PROCESSES behind the "
+                        "fleet supervisor (TCP wire protocol, per-process "
+                        "failure domains): a SIGKILL'd worker is "
+                        "respawned and canary-reinstated while its "
+                        "in-flight work fails over / migrates; outputs "
+                        "are byte-identical to the in-process engine "
+                        "(0 = off; overrides --replicas)")
+    p.add_argument("--fleet-runtime-dir", default="",
+                   help="fleet scratch dir (worker spec, port files, "
+                        "per-worker logs); default: a per-PID dir under "
+                        "the system temp dir")
+    p.add_argument("--fleet-respawn-backoff", type=float, default=0.5,
+                   help="initial respawn backoff after a worker death "
+                        "(doubles per consecutive failure, capped at 30s)")
+    p.add_argument("--fleet-restart-budget", type=int, default=8,
+                   help="respawns allowed per worker before it is "
+                        "permanently evicted")
     # -- prefill/decode disaggregation (dlti_tpu.serving.disagg) --------
     p.add_argument("--disagg", action="store_true",
                    help="prefill/decode disaggregation: prompts prefill on "
@@ -418,7 +437,51 @@ def main() -> None:
         probation_initial_s=args.probation,
         flap_window_s=args.flap_window,
         flap_max_cycles=args.flap_max_cycles)
-    if args.disagg:
+    if args.fleet_workers > 0:
+        if args.disagg:
+            raise SystemExit("--fleet-workers and --disagg are mutually "
+                             "exclusive (disagg pools stay in-process)")
+        import dataclasses
+        import tempfile
+
+        from dlti_tpu.config import FleetConfig
+        from dlti_tpu.serving import FleetSupervisor, make_subprocess_spawner
+
+        runtime_dir = args.fleet_runtime_dir or os.path.join(
+            tempfile.gettempdir(), f"dlti_fleet_{os.getpid()}")
+        # Everything a worker needs to build a byte-identical engine: the
+        # same model source, engine config, adapters, and the parent's
+        # matmul precision (the env half of the platform setup is
+        # inherited through the child env).
+        spec = {
+            "model_dir": args.model_dir,
+            "model_preset": args.random_init,
+            "engine": dataclasses.asdict(ec),
+            "matmul_precision": jax.config.jax_default_matmul_precision,
+            "adapters": {name.strip(): adir.strip()
+                         for name, _, adir in
+                         (s.partition("=") for s in args.adapter)},
+            "warmup": True,
+            "slow_log_k": args.slow_log_k,
+            "flight_dir": args.flight_dir,
+        }
+        # Fleet healing is always on: respawn-on-death is the point of
+        # per-process failure domains (--self-heal only tunes probation).
+        engine = FleetSupervisor(
+            ec, workers=args.fleet_workers,
+            spawner=make_subprocess_spawner(spec, runtime_dir,
+                                            host="127.0.0.1"),
+            fleet_cfg=FleetConfig(
+                workers=args.fleet_workers,
+                respawn_backoff_s=args.fleet_respawn_backoff,
+                restart_budget=args.fleet_restart_budget),
+            lifecycle_cfg=dataclasses.replace(lc_cfg, enabled=True),
+            max_retries=args.max_retries,
+            affinity_spill_threshold=args.affinity_spill_threshold,
+            canary_vocab=model_cfg.vocab_size)
+        print(f"fleet supervisor: {args.fleet_workers} worker "
+              f"process(es) ready (runtime dir {runtime_dir})")
+    elif args.disagg:
         from dlti_tpu.serving import DisaggController
 
         engine = DisaggController(
@@ -542,6 +605,8 @@ def main() -> None:
     try:
         serve(engine, tok, sc)
     finally:
+        if args.fleet_workers > 0:
+            engine.close()  # FT_SHUTDOWN + terminate/kill ladder
         if args.disagg:
             engine.stop()
         if tracer is not None:

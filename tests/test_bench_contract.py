@@ -245,7 +245,7 @@ def test_disk_metric_names_are_schema_stable():
     # The path-class set is the degradation-policy contract (the README
     # criticality table and the AST guard's covered modules key on it).
     assert durable_io.PATH_CLASSES == (
-        "checkpoint", "adapter", "prefix_tier", "flight",
+        "checkpoint", "adapter", "prefix_tier", "flight", "fleet_runtime",
         "steplog", "elastic", "sentinel", "watchdog",
     )
 
@@ -281,6 +281,39 @@ def test_lifecycle_metric_names_are_schema_stable():
     # (dashboards map code -> label via STATES order).
     assert lifecycle.STATES == (
         "live", "quarantined", "probing", "draining", "evicted",
+    )
+
+
+def test_fleet_metric_names_are_schema_stable():
+    """Multi-process fleet telemetry names are a scrape contract: the
+    wire-layer frame/byte counters (labeled by frame kind) and the
+    supervisor's live-worker gauge + respawn counter, all federated into
+    the serving registry and cross-checked by loadgen's federation
+    report."""
+    from dlti_tpu.serving import fleet, wire
+
+    assert wire.WIRE_METRIC_NAMES == (
+        "dlti_fleet_frames_total",
+        "dlti_fleet_wire_bytes_total",
+    )
+    assert wire.frames_total.name == wire.WIRE_METRIC_NAMES[0]
+    assert wire.wire_bytes_total.name == wire.WIRE_METRIC_NAMES[1]
+
+    assert fleet.FLEET_METRIC_NAMES == (
+        "dlti_fleet_workers_alive",
+        "dlti_fleet_respawns_total",
+    )
+    assert fleet.workers_alive_gauge.name == fleet.FLEET_METRIC_NAMES[0]
+    assert fleet.respawns_total.name == fleet.FLEET_METRIC_NAMES[1]
+    # The per-worker key sets are the federation contract: counter keys
+    # must sum across workers to the fleet-level dlti_{key} totals
+    # (loadgen's federation report asserts this at scrape time).
+    assert fleet.WORKER_COUNTER_KEYS == (
+        "requests", "generated_tokens", "prefill_tokens",
+        "preemptions", "decode_steps",
+    )
+    assert fleet.WORKER_GAUGE_KEYS == (
+        "up", "active", "waiting", "free_blocks",
     )
 
 

@@ -28,6 +28,7 @@ PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 COVERED_MODULES = (
     os.path.join("checkpoint", "store.py"),
     os.path.join("serving", "adapters.py"),
+    os.path.join("serving", "fleet.py"),
     os.path.join("serving", "prefix_tiers.py"),
     os.path.join("telemetry", "flightrecorder.py"),
     os.path.join("telemetry", "steplog.py"),
@@ -44,6 +45,9 @@ _ALLOWED_RAW_WRITES = {
     # handles passed to Popen — a stream, not a persistence write, and
     # it must not share the durable writer's retry/degrade machinery.
     (os.path.join("training", "elastic.py"), "_spawn"),
+    # Fleet worker stdout/stderr capture: same shape — a long-lived
+    # subprocess log handle handed to Popen, not a persistence write.
+    (os.path.join("serving", "fleet.py"), "make_subprocess_spawner"),
 }
 
 _WRITE_MODE_CHARS = set("wax+")

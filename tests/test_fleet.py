@@ -1,0 +1,704 @@
+"""Multi-process serving fleet tests (dlti_tpu.serving.fleet).
+
+Layers:
+
+* **Thread-spawner fast tier** — the spawner seam injects in-process
+  ``EngineWorker`` threads instead of real processes, so the full
+  supervisor ↔ worker wire conversation (submit / step / drain / adopt /
+  health / abort) runs in seconds:
+  - byte-identity with a single-process engine (greedy and seeded),
+  - cross-worker KV-handoff migration on drain, byte-identical, bf16 and
+    int8 KV (the envelope's numpy payloads round-trip byte-exactly),
+  - kill → failover + canary-gated respawn with zero client errors and
+    monotonic per-worker counters,
+  - a worker that survives garbage/truncated/oversized/corrupt frames
+    and still answers a clean health round-trip,
+  - an evil peer speaking corrupt frames: the supervisor evicts it and
+    rehomes its work instead of hanging or corrupting an adoption,
+  - the ReplicatedEngine-compatible facade + federation arithmetic
+    (per-worker counter sums == fleet totals; loadgen's key mirror).
+* **Subprocess slow tier** — the real ``scripts/engine_worker.py``
+  drill: ``--fleet-workers 2`` outputs byte-identical to an in-process
+  2-replica engine (greedy + seeded, incl. one cross-process migration),
+  and a live-loadgen chaos drill that SIGKILLs a worker mid-run and
+  demands zero client errors, a respawn, and consistent federated
+  metrics.
+"""
+
+import dataclasses
+import itertools
+import os
+import signal
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlti_tpu.config import (
+    FleetConfig, MODEL_PRESETS, ReplicaLifecycleConfig,
+)
+from dlti_tpu.models import LlamaForCausalLM
+from dlti_tpu.serving import (
+    EngineConfig, InferenceEngine, ReplicatedEngine, SamplingParams,
+)
+from dlti_tpu.serving import fleet, wire
+from dlti_tpu.serving.engine import Request
+from dlti_tpu.serving.fleet import FleetSupervisor, make_subprocess_spawner
+from dlti_tpu.serving.worker import EngineWorker
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7, 8], [9, 10, 11, 12], [13, 14]]
+
+GREEDY = SamplingParams(max_tokens=8, temperature=0.0)
+SEEDED = SamplingParams(max_tokens=8, temperature=0.9, seed=7)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = LlamaForCausalLM(CFG, None)
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ec(**over):
+    base = dict(max_seqs=4, block_size=8, num_blocks=64, max_model_len=128,
+                cache_dtype="float32", eos_token_id=-1)
+    base.update(over)
+    return EngineConfig(**base)
+
+
+# ----------------------------------------------------------------------
+# Thread-based fake spawner (the test seam make_subprocess_spawner names)
+# ----------------------------------------------------------------------
+
+class _ThreadHandle:
+    """Process-handle protocol over an in-process EngineWorker thread.
+
+    ``kill()`` closes the worker's listener AND its live supervisor
+    connection, so the supervisor's next RPC fails exactly like it does
+    against a SIGKILL'd process."""
+
+    _pids = itertools.count(900000)
+
+    def __init__(self, worker: EngineWorker):
+        self.worker = worker
+        self.pid = next(self._pids)
+        self.thread = threading.Thread(target=worker.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def port(self):
+        return self.worker.port
+
+    def poll(self):
+        return None if self.thread.is_alive() else 0
+
+    def wait(self, timeout=None):
+        self.thread.join(timeout)
+        if self.thread.is_alive():
+            raise TimeoutError("worker thread still serving")
+        return 0
+
+    def terminate(self):
+        self.worker.close()
+
+    kill = terminate
+
+
+def _thread_spawner(params, **engine_over):
+    """spawner(idx, generation) building a fresh engine per incarnation
+    from the shared (NOT donated) param tree — every worker holds
+    identical weights, like the subprocess PRNGKey(0) preset path."""
+    spawned = []
+
+    def spawn(idx: int, generation: int) -> _ThreadHandle:
+        engine = InferenceEngine(CFG, params, _ec(**engine_over))
+        handle = _ThreadHandle(EngineWorker(engine, port=0, worker_id=idx))
+        spawned.append((idx, generation, handle))
+        return handle
+
+    spawn.spawned = spawned
+    return spawn
+
+
+def _fleet_cfg(**over):
+    base = dict(workers=2, health_interval_s=0.05, respawn_backoff_s=0.05,
+                respawn_backoff_max_s=0.5, startup_timeout_s=120.0,
+                rpc_timeout_s=60.0, term_grace_s=2.0)
+    base.update(over)
+    return FleetConfig(**base)
+
+
+def _make_fleet(params, *, workers=2, heal=True, engine_over=None,
+                **sup_kwargs):
+    spawner = _thread_spawner(params, **(engine_over or {}))
+    lc = ReplicaLifecycleConfig(enabled=heal, probation_initial_s=0.05,
+                                probation_max_s=0.5)
+    return FleetSupervisor(
+        _ec(**(engine_over or {})), workers=workers, spawner=spawner,
+        fleet_cfg=_fleet_cfg(workers=workers), lifecycle_cfg=lc,
+        canary_vocab=CFG.vocab_size, **sup_kwargs)
+
+
+def _expected(params_tree, sp, **engine_over):
+    eng = InferenceEngine(CFG, params_tree, _ec(**engine_over))
+    return {tuple(p): (r.output_token_ids, r.output_logprobs)
+            for p, r in zip(PROMPTS, eng.generate(PROMPTS, sp))}
+
+
+# ----------------------------------------------------------------------
+# Byte-identity: fleet == single-process engine
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("sp", [GREEDY, SEEDED], ids=["greedy", "seeded"])
+def test_fleet_outputs_byte_identical_to_single_process(tiny_params, sp):
+    expect = _expected(tiny_params, sp)
+    sup = _make_fleet(tiny_params, workers=2)
+    try:
+        results = sup.generate(PROMPTS, sp)
+        # Work genuinely spread across both workers.
+        per_worker = [sup.fleet_scalars()[f"fleet_w{i}_requests"]
+                      for i in range(2)]
+        assert all(v > 0 for v in per_worker), per_worker
+        for p, r in zip(PROMPTS, results):
+            toks, lps = expect[tuple(p)]
+            assert r.output_token_ids == toks
+            assert [float(x) for x in r.output_logprobs] \
+                == [float(x) for x in lps]
+            assert r.finish_reason == "length"
+    finally:
+        sup.close()
+
+
+@pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+@pytest.mark.parametrize("sp", [GREEDY, SEEDED], ids=["greedy", "seeded"])
+def test_fleet_migration_byte_identical(tiny_params, kv_dtype, sp):
+    """Drain one worker mid-decode: its requests cross the process
+    boundary as verbatim KV-handoff envelopes and still finish with
+    EXACTLY the single-engine tokens — bf16 and int8 KV payloads."""
+    expect = _expected(tiny_params, sp, cache_dtype=kv_dtype)
+    sup = _make_fleet(tiny_params, workers=2,
+                      engine_over={"cache_dtype": kv_dtype})
+    try:
+        reqs = [sup.submit(p, sp) for p in PROMPTS]
+        for _ in range(60):
+            sup.step()
+            if all(len(r.output_token_ids) >= 2 for r in reqs):
+                break
+        assert all(not r.done for r in reqs)
+        victim = next(w for w in sup._workers if w.owned)
+        before = {r.request_id: list(r.output_token_ids) for r in reqs}
+        errored = sup.drain_replica(victim.idx, kind="preempt",
+                                    quarantine=False)
+        assert errored == []
+        while sup.has_work:
+            sup.step()
+        migrated = [r for r in reqs if r.num_migrations > 0]
+        assert migrated, "drain must migrate at least one mid-decode request"
+        for r in migrated:
+            # Mid-flight tokens survived the envelope (mirror kept them).
+            assert r.output_token_ids[:len(before[r.request_id])] \
+                == before[r.request_id]
+        for p, r in zip(PROMPTS, reqs):
+            toks, _ = expect[tuple(p)]
+            assert r.output_token_ids == toks, \
+                f"{r.request_id} (migrations={r.num_migrations})"
+            assert r.finish_reason == "length"
+    finally:
+        sup.close()
+
+
+# ----------------------------------------------------------------------
+# Kill -> failover + respawn
+# ----------------------------------------------------------------------
+
+def test_fleet_kill_failover_respawn_zero_errors(tiny_params):
+    respawns_before = fleet.respawns_total.value
+    sup = _make_fleet(tiny_params, workers=2)
+    try:
+        sp = SamplingParams(max_tokens=12, temperature=0.0)
+        reqs = [sup.submit(p, sp) for p in PROMPTS]
+        for _ in range(60):
+            sup.step()
+            if any(r.output_token_ids for r in reqs):
+                break
+        victim = next(w for w in sup._workers if w.owned)
+        scal_before = sup.fleet_scalars()
+        victim.handle.kill()  # SIGKILL analog mid-decode
+        deadline = time.monotonic() + 60
+        while sup.has_work and time.monotonic() < deadline:
+            sup.step()
+        # Zero client errors: every request finished normally on the
+        # survivor (failover resubmits recompute from mirror tokens).
+        assert [r.finish_reason for r in reqs] == ["length"] * len(reqs)
+        assert sup.failover["replica_faults"] >= 1
+        assert sup.failover["failover_errors"] == 0
+        # The replacement process canaries back in.
+        while sup._respawns < 1 and time.monotonic() < deadline:
+            sup.step()
+            time.sleep(0.005)
+        assert sup._respawns >= 1
+        assert fleet.respawns_total.value >= respawns_before + 1
+        assert sup.worker_states()[str(victim.idx)] == "live"
+        assert sup.num_live == 2
+        # Federated per-worker counters stayed monotonic across the
+        # respawn (stats_carry) and new work reaches the replacement.
+        scal_after = sup.fleet_scalars()
+        for k in fleet.WORKER_COUNTER_KEYS:
+            key = f"fleet_w{victim.idx}_{k}"
+            assert scal_after[key] >= scal_before[key], key
+        assert scal_after["fleet_respawns"] >= 1
+        r2 = sup.generate(PROMPTS[:2], GREEDY)
+        assert all(r.finish_reason == "length" for r in r2)
+    finally:
+        sup.close()
+
+
+def test_fleet_total_outage_queues_until_respawn(tiny_params):
+    """Every worker dead at once: submits queue during the respawn window
+    instead of erroring, then drain once a replacement is live."""
+    sup = _make_fleet(tiny_params, workers=2)
+    try:
+        for w in list(sup._workers):
+            w.handle.kill()
+        deadline = time.monotonic() + 60
+        while sup.num_live > 0 and time.monotonic() < deadline:
+            sup.step()  # discover the deaths
+        req = sup.submit(PROMPTS[0], GREEDY)  # _reviving() holds the queue
+        while sup.has_work and time.monotonic() < deadline:
+            sup.step()
+            time.sleep(0.005)
+        assert req.finish_reason == "length"
+        assert sup._respawns >= 1
+    finally:
+        sup.close()
+
+
+# ----------------------------------------------------------------------
+# Robustness: worker survives garbage, supervisor survives evil peers
+# ----------------------------------------------------------------------
+
+def _connect(port):
+    s = wire.connect_with_retry("127.0.0.1", port, timeout_s=10.0)
+    s.settimeout(30.0)  # a hung reply should fail the test, not the suite
+    return s
+
+
+def test_worker_survives_malformed_frames(tiny_params):
+    engine = InferenceEngine(CFG, tiny_params, _ec())
+    worker = EngineWorker(engine, port=0, worker_id=3,
+                          max_frame_bytes=1 << 20)
+    t = threading.Thread(target=worker.serve_forever, daemon=True)
+    t.start()
+    try:
+        # 1. Not the protocol at all (HTTP bytes): FT_ERROR or a drop,
+        # never a worker death.
+        s = _connect(worker.port)
+        s.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        try:
+            ftype, payload = wire.recv_frame(s)
+            assert ftype == wire.FT_ERROR
+            assert "WireBadMagic" in wire.unpack_obj(payload)["error"]
+        except wire.WireError:
+            pass  # connection torn down before the reply landed: also fine
+        s.close()
+
+        # 2. Truncated mid-frame (peer death): worker drops and re-accepts.
+        s = _connect(worker.port)
+        s.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                    wire.FT_STEP, 512)[:7])
+        s.close()
+
+        # 3. Version from the future.
+        s = _connect(worker.port)
+        s.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION + 7,
+                                    wire.FT_STEP, 0))
+        try:
+            ftype, payload = wire.recv_frame(s)
+            assert ftype == wire.FT_ERROR
+            assert "WireVersionMismatch" in wire.unpack_obj(payload)["error"]
+        except wire.WireError:
+            pass
+        s.close()
+
+        # 4. Oversized declared payload: refused without allocation.
+        s = _connect(worker.port)
+        s.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                    wire.FT_ADOPT, (1 << 20) + 1))
+        try:
+            ftype, payload = wire.recv_frame(s)
+            assert ftype == wire.FT_ERROR
+            assert "WireFrameTooLarge" in wire.unpack_obj(payload)["error"]
+        except wire.WireError:
+            pass
+        s.close()
+
+        # 5. Digest corruption: caught before dispatch.
+        s = _connect(worker.port)
+        payload = wire.pack_obj({"request": {}})
+        s.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                    wire.FT_ADOPT, len(payload))
+                  + payload + b"\x00" * wire._DIGEST_BYTES)
+        try:
+            ftype, reply = wire.recv_frame(s)
+            assert ftype == wire.FT_ERROR
+            assert "WireDigestMismatch" in wire.unpack_obj(reply)["error"]
+        except wire.WireError:
+            pass
+        s.close()
+
+        # 6. Well-formed frame of an unexpected type: FT_ERROR reply and
+        # the SAME connection keeps serving.
+        s = _connect(worker.port)
+        with pytest.raises(wire.WireRemoteError, match="unexpected frame"):
+            wire.request_reply(s, wire.FT_STEP_RESULT, {})
+        reply = wire.request_reply(s, wire.FT_HEALTH, {})
+        assert reply["ok"] and reply["worker_id"] == 3
+
+        # 7. And the engine still actually works.
+        r = wire.request_reply(s, wire.FT_SUBMIT, {
+            "request": wire.request_to_wire(Request(
+                request_id="post-garbage", prompt_token_ids=[1, 2, 3],
+                params=SamplingParams(max_tokens=2, temperature=0.0),
+                arrival_time=time.monotonic())),
+            "resubmit": False})
+        assert r["ok"]
+        for _ in range(50):
+            reply = wire.request_reply(s, wire.FT_STEP, {"cancels": []})
+            done = [ev for ev in reply["events"]
+                    if ev["id"] == "post-garbage"
+                    and "finish_reason" in ev]
+            if done:
+                assert done[0]["finish_reason"] == "length"
+                break
+        else:
+            pytest.fail("request did not finish after garbage storm")
+        s.close()
+    finally:
+        worker.close()
+        t.join(timeout=10)
+        assert not t.is_alive(), "worker thread must exit on close()"
+
+
+class _EvilHandle:
+    """A 'worker' that handshakes health correctly, then answers every
+    other frame with a digest-corrupted reply."""
+
+    def __init__(self):
+        self.pid = 66666
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(2)
+        self._port = self._listener.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                while not self._stop:
+                    ftype, _ = wire.recv_frame(conn)
+                    if ftype == wire.FT_HEALTH:
+                        wire.send_frame(conn, wire.FT_OK, wire.pack_obj(
+                            {"ok": True, "pid": self.pid, "worker_id": 0,
+                             "time": 0.0, "stats": {}, "metrics": {},
+                             "active": 0, "waiting": 0, "free_blocks": 64,
+                             "has_work": False}))
+                        continue
+                    payload = wire.pack_obj({"ok": True})
+                    conn.sendall(wire._HEADER.pack(
+                        wire.MAGIC, wire.WIRE_VERSION, wire.FT_OK,
+                        len(payload)) + payload
+                        + b"\xde" * wire._DIGEST_BYTES)
+            except (wire.WireError, OSError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def port(self):
+        return self._port
+
+    def poll(self):
+        return None if not self._stop else 0
+
+    def wait(self, timeout=None):
+        self.thread.join(timeout)
+        return 0
+
+    def terminate(self):
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    kill = terminate
+
+
+def test_supervisor_evicts_corrupt_peer_and_rehomes(tiny_params):
+    """Worker 0 answers with digest-corrupted frames: the supervisor must
+    evict it (never adopt the corrupt bytes, never hang) and finish the
+    request on the healthy worker."""
+    good = _thread_spawner(tiny_params)
+
+    def spawn(idx, generation):
+        if idx == 0:
+            return _EvilHandle()
+        return good(idx, generation)
+
+    sup = FleetSupervisor(
+        _ec(), workers=2, spawner=spawn, fleet_cfg=_fleet_cfg(),
+        lifecycle_cfg=ReplicaLifecycleConfig(enabled=False),
+        canary_vocab=CFG.vocab_size)
+    try:
+        req = sup.submit(PROMPTS[0], GREEDY)
+        deadline = time.monotonic() + 60
+        while sup.has_work and time.monotonic() < deadline:
+            sup.step()
+        assert req.finish_reason == "length", \
+            "request must finish on the healthy worker"
+        assert req.replica == 1
+        assert sup.failover["replica_faults"] >= 1
+        assert sup.worker_states()["0"] == "dead"  # healing off: stays dead
+        assert sup.num_live == 1
+    finally:
+        sup.close()
+
+
+# ----------------------------------------------------------------------
+# Facade surface + federation arithmetic
+# ----------------------------------------------------------------------
+
+def test_fleet_facade_and_federation(tiny_params):
+    sup = _make_fleet(tiny_params, workers=2)
+    try:
+        sup.generate(PROMPTS, GREEDY)
+        scal = sup.fleet_scalars()
+        stats = sup.stats
+        # Per-worker federated counters sum exactly to the fleet totals —
+        # the equality loadgen's federation check asserts over /metrics.
+        for k in fleet.WORKER_COUNTER_KEYS:
+            worker_sum = sum(scal[f"fleet_w{i}_{k}"] for i in range(2))
+            assert worker_sum == stats.get(k, 0), k
+        assert scal["fleet_workers"] == 2.0
+        assert scal["fleet_workers_live"] == 2.0
+        assert scal["fleet_w0_up"] == 1.0 and scal["fleet_w1_up"] == 1.0
+        for key in sup.fleet_gauge_keys:
+            assert key in scal, key
+        assert len(stats["replicas"]) == 2
+        assert sup.lifecycle_counts()["live"] == 2
+        assert set(sup.worker_states().values()) == {"live"}
+        assert sup.respawn_retry_after_s == 0.0
+        assert sup.cfg.max_seqs == 4
+        assert fleet.workers_alive_gauge.value == 2.0
+
+        # Loadgen's hardcoded key mirror must track the fleet contract.
+        from dlti_tpu.benchmarks import loadgen
+
+        assert loadgen._FLEET_COUNTER_KEYS == fleet.WORKER_COUNTER_KEYS
+
+        # abort_all finishes every mirror and clears the pending queue.
+        reqs = [sup.submit(p, SamplingParams(max_tokens=64))
+                for p in PROMPTS]
+        sup.step()
+        aborted = sup.abort_all(reason="abort")
+        assert {r.request_id for r in aborted} \
+            == {r.request_id for r in reqs}
+        assert all(r.finish_reason == "abort" for r in reqs)
+        assert not sup.has_work
+        assert sup.num_active == 0
+    finally:
+        sup.close()
+
+
+def test_fleet_sticky_affinity_and_cancel(tiny_params):
+    sup = _make_fleet(tiny_params, workers=2)
+    try:
+        # Same affinity key -> same worker (rendezvous hash), booked as
+        # sticky routes.
+        r1 = sup.submit(PROMPTS[0], GREEDY, affinity_key="session-A")
+        sup.step()
+        r2 = sup.submit(PROMPTS[1], GREEDY, affinity_key="session-A")
+        sup.step()
+        assert r1.replica == r2.replica
+        assert sup.affinity["sticky"] >= 2
+        # Cancellation propagates over the wire as a step piggyback.
+        r3 = sup.submit(PROMPTS[2], SamplingParams(max_tokens=64))
+        sup.step()
+        r3.cancel_requested = True
+        deadline = time.monotonic() + 30
+        while sup.has_work and time.monotonic() < deadline:
+            sup.step()
+        # Server-side cancel finishes as a normal "stop", long before
+        # max_tokens would.
+        assert r3.finish_reason == "stop"
+        assert len(r3.output_token_ids) < 64
+    finally:
+        sup.close()
+
+
+# ----------------------------------------------------------------------
+# Subprocess drills (slow tier): the real engine_worker.py processes
+# ----------------------------------------------------------------------
+
+def _subprocess_spec(**engine_over):
+    return {
+        "model_preset": "llama_tiny",
+        "engine": dataclasses.asdict(_ec(**engine_over)),
+        # conftest forces true-fp32 matmuls in THIS process; workers need
+        # the same knob for cross-process byte identity.
+        "matmul_precision": "highest",
+        "warmup": False,  # lazy compiles keep the drill's boot short
+    }
+
+
+def _mk_subprocess_fleet(tmp_path, *, workers=2, heal=True, flight_dir=None,
+                         **engine_over):
+    spec = _subprocess_spec(**engine_over)
+    if flight_dir:
+        spec["flight_dir"] = flight_dir
+    spawner = make_subprocess_spawner(spec, str(tmp_path))
+    return FleetSupervisor(
+        _ec(**engine_over), workers=workers, spawner=spawner,
+        fleet_cfg=_fleet_cfg(workers=workers, startup_timeout_s=600.0,
+                             respawn_backoff_s=0.2),
+        lifecycle_cfg=ReplicaLifecycleConfig(enabled=heal,
+                                             probation_initial_s=0.2),
+        canary_vocab=CFG.vocab_size)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+@pytest.mark.parametrize("sp", [GREEDY, SEEDED], ids=["greedy", "seeded"])
+def test_subprocess_fleet_byte_identical_with_migration(
+        tmp_path, tiny_params, kv_dtype, sp):
+    """The acceptance drill: --fleet-workers 2 (real processes) produces
+    byte-identical outputs to --replicas 2 (in-process), greedy and
+    seeded, bf16 and int8 KV — including one cross-process migration."""
+    ref = ReplicatedEngine(CFG, tiny_params, _ec(cache_dtype=kv_dtype),
+                           replicas=2)
+    expect = {tuple(p): r.output_token_ids
+              for p, r in zip(PROMPTS, ref.generate(PROMPTS, sp))}
+
+    sup = _mk_subprocess_fleet(tmp_path, workers=2, cache_dtype=kv_dtype)
+    try:
+        reqs = [sup.submit(p, sp) for p in PROMPTS]
+        for _ in range(120):
+            sup.step()
+            if all(len(r.output_token_ids) >= 2 for r in reqs):
+                break
+        assert all(not r.done for r in reqs)
+        victim = next(w for w in sup._workers if w.owned)
+        errored = sup.drain_replica(victim.idx, kind="preempt",
+                                    quarantine=False)
+        assert errored == []
+        while sup.has_work:
+            sup.step()
+        assert any(r.num_migrations > 0 for r in reqs)
+        for p, r in zip(PROMPTS, reqs):
+            assert r.output_token_ids == expect[tuple(p)], \
+                f"{r.request_id} (migrations={r.num_migrations})"
+            assert r.finish_reason == "length"
+    finally:
+        sup.close()
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_chaos_sigkill_under_load(tmp_path):
+    """Live loadgen against serve-over-fleet; SIGKILL one worker process
+    mid-run. Demands: zero client errors, dlti_fleet_respawns_total >= 1,
+    and federated per-worker /metrics series that sum to the fleet
+    totals (LoadReport.fleet_federation)."""
+    from dlti_tpu.benchmarks import LoadGenConfig, run_load_test
+    from dlti_tpu.data.tokenizer import IdTokenizer
+    from dlti_tpu.serving.server import ServerConfig, make_server
+
+    from dlti_tpu.telemetry.flightrecorder import FlightRecorder, install
+
+    flight_dir = str(tmp_path / "flight")
+    # Supervisor-side recorder: _fail_worker dumps the fault at the dump
+    # root; the worker processes dump under worker{N}/ (spec flight_dir).
+    prev_recorder = install(FlightRecorder(flight_dir))
+    sup = _mk_subprocess_fleet(tmp_path, workers=2, flight_dir=flight_dir)
+    httpd = None
+    try:
+        httpd, async_engine = make_server(
+            sup, IdTokenizer(vocab_size=CFG.vocab_size),
+            ServerConfig(host="127.0.0.1", port=0,
+                         default_params=SamplingParams(max_tokens=8)))
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+
+        kill_done = threading.Event()
+
+        def assassin():
+            # Let traffic build, then SIGKILL a live worker mid-decode.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                victims = [w for w in sup._workers
+                           if w.pid and w.sock is not None and w.owned]
+                if victims:
+                    os.kill(victims[0].pid, signal.SIGKILL)
+                    kill_done.set()
+                    return
+                time.sleep(0.05)
+
+        killer = threading.Thread(target=assassin, daemon=True)
+        killer.start()
+        report = run_load_test(LoadGenConfig(
+            host="127.0.0.1", port=port, num_requests=24, concurrency=4,
+            max_tokens=8, stream=True, prompt="chaos", timeout_s=300,
+            scrape_debug_vars=True))
+        killer.join(timeout=60)
+        assert kill_done.is_set(), "no worker was ever holding work"
+
+        # Zero client errors through the kill + respawn.
+        assert report.num_ok == report.num_requests, report.errors
+        assert report.errors == []
+
+        # The killed worker respawned.
+        deadline = time.monotonic() + 120
+        while sup._respawns < 1 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert sup._respawns >= 1
+        assert fleet.respawns_total.value >= 1
+
+        # Federation: per-worker series were scraped and sum to totals.
+        fed = report.fleet_federation
+        assert fed, "fleet federation block missing from LoadReport"
+        assert sorted(fed["workers"]) == [0, 1]
+        assert fed["consistent"], fed["checks"]
+        assert fed["respawns_total"] >= 1
+
+        # Satellite: postmortem --all merges the per-worker dump tree
+        # (the SIGKILL'd worker's supervisor-side dump is at the root).
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "scripts"))
+        try:
+            import postmortem
+            dumps = postmortem.discover_dumps(flight_dir)
+        finally:
+            sys.path.pop(0)
+        assert dumps, "worker fault must leave a flight dump"
+    finally:
+        install(prev_recorder)
+        if httpd is not None:
+            httpd.shutdown()
+            async_engine.shutdown()
+            httpd.server_close()
+        sup.close()

@@ -39,9 +39,11 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.serving.disagg import (
         KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
     )
+    from dlti_tpu.serving.fleet import FLEET_METRIC_NAMES
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
     from dlti_tpu.serving.lifecycle import LIFECYCLE_METRIC_NAMES
     from dlti_tpu.serving.prefix_cache import PREFIX_CACHE_METRIC_NAMES
+    from dlti_tpu.serving.wire import WIRE_METRIC_NAMES
     from dlti_tpu.telemetry import (
         FLIGHT_METRIC_NAMES, LEDGER_METRIC_NAMES,
         REQUEST_PHASE_METRIC_NAMES, SLO_METRIC_NAMES,
@@ -73,13 +75,15 @@ def test_pinned_name_tuples_follow_convention():
                        (POOL_METRIC_NAMES, "disagg-pools"),
                        (KV_HANDOFF_METRIC_NAMES, "kv-handoff"),
                        (ADAPTER_METRIC_NAMES, "adapters"),
-                       (LIFECYCLE_METRIC_NAMES, "lifecycle")):
+                       (LIFECYCLE_METRIC_NAMES, "lifecycle"),
+                       (WIRE_METRIC_NAMES, "wire"),
+                       (FLEET_METRIC_NAMES, "fleet")):
         _assert_convention(tup, where)
 
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
-    from dlti_tpu.serving import adapters, lifecycle
+    from dlti_tpu.serving import adapters, fleet, lifecycle, wire
     from dlti_tpu.telemetry import (
         flightrecorder, ledger, memledger, slo, watchdog,
     )
@@ -90,6 +94,8 @@ def test_module_level_metric_objects_follow_convention():
             lifecycle.flaps_total, lifecycle.migrations_total,
             lifecycle.migration_fallbacks_total,
             lifecycle.replica_state_gauge,
+            wire.frames_total, wire.wire_bytes_total,
+            fleet.workers_alive_gauge, fleet.respawns_total,
             adapters.loads_total, adapters.evictions_total,
             adapters.pool_hits_total, adapters.pool_misses_total,
             adapters.pool_slots_gauge, adapters.pool_bytes_gauge,

@@ -14,7 +14,6 @@ Three contracts pinned here:
 """
 
 import json
-import socket
 import sys
 import threading
 
@@ -25,15 +24,7 @@ from dlti_tpu.benchmarks.traces import (
     GENERATORS, TRACE_FORMAT, TraceEvent, main as traces_main, read_trace,
     synthesize, trace_summary, write_trace,
 )
-
-
-def _free_dead_port() -> int:
-    """A port nothing is listening on (bind, read it off, close)."""
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from dlti_tpu.serving.wire import ephemeral_port as _free_dead_port
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,418 @@
+"""Fleet wire-protocol tests: serializer round-trips, frame integrity,
+and malformed-input robustness (ISSUE 17 satellite).
+
+Every failure mode gets a dedicated exception so the supervisor can tell
+"peer died mid-frame" (fail over) from "peer spoke garbage" (evict); these
+tests pin that taxonomy and the byte-exactness of the serializer the
+paged-KV handoff envelope rides on.
+"""
+
+import hashlib
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlti_tpu.serving import wire
+from dlti_tpu.serving.engine import Request
+from dlti_tpu.serving.sampling import SamplingParams
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+# -- tagged serializer -------------------------------------------------------
+
+@pytest.mark.parametrize("obj", [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    (1 << 62),
+    -(1 << 63),            # int64 min boundary
+    (1 << 63) - 1,         # int64 max boundary
+    (1 << 80),             # bigint path
+    -(1 << 100),
+    3.25,
+    float("inf"),
+    "",
+    "héllo wörld",
+    b"",
+    b"\x00\xff raw",
+    [],
+    [1, "two", 3.0, None],
+    (4, 5, (6,)),
+    {},
+    {"k": [1, 2], "nested": {"t": (True, False), "b": b"x"}},
+])
+def test_pack_obj_roundtrip(obj):
+    out = wire.unpack_obj(wire.pack_obj(obj))
+    assert out == obj
+    assert type(out) is type(obj)
+
+
+def test_pack_obj_nan_roundtrip():
+    out = wire.unpack_obj(wire.pack_obj(float("nan")))
+    assert isinstance(out, float) and out != out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8", "uint32",
+                                   "float64", "int64"])
+def test_ndarray_roundtrip_byte_exact(dtype):
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        import jax.numpy as jnp  # bfloat16 registers via ml_dtypes
+
+        dt = jnp.bfloat16
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 100, size=(3, 4, 5)).astype(dt)
+    out = wire.unpack_obj(wire.pack_obj(arr))
+    assert isinstance(out, np.ndarray)
+    assert out.dtype == arr.dtype
+    assert out.shape == arr.shape
+    assert out.tobytes() == arr.tobytes()
+
+
+def test_ndarray_zero_dim_and_empty():
+    scalar = np.float32(7.5)  # np.generic packs as its python item
+    assert wire.unpack_obj(wire.pack_obj(scalar)) == 7.5
+    empty = np.zeros((0, 4), np.int32)
+    out = wire.unpack_obj(wire.pack_obj(empty))
+    assert out.shape == (0, 4) and out.dtype == np.int32
+
+
+def test_ndarray_noncontiguous_packs_c_order():
+    arr = np.arange(24, dtype=np.int32).reshape(4, 6)[:, ::2]
+    out = wire.unpack_obj(wire.pack_obj(arr))
+    assert np.array_equal(out, arr)
+
+
+def test_pack_obj_rejects_unserializable():
+    with pytest.raises(TypeError):
+        wire.pack_obj(object())
+    with pytest.raises(TypeError):
+        wire.pack_obj({1, 2, 3})
+
+
+def test_unpack_obj_unknown_tag():
+    with pytest.raises(wire.WireError, match="unknown tag"):
+        wire.unpack_obj(b"Z")
+
+
+def test_unpack_obj_trailing_bytes():
+    data = wire.pack_obj(42) + b"junk"
+    with pytest.raises(wire.WireError, match="trailing"):
+        wire.unpack_obj(data)
+
+
+def test_unpack_obj_truncated_payload():
+    data = wire.pack_obj("hello world")
+    with pytest.raises(wire.WireError):
+        wire.unpack_obj(data[:4])
+
+
+# -- frame I/O ---------------------------------------------------------------
+
+def test_frame_roundtrip():
+    a, b = _pair()
+    try:
+        payload = wire.pack_obj({"x": [1, 2, 3], "arr": np.arange(8)})
+        wire.send_frame(a, wire.FT_STEP, payload)
+        ftype, got = wire.recv_frame(b)
+        assert ftype == wire.FT_STEP
+        assert got == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_empty_payload():
+    a, b = _pair()
+    try:
+        wire.send_frame(a, wire.FT_HEALTH)
+        ftype, got = wire.recv_frame(b)
+        assert ftype == wire.FT_HEALTH and got == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_bad_magic():
+    a, b = _pair()
+    try:
+        a.sendall(b"HTTP" + wire._HEADER.pack(
+            wire.MAGIC, wire.WIRE_VERSION, wire.FT_OK, 0)[4:])
+        with pytest.raises(wire.WireBadMagic):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_version_mismatch():
+    a, b = _pair()
+    try:
+        a.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION + 1,
+                                    wire.FT_OK, 0))
+        with pytest.raises(wire.WireVersionMismatch):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_too_large():
+    a, b = _pair()
+    try:
+        a.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                    wire.FT_OK, 1 << 30))
+        with pytest.raises(wire.WireFrameTooLarge):
+            wire.recv_frame(b, max_frame_bytes=1024)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_digest_mismatch():
+    a, b = _pair()
+    try:
+        payload = wire.pack_obj({"adopt": "me"})
+        digest = hashlib.sha256(payload).digest()[:wire._DIGEST_BYTES]
+        corrupted = bytearray(payload)
+        corrupted[0] ^= 0xFF  # bit-flip after the digest was computed
+        a.sendall(wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                    wire.FT_ADOPT, len(payload))
+                  + bytes(corrupted) + digest)
+        with pytest.raises(wire.WireDigestMismatch):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_closed_at_boundary():
+    a, b = _pair()
+    a.close()
+    try:
+        with pytest.raises(wire.WireClosed):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_recv_peer_death_mid_frame():
+    a, b = _pair()
+    try:
+        payload = wire.pack_obj([1] * 100)
+        frame = (wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION,
+                                   wire.FT_STEP, len(payload))
+                 + payload)
+        a.sendall(frame[:len(frame) // 2])  # half a frame, then die
+        a.close()
+        with pytest.raises(wire.WireTruncated):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_recv_truncated_header():
+    a, b = _pair()
+    try:
+        a.sendall(b"DLT")  # less than one header
+        a.close()
+        with pytest.raises(wire.WireTruncated):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_send_frame_on_dead_socket():
+    a, b = _pair()
+    b.close()
+    try:
+        with pytest.raises(wire.WireTruncated):
+            # Loopback buffering may swallow one send; a big payload and a
+            # second attempt guarantee the broken pipe surfaces.
+            payload = b"x" * (1 << 22)
+            wire.send_frame(a, wire.FT_STEP, payload)
+            wire.send_frame(a, wire.FT_STEP, payload)
+    finally:
+        a.close()
+
+
+def test_request_reply_ok_and_remote_error():
+    a, b = _pair()
+
+    def peer():
+        ftype, payload = wire.recv_frame(b)
+        assert ftype == wire.FT_HEALTH
+        wire.send_frame(b, wire.FT_OK, wire.pack_obj({"ok": True}))
+        wire.recv_frame(b)
+        wire.send_frame(b, wire.FT_ERROR,
+                        wire.pack_obj({"error": "handler exploded"}))
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    try:
+        assert wire.request_reply(a, wire.FT_HEALTH, None) == {"ok": True}
+        with pytest.raises(wire.WireRemoteError, match="handler exploded"):
+            wire.request_reply(a, wire.FT_STEP, {"cancels": []})
+    finally:
+        t.join(timeout=5)
+        a.close()
+        b.close()
+
+
+def test_wire_metrics_count_frames():
+    def frames_sum():
+        return sum(c.value for _, _, c in wire.frames_total.samples())
+
+    base_frames = frames_sum()
+    base_health = wire.frames_total.labels(kind="health").value
+    base_bytes = wire.wire_bytes_total.value
+    a, b = _pair()
+    try:
+        wire.send_frame(a, wire.FT_HEALTH, b"abc")
+        wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    assert frames_sum() == base_frames + 1
+    assert wire.frames_total.labels(kind="health").value == base_health + 1
+    assert (wire.wire_bytes_total.value - base_bytes
+            == wire._HEADER.size + 3 + wire._DIGEST_BYTES)
+
+
+# -- request descriptor ------------------------------------------------------
+
+def _mk_request():
+    req = Request(
+        request_id="req-42",
+        prompt_token_ids=[5, 6, 7],
+        params=SamplingParams(max_tokens=16, temperature=0.5, top_k=10,
+                              top_p=0.9, seed=123, logprobs=True,
+                              stop_token_ids=(99,)),
+        arrival_time=time.monotonic(),
+    )
+    req.output_token_ids = [8, 9]
+    req.output_logprobs = [-0.5, -1.25]
+    req.num_preemptions = 1
+    req.num_retries = 2
+    req.num_migrations = 3
+    req.tenant = "acme"
+    req.adapter = "lora-a"
+    return req
+
+
+def test_request_descriptor_roundtrip():
+    req = _mk_request()
+    out = wire.request_from_wire(wire.request_to_wire(req))
+    assert out.request_id == req.request_id
+    assert out.prompt_token_ids == req.prompt_token_ids
+    assert out.output_token_ids == req.output_token_ids
+    assert out.output_logprobs == req.output_logprobs
+    for f in wire._PARAM_FIELDS:
+        assert getattr(out.params, f) == getattr(req.params, f), f
+    assert out.params.stop_token_ids == (99,)
+    assert out.num_preemptions == 1
+    assert out.num_retries == 2
+    assert out.num_migrations == 3
+    assert out.tenant == "acme"
+    assert out.adapter == "lora-a"
+    assert not out.done
+
+
+def test_request_descriptor_survives_wire_serialization():
+    d = wire.request_to_wire(_mk_request())
+    out = wire.request_from_wire(wire.unpack_obj(wire.pack_obj(d)))
+    assert out.output_token_ids == [8, 9]
+    assert out.params.seed == 123
+
+
+# -- handoff envelope --------------------------------------------------------
+
+def _mk_snap():
+    return {
+        "request": _mk_request(),
+        "payloads": [{"l00000": {"k": np.ones((2, 3), np.float32),
+                                 "v": np.zeros((2, 3), np.float32)}}],
+        "seq_len": 5,
+        "last_token": 9,
+        "slot_key": np.array([11, 22], np.uint32),
+        "gen_count": 2,
+    }
+
+
+def test_handoff_roundtrip_byte_exact():
+    snap = _mk_snap()
+    out = wire.unpack_handoff(wire.pack_handoff(snap))
+    assert out["seq_len"] == 5 and out["last_token"] == 9
+    assert out["gen_count"] == 2
+    assert out["slot_key"].tobytes() == snap["slot_key"].tobytes()
+    kv = out["payloads"][0]["l00000"]
+    assert kv["k"].tobytes() == snap["payloads"][0]["l00000"]["k"].tobytes()
+    assert out["request"].request_id == "req-42"
+    assert out["request"].output_token_ids == [8, 9]
+
+
+def test_handoff_version_mismatch():
+    env = wire.pack_obj({"v": wire.HANDOFF_VERSION + 1, "kind": "kv-handoff",
+                         "snap": {}})
+    with pytest.raises(wire.WireVersionMismatch):
+        wire.unpack_handoff(env)
+
+
+def test_handoff_wrong_kind_or_shape():
+    with pytest.raises(wire.WireError):
+        wire.unpack_handoff(wire.pack_obj({"v": 1, "kind": "weights"}))
+    with pytest.raises(wire.WireError):
+        wire.unpack_handoff(wire.pack_obj([1, 2, 3]))
+
+
+# -- shared helpers ----------------------------------------------------------
+
+def test_ephemeral_port_is_bindable():
+    port = wire.ephemeral_port()
+    assert 1024 <= port <= 65535
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+
+
+def test_connect_with_retry_times_out_cleanly():
+    port = wire.ephemeral_port()
+    t0 = time.monotonic()
+    with pytest.raises(wire.WireError, match="could not connect"):
+        wire.connect_with_retry("127.0.0.1", port, timeout_s=0.3,
+                                interval_s=0.05)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_connect_with_retry_waits_for_listener():
+    port = wire.ephemeral_port()
+    accepted = []
+
+    def late_listener():
+        time.sleep(0.2)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        accepted.append(True)
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=late_listener, daemon=True)
+    t.start()
+    sock = wire.connect_with_retry("127.0.0.1", port, timeout_s=5.0)
+    sock.close()
+    t.join(timeout=5)
+    assert accepted == [True]
