@@ -1,18 +1,33 @@
-"""Make speculation win (VERDICT r03 #6): measure the fused n-gram
-speculative path on its FAVORABLE workload — repetitive/code-like text,
-greedy, engine-direct, long outputs — vs plain multi-step decode at the
-same steps_per_sync, and report tokens/s over >= 3 runs each.
+"""Adaptive speculative decoding A/B: favorable AND adversarial traces,
+plus the ragged multi-admission prefill TTFT wave.
+
+Methodology fixes over the r03 version (whose committed artifact
+recorded a 0.103 "speedup"): the measured window previously included
+XLA compiles — run 1 of the plain arm compiled the decode ladder
+mid-measurement and the spec arm compiled a fresh draft-length rung
+mid-run-2, so the medians compared compile time, not decode time. Every
+arm now runs its FULL measured workload once before timing (compiling
+prefill buckets, the decode ladder, and every spec-k rung the per-slot
+controller will visit), reports the median of >= 3 measured runs, and
+asserts byte-identical outputs against the plain-greedy reference
+before a single number is written.
+
+Traces:
+
+* **favorable** — prompts whose greedy continuation locks into a short
+  loop (repetitive/code-template shape): the n-gram proposer gets long
+  accepted prefixes and the ladder stays at the top rung.
+* **adversarial** — prompts whose continuation wanders: near-zero
+  acceptance, so the per-slot gate pauses speculation and the ladder
+  collapses toward k=1; the claim is bounded overhead, not a win.
+* **ragged wave** — a burst of mixed-length admissions, prefill TTFT
+  p99 with ragged packing on vs off at byte-identical outputs.
 
 Usage:
-  python benchmarks_dev/spec_win.py                 # real chip, 300M export
-  python benchmarks_dev/spec_win.py --cpu           # CPU, llama_tiny (mechanism check)
-  python benchmarks_dev/spec_win.py --export exports/glaive_300m
-
-The favorable construction: prompts containing repeated boilerplate
-blocks (the shape of real config/code templating), greedy sampling, long
-outputs. A trained model continues the repetition, so the on-device
-n-gram prompt-lookup proposer gets long accepted prefixes; the adaptive
-gate never engages. Writes results/speculative_win.json (or _cpu variant).
+  python benchmarks_dev/spec_win.py --cpu            # llama_tiny check
+  python benchmarks_dev/spec_win.py                  # real chip, export
+  python benchmarks_dev/spec_win.py --cpu --runs 1 --max-tokens 48 \
+      --wave 8 --json-out /tmp/x.json                # CI smoke shape
 """
 
 import argparse
@@ -35,14 +50,19 @@ def main():
     ap.add_argument("--max-tokens", type=int, default=160)
     ap.add_argument("--sync", type=int, default=8)
     ap.add_argument("--draft", type=int, default=6)
+    ap.add_argument("--wave", type=int, default=24,
+                    help="requests in the ragged-prefill admission wave")
+    ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
     import jax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
 
     from dlti_tpu.config import MODEL_PRESETS
     from dlti_tpu.models import LlamaForCausalLM
@@ -65,20 +85,25 @@ def main():
         cfg = full_cfg.model
         tok = ByteTokenizer()
 
-    # Repetitive, code-shaped prompts: boilerplate blocks the greedy
-    # continuation keeps extending (prompt-lookup heaven).
     if tok is None:
-        # token-id world for the tiny model: a strict 8-token cycle
-        base = [11, 12, 13, 14, 15, 16, 17, 18]
-        prompts = [(base * 6)[:48] for _ in range(4)]
+        # llama_tiny's greedy continuation of [6,6,7,7,...] is a
+        # period-1 loop (prompt-lookup heaven); the adversarial prompts
+        # wander through distinct tokens for many rounds.
+        favorable = [([6, 6, 7, 7] * 4)[: 8 + i] for i in range(4)]
+        adversarial = [[2, 7, 1, 8, 2, 8], [11, 13, 17, 19, 23],
+                       [10, 20, 30, 40, 50, 60], [19, 28, 37, 46, 55]]
     else:
         block = ("def check_{i}(value):\n"
                  "    if value is None:\n"
                  "        return default\n"
                  "    return transform(value)\n\n")
-        texts = ["".join(block.replace("{i}", str(i)) for i in range(4))
-                 for _ in range(4)]
-        prompts = [tok.encode(t)[:512] for t in texts]
+        favorable = [tok.encode("".join(
+            block.replace("{i}", str(i)) for i in range(4)))[:512]
+            for _ in range(4)]
+        prose = ("the quarterly throughput review considered seventeen "
+                 "distinct mitigation strategies across regions, none "
+                 "repeated verbatim anywhere in the corpus; ")
+        adversarial = [tok.encode(prose * (3 + i))[:256] for i in range(4)]
 
     def build(spec: bool):
         ec = EngineConfig(
@@ -92,54 +117,121 @@ def main():
         )
         return InferenceEngine(cfg, params, ec)
 
-    def measure(spec: bool):
+    sp = SamplingParams(temperature=0.0, max_tokens=args.max_tokens)
+
+    def measure(spec: bool, prompts):
         eng = build(spec)
-        sp = SamplingParams(temperature=0.0, max_tokens=args.max_tokens)
-        rates, toks = [], None
-        # warmup (compile): decode ladder + spec program + prefill buckets
+        # Compile warmup OUTSIDE the measured window: the decode ladder,
+        # prefill buckets, and — by running the full measured workload
+        # once — every spec-k rung the adaptive controller will visit.
         eng.warmup_decode_ladder()
-        eng.generate([p[:16] for p in prompts], SamplingParams(
-            temperature=0.0, max_tokens=args.sync * (args.draft + 1) + 2))
-        eng.generate(prompts, SamplingParams(
-            temperature=0.0, max_tokens=args.sync * (args.draft + 1) + 2))
+        eng.generate(prompts, sp)
+        rates, toks = [], None
         for _ in range(args.runs):
             t0 = time.perf_counter()
             res = eng.generate(prompts, sp)
             dt = time.perf_counter() - t0
             n = sum(len(r.output_token_ids) for r in res)
             rates.append(n / dt)
-            toks = [r.output_token_ids for r in res]
-        st = dict(eng.stats)
-        return rates, toks, st
+            run_toks = [r.output_token_ids for r in res]
+            assert toks is None or run_toks == toks, "non-deterministic run"
+            toks = run_toks
+        return rates, toks, dict(eng.stats)
 
-    plain_rates, plain_toks, plain_st = measure(False)
-    spec_rates, spec_toks, spec_st = measure(True)
-    assert spec_toks == plain_toks, "speculation changed greedy outputs"
+    def trace(name, prompts):
+        plain_rates, plain_toks, _ = measure(False, prompts)
+        spec_rates, spec_toks, st = measure(True, prompts)
+        # Per-arm outputs-equal assert BEFORE any number is reported.
+        assert spec_toks == plain_toks, \
+            f"{name}: speculation changed greedy outputs"
+        med_p = statistics.median(plain_rates)
+        med_s = statistics.median(spec_rates)
+        acc = (st["spec_accepted"] / st["spec_proposed"]
+               if st.get("spec_proposed") else 0.0)
+        return {
+            "plain_tok_s_all": [round(r, 1) for r in plain_rates],
+            "spec_tok_s_all": [round(r, 1) for r in spec_rates],
+            "plain_tok_s_median": round(med_p, 1),
+            "spec_tok_s_median": round(med_s, 1),
+            "speedup": round(med_s / med_p, 3),
+            "draft_acceptance": round(acc, 3),
+            "spec_paused_rounds": st.get("spec_paused_rounds", 0),
+            "outputs_equal": True,
+        }
 
-    med_p = statistics.median(plain_rates)
-    med_s = statistics.median(spec_rates)
-    acc = (spec_st["spec_accepted"] / spec_st["spec_proposed"]
-           if spec_st.get("spec_proposed") else 0.0)
+    # ------------------------------------------------------------------
+    # Ragged multi-admission prefill: TTFT over an admission wave
+    # ------------------------------------------------------------------
+    # Lengths straddling four pow2 buckets: under a chunked-prefill token
+    # budget every step carries chunks from several admissions in several
+    # buckets — the bucketed path pays one program call per bucket per
+    # step, ragged packing merges them, so each step (and therefore every
+    # queued request's first token) lands sooner.
+    rng = np.random.RandomState(0)
+    wave_lens = [(5, 9, 17, 33)[i % 4] for i in range(args.wave)]
+    wave_prompts = [
+        [int(t) for t in rng.randint(2, cfg.vocab_size - 2, size=n)]
+        for n in wave_lens]
+    wave_sp = SamplingParams(temperature=0.0, max_tokens=8)
+
+    def ttft_wave(ragged: bool):
+        ec = EngineConfig(
+            max_seqs=max(8, args.wave), block_size=16, num_blocks=512,
+            max_model_len=128, eos_token_id=-1,
+            cache_dtype="float32" if args.cpu else "bfloat16",
+            max_prefill_tokens_per_step=64,
+            ragged_prefill=ragged)
+        eng = InferenceEngine(cfg, params, ec)
+        eng.generate(wave_prompts, wave_sp)  # compile warmup
+        p99s, p50s, toks = [], [], None
+        for _ in range(args.runs):
+            reqs = [eng.submit(p, wave_sp) for p in wave_prompts]
+            first = {}
+            t0 = time.perf_counter()
+            while eng.has_work:
+                eng.step()
+                now = time.perf_counter()
+                for r in reqs:
+                    if r.output_token_ids and r.request_id not in first:
+                        first[r.request_id] = now - t0
+            lat = sorted(first.values())
+            p99s.append(float(np.percentile(lat, 99)))
+            p50s.append(float(np.percentile(lat, 50)))
+            toks = [r.output_token_ids for r in reqs]
+        return (statistics.median(p99s), statistics.median(p50s), toks,
+                eng.stats["prefill_batches"])
+
+    p99_off, p50_off, toks_off, batches_off = ttft_wave(False)
+    p99_on, p50_on, toks_on, batches_on = ttft_wave(True)
+    assert toks_on == toks_off, "ragged packing changed outputs"
+
     out = {
-        "what": "speculation on its favorable workload (repetitive "
-                "code-shaped prompts, greedy, engine-direct, long outputs) "
-                "vs plain multi-step at the same steps_per_sync",
+        "what": "adaptive speculation (per-slot gate + draft-length "
+                "ladder) vs plain multi-step at the same steps_per_sync, "
+                "on favorable AND adversarial traces; plus ragged "
+                "multi-admission prefill TTFT",
         "platform": "cpu/llama_tiny" if args.cpu else f"tpu/{args.export}",
         "steps_per_sync": args.sync, "num_draft_tokens": args.draft,
         "max_tokens": args.max_tokens, "runs": args.runs,
-        "plain_tok_s_all": [round(r, 1) for r in plain_rates],
-        "spec_tok_s_all": [round(r, 1) for r in spec_rates],
-        "plain_tok_s_median": round(med_p, 1),
-        "spec_tok_s_median": round(med_s, 1),
-        "speedup": round(med_s / med_p, 3),
-        "outputs_identical": True,
-        "draft_acceptance": round(acc, 3),
-        "decode_rounds_plain": plain_st["decode_steps"],
-        "decode_rounds_spec": spec_st["decode_steps"],
-        "date": "2026-08-01",
+        "favorable": trace("favorable", favorable),
+        "adversarial": trace("adversarial", adversarial),
+        "ragged_prefill": {
+            "wave_requests": args.wave,
+            "ttft_p99_s_off": round(p99_off, 4),
+            "ttft_p99_s_on": round(p99_on, 4),
+            "ttft_p50_s_off": round(p50_off, 4),
+            "ttft_p50_s_on": round(p50_on, 4),
+            "prefill_batches_off": batches_off,
+            "prefill_batches_on": batches_on,
+            "outputs_equal": True,
+        },
+        "date": time.strftime("%Y-%m-%d"),
     }
-    name = ("results/speculative_win_cpu.json" if args.cpu
-            else "results/speculative_win.json")
+    out["outputs_equal"] = (out["favorable"]["outputs_equal"]
+                            and out["adversarial"]["outputs_equal"]
+                            and out["ragged_prefill"]["outputs_equal"])
+    name = args.json_out or ("results/spec_adaptive_cpu.json" if args.cpu
+                             else "results/spec_adaptive.json")
     with open(name, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out, indent=1))

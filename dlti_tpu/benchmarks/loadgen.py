@@ -251,6 +251,13 @@ class LoadReport:
     # the fleet liveness/respawn counters}. Empty against a
     # single-process server.
     fleet_federation: dict = field(default_factory=dict)
+    # Speculative-decode economics scraped from /metrics at run end
+    # (engine.SPEC_METRIC_NAMES): proposed/accepted draft-token totals,
+    # paused slot-rounds, the cumulative acceptance rate, and the draft
+    # length the adaptive ladder last dispatched. All zeros against a
+    # server running without --speculative (the series are schema-stable
+    # and always exposed); {} only when the scrape itself fails.
+    spec: dict = field(default_factory=dict)
     # SLO cross-check (telemetry.slo via GET /debug/slo): the server's
     # per-(objective, class) compliance / error-budget / breaching state
     # at run end, the client's own compliance recomputed from this run's
@@ -864,6 +871,50 @@ def _fleet_federation_report(metrics_text: str) -> dict:
     }
 
 
+async def _scrape_spec(cfg: LoadGenConfig) -> dict:
+    """LoadReport.spec from the server's /metrics exposition: the five
+    schema-stable speculative-decode series (dlti_spec_*_total counters
+    plus the acceptance-rate / draft-length gauges), reported under
+    short keys. Best-effort like every scrape — {} on any failure."""
+    names = {
+        "dlti_spec_proposed_total": "proposed",
+        "dlti_spec_accepted_total": "accepted",
+        "dlti_spec_paused_rounds_total": "paused_rounds",
+        "dlti_spec_acceptance_rate": "acceptance_rate",
+        "dlti_spec_draft_len": "draft_len",
+    }
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(cfg.host, cfg.port), 10.0)
+        req = (f"GET /metrics HTTP/1.1\r\nHost: {cfg.host}:{cfg.port}\r\n"
+               f"Connection: close\r\n\r\n").encode()
+        writer.write(req)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(), 10.0)
+        if b" 200" not in status_line:
+            return {}
+        headers: dict = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 10.0)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = b"".join([c async for c in _iter_body(reader, headers, 10.0)])
+        writer.close()
+    except Exception:
+        return {}
+    out: dict = {}
+    for line in raw.decode(errors="replace").splitlines():
+        name, _, value = line.partition(" ")
+        if name in names:
+            try:
+                out[names[name]] = float(value)
+            except ValueError:
+                pass
+    return out
+
+
 async def _scrape_fleet_federation(cfg: LoadGenConfig) -> dict:
     """GET /metrics and run the fleet federation cross-check.
     Best-effort like every scrape: {} on any failure or against a
@@ -1072,6 +1123,10 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     # the same best-effort gate; {} against a single-process server.
     fleet_federation = (await _scrape_fleet_federation(cfg)
                         if cfg.scrape_debug_vars else {})
+    # End-of-run speculative-decode economics (engine spec scalar
+    # source) — same best-effort gate; all-zero values against a server
+    # running without --speculative.
+    spec = (await _scrape_spec(cfg) if cfg.scrape_debug_vars else {})
     slo = (_slo_report(slo_snap, records)
            if slo_snap and slo_snap.get("objectives") else {})
     memory = {}
@@ -1162,6 +1217,7 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         memory=memory,
         slo=slo,
         fleet_federation=fleet_federation,
+        spec=spec,
     )
 
 

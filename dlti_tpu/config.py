@@ -717,6 +717,38 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class SpeculativeConfig:
+    """Adaptive speculative decoding (``dlti_tpu.serving.engine``): the
+    n-gram prompt-lookup draft path plus its per-slot adaptive
+    controller (acceptance-gated cooldowns and the pow2 draft-length
+    ladder). Field names mirror the ``EngineConfig`` ``spec_*`` fields;
+    :meth:`engine_kwargs` is the plumbing that applies the block to an
+    engine build (``scripts/serve.py`` flags override it per run). Off
+    by default — ``mode="none"`` keeps decode byte-identical to an
+    engine that never compiled a spec program."""
+
+    mode: str = "none"                 # "none" | "ngram"
+    num_draft_tokens: int = 4
+    ngram_size: int = 2
+    adaptive: bool = True
+    min_acceptance: float = 0.25
+    probe_window: int = 64
+    cooldown: int = 32
+
+    def engine_kwargs(self) -> dict:
+        """EngineConfig constructor kwargs for this block."""
+        return {
+            "speculative": self.mode,
+            "num_draft_tokens": self.num_draft_tokens,
+            "ngram_size": self.ngram_size,
+            "spec_adaptive": self.adaptive,
+            "spec_min_acceptance": self.min_acceptance,
+            "spec_probe_window": self.probe_window,
+            "spec_cooldown": self.cooldown,
+        }
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
@@ -727,6 +759,7 @@ class ServingConfig:
     lifecycle: ReplicaLifecycleConfig = field(
         default_factory=ReplicaLifecycleConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
 
 
 @dataclass(frozen=True)
@@ -778,7 +811,7 @@ class Config:
                     "model", "lora", "optimizer", "parallel", "data",
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
-                    "disagg", "lifecycle", "slo", "fleet",
+                    "disagg", "lifecycle", "slo", "fleet", "speculative",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -794,6 +827,7 @@ class Config:
                         "lifecycle": ReplicaLifecycleConfig,
                         "slo": SLOConfig,
                         "fleet": FleetConfig,
+                        "speculative": SpeculativeConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -47,6 +47,18 @@ from dlti_tpu.telemetry.memledger import (
 )
 from dlti_tpu.utils.logging import get_logger
 
+# Speculative-decode /metrics names (registered by server.build_registry's
+# spec scalar source; the engine's stats dict stays the source of truth).
+# Name-stability contract — external dashboards scrape these; pinned in
+# tests/test_bench_contract.py.
+SPEC_METRIC_NAMES = (
+    "dlti_spec_proposed_total",
+    "dlti_spec_accepted_total",
+    "dlti_spec_paused_rounds_total",
+    "dlti_spec_acceptance_rate",
+    "dlti_spec_draft_len",
+)
+
 
 @dataclass
 class EngineConfig:
@@ -126,6 +138,24 @@ class EngineConfig:
     spec_min_acceptance: float = 0.25
     spec_probe_window: int = 64
     spec_cooldown: int = 32
+    # Draft-length ladder: compile spec programs for the pow2 halving
+    # ladder of k (num_draft_tokens, /2, ..., 1) and pick the dispatch k
+    # each engine round from the live per-slot acceptance windows —
+    # shorter drafts on text where prompt lookup barely lands, full-k on
+    # repetitive text. Greedy exactness holds at every k (an accepted
+    # prefix under smaller k is a prefix of the full-k acceptance), so
+    # this only trades verify-forward width for wasted lanes. False pins
+    # dispatch at k=num_draft_tokens (the pre-ladder behavior).
+    spec_adaptive: bool = True
+    # Ragged multi-admission prefill: instead of grouping prefill chunks
+    # by their own pow2 bucket (each group padded to its widest member's
+    # bucket), pack chunks from many admissions FCFS into shared groups
+    # bounded by padded total tokens — one prefill call advances several
+    # admissions. Rows keep their own block tables and last-token
+    # indices, so outputs are byte-identical ragged on/off; the win is
+    # fewer program dispatches (and fewer distinct jit specializations)
+    # under a multi-admission wave.
+    ragged_prefill: bool = False
     # Device-resident decode state (dlti_tpu.serving.decode_state): block
     # tables, slot keys, gen counts, and sampling params live as
     # persistent device arrays maintained incrementally with per-slot
@@ -457,6 +487,13 @@ class EngineExecutor:
         self._spec_fn = (
             self._build_spec_decode_fn(ec.num_draft_tokens, self._spec_rounds)
             if ec.speculative == "ngram" else None)
+        # Draft-length ladder (spec_adaptive): one spec program per pow2 k
+        # on the halving ladder, compiled lazily on first dispatch at that
+        # k. The max-k program above is eagerly built (it doubles as the
+        # "speculation is on" sentinel) and seeds the ladder dict.
+        self._spec_fns: Dict[int, callable] = (
+            {ec.num_draft_tokens: self._spec_fn}
+            if self._spec_fn is not None else {})
         if ec.speculative not in ("none", "ngram"):
             raise ValueError(f"unknown speculative mode {ec.speculative!r}")
         self._sample_fn = jax.jit(sample_tokens)
@@ -691,9 +728,9 @@ class EngineExecutor:
             return jnp.where(ok, drafts, -1)
 
         @partial(jax.jit, donate_argnums=(1,))
-        def spec_decode(params, cache_kv, hist, t_in, seq_len, block_tables,
-                        slot_keys, gen_counts, temperature, top_k, top_p,
-                        *lora):
+        def spec_decode(params, cache_kv, hist, t_in, seq_len, spec_mask,
+                        block_tables, slot_keys, gen_counts, temperature,
+                        top_k, top_p, *lora):
             S = t_in.shape[0]
             rows = jnp.arange(S)
             is_greedy = temperature == 0.0
@@ -702,6 +739,10 @@ class EngineExecutor:
                 cache, hist, t_in, seq_len, cnt = carry
                 hist = hist.at[rows, seq_len].set(t_in)
                 drafts = propose(hist, seq_len)                  # (S, k)
+                # Per-slot gate: a paused slot's draft is forced to the
+                # all-(-1) no-hit form, degrading just that slot to
+                # single-step while its neighbors keep speculating.
+                drafts = jnp.where(spec_mask[:, None], drafts, -1)
                 ids = jnp.concatenate(
                     [t_in[:, None], jnp.maximum(drafts, 0)], axis=1)
                 pos = seq_len[:, None] + jnp.arange(k + 1)[None, :]
@@ -741,6 +782,16 @@ class EngineExecutor:
                     emit.T, prop.T, acc.T)
 
         return spec_decode
+
+    def spec_fn(self, k: int):
+        """The spec program for draft length ``k`` (pow2 halving-ladder
+        member), compiled lazily on first dispatch at that k — the same
+        bounded-variants pattern as ``_multi_decode_fns``."""
+        fn = self._spec_fns.get(k)
+        if fn is None:
+            fn = self._build_spec_decode_fn(k, self._spec_rounds)
+            self._spec_fns[k] = fn
+        return fn
 
     # -- paged-KV block transport (tier demotion + disagg handoff) -----
     def fetch_block_kv(self, block: int):
@@ -888,9 +939,23 @@ class InferenceEngine:
         self._spec_hist = (
             np.zeros((ec.max_seqs, self._spec_hist_width), np.int32)
             if ec.speculative == "ngram" else None)
-        self._spec_pause = 0      # decode rounds left in adaptive cooldown
-        self._spec_win_prop = 0   # proposals since last gate decision
-        self._spec_win_acc = 0    # acceptances since last gate decision
+        # Per-slot adaptive controller (replaces the old engine-wide
+        # _spec_pause): each slot carries its own rolling acceptance
+        # window and cooldown, so one zero-hit slot pauses alone while
+        # its batchmates keep speculating. prop/acc count slot-rounds and
+        # extra accepted tokens since that slot's last gate decision;
+        # pause is decode rounds left in that slot's cooldown; ewma is
+        # the smoothed accepted-drafts-per-round estimate feeding the
+        # draft-length ladder (optimistically seeded at full k so a fresh
+        # slot probes with the widest draft).
+        self._spec_slot_prop = np.zeros((S,), np.int64)
+        self._spec_slot_acc = np.zeros((S,), np.int64)
+        self._spec_slot_pause = np.zeros((S,), np.int32)
+        self._spec_slot_ewma = np.full((S,), float(ec.num_draft_tokens),
+                                       np.float64)
+        # Last dispatched draft length (0 = no spec round in flight /
+        # speculation off) — the dlti_spec_draft_len gauge.
+        self._spec_last_k = 0
 
         # Disaggregated serving (serving/disagg.py): a prefill-only engine
         # runs admission and chunked prefill but never dispatches decode —
@@ -918,6 +983,10 @@ class InferenceEngine:
                       # an HBM hit or a re-prefill. Present (at 0) even
                       # without tiering so the /metrics schema is stable.
                       "prefix_restored_tokens": 0,
+                      # Prefill program dispatches (ragged packing exists
+                      # to shrink this under multi-admission waves).
+                      # Present (at 0) so the /metrics schema is stable.
+                      "prefill_batches": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_paused_rounds": 0,
                       # Decode-state cache accounting (decode_state.py):
@@ -1042,6 +1111,9 @@ class InferenceEngine:
     @property
     def _spec_fn(self):
         return self.executor._spec_fn
+
+    def _spec_fn_for(self, k: int):
+        return self.executor.spec_fn(k)
 
     @property
     def _spec_rounds(self):
@@ -1263,6 +1335,20 @@ class InferenceEngine:
         return self.block_manager.num_free
 
     @property
+    def spec_acceptance_rate(self) -> float:
+        """Cumulative accepted/proposed draft-token ratio (0.0 before any
+        proposal) — the dlti_spec_acceptance_rate gauge."""
+        p = self.stats.get("spec_proposed", 0)
+        return self.stats.get("spec_accepted", 0) / p if p else 0.0
+
+    @property
+    def spec_draft_len(self) -> int:
+        """Draft length of the last dispatched decode round (0 = the
+        round ran plain decode: speculation off, paused, or no greedy
+        slot) — the dlti_spec_draft_len gauge."""
+        return self._spec_last_k
+
+    @property
     def has_work(self) -> bool:
         return bool(self.waiting) or self.num_active > 0
 
@@ -1474,11 +1560,19 @@ class InferenceEngine:
                 slot.next_pos = n_cached  # _register_slot set it to the end
             return
 
+        suffix_lens = [len(req.prompt_token_ids) + len(req.output_token_ids)
+                       - n_cached
+                       for _slot, req, _blocks, n_cached in admissions]
+        if self.cfg.ragged_prefill:
+            # Ragged: one call advances admissions of MIXED suffix lengths
+            # (group width = widest member's bucket, padding bounded) —
+            # a heterogeneous admission wave stops costing one program
+            # call (and one jit specialization) per distinct bucket.
+            for width, group in self._ragged_groups(admissions, suffix_lens):
+                self._prefill_group(width, group)
+            return
         by_bucket: Dict[int, List[tuple]] = {}
-        for adm in admissions:
-            slot, req, blocks, n_cached = adm
-            suffix_len = (len(req.prompt_token_ids)
-                          + len(req.output_token_ids) - n_cached)
+        for adm, suffix_len in zip(admissions, suffix_lens):
             by_bucket.setdefault(self._bucket_for(suffix_len), []).append(adm)
         for bucket, group in by_bucket.items():
             # Chunk very wide admission waves: past ~8 rows the batched
@@ -1508,12 +1602,50 @@ class InferenceEngine:
             chunks.append((slot, piece, slot.next_pos, take == remaining))
             slot.next_pos += take
             budget -= take
+        if self.cfg.ragged_prefill:
+            for width, group in self._ragged_groups(
+                    chunks, [len(c[1]) for c in chunks]):
+                self._run_prefill_batch(width, group)
+            return
         by_bucket: Dict[int, List[tuple]] = {}
         for ch in chunks:
             by_bucket.setdefault(self._bucket_for(len(ch[1])), []).append(ch)
         for bucket, group in by_bucket.items():
             for i in range(0, len(group), 8):
                 self._run_prefill_batch(bucket, group[i:i + 8])
+
+    def _ragged_groups(self, items: List, lengths: List[int]) -> List[tuple]:
+        """FCFS ragged packing for multi-admission prefill: ``(width,
+        members)`` groups where width is the widest member's pow2 bucket.
+
+        A group closes at 8 rows (same flattening point as the bucketed
+        path) or when its padded footprint — pow2-padded row count times
+        group width — would exceed twice the members' own bucketed token
+        work. The 2x bound is the padding overhead the bucketed path
+        already tolerates per row, accounted group-wide: short chunks
+        pack behind a long one only while the wasted lanes stay cheaper
+        than a second program dispatch. Rows keep their own positions,
+        block tables, and last-token indices, so grouping choice never
+        changes any row's output (byte-identical ragged on/off)."""
+        groups: List[tuple] = []
+        cur: List = []
+        wid = real = 0
+        for it, ln in zip(items, lengths):
+            w = self._bucket_for(ln)
+            nwid = max(wid, w)
+            nreal = real + w
+            rows_pow2 = 1
+            while rows_pow2 < len(cur) + 1:
+                rows_pow2 *= 2
+            if cur and (len(cur) >= 8 or rows_pow2 * nwid > 2 * nreal):
+                groups.append((wid, cur))
+                cur = []
+                nwid, nreal = w, w
+            cur.append(it)
+            wid, real = nwid, nreal
+        if cur:
+            groups.append((wid, cur))
+        return groups
 
     def _register_slot(self, slot: _Slot, req: Request, blocks: List[int],
                        n: int) -> None:
@@ -1594,6 +1726,9 @@ class InferenceEngine:
             nblk_bucket *= 2
         nblk_bucket = min(nblk_bucket, ec.max_blocks_per_seq)
 
+        # Program dispatches — with ragged packing this is the number a
+        # multi-admission wave is supposed to shrink.
+        self.stats["prefill_batches"] += 1
         ids = np.zeros((B, bucket), np.int32)
         pos = np.full((B, bucket), -1, np.int32)  # -1 -> write dropped
         bt = np.zeros((B, nblk_bucket), np.int32)
@@ -1706,22 +1841,28 @@ class InferenceEngine:
         # their block-table rows masked to the trash block.
         k_steps = 1
         active0 = [s for s in self.slots if not s.free and not s.prefilling]
-        # Speculative decode engages per ROUND when any active slot is
-        # greedy (per-slot gating inside the program handles the rest) and
-        # every active slot has room for the worst-case window; the
-        # adaptive gate pauses it while draft acceptance is poor.
+        # Speculative decode engages per ROUND when any active greedy slot
+        # is unpaused (per-slot gating: _spec_round_gate ticks cooldowns
+        # and returns this round's participants, and the program masks the
+        # rest to single-step) and every active slot has room for the
+        # worst-case window at the SELECTED draft length. When every
+        # greedy slot is paused the round falls back to plain multi-step —
+        # the (k+1)-wide verify forwards would be pure overhead.
         # Trade-off: the room check is batch-wide (R is compile-static),
         # so one slot within R*(k+1) tokens of max_model_len falls the
         # whole batch back to plain multi-step until it retires — at most
         # its last R*(k+1) decode rounds. A per-slot R would need one
         # compiled variant per window size; not worth the compile surface.
-        spec_window = self._spec_rounds * (ec.num_draft_tokens + 1)
-        use_spec = (
-            self._spec_fn is not None and active0
-            and any(s.request.params.temperature == 0.0 for s in active0)
-            and all(s.seq_len + spec_window <= ec.max_model_len
-                    for s in active0)
-            and self._spec_gate_open())
+        spec_parts: list = []
+        spec_k = 0
+        if self._spec_fn is not None and active0:
+            spec_parts = self._spec_round_gate(active0)
+        if spec_parts:
+            spec_k = self._spec_pick_k(spec_parts)
+        spec_window = self._spec_rounds * (spec_k + 1)
+        use_spec = bool(spec_parts) and all(
+            s.seq_len + spec_window <= ec.max_model_len for s in active0)
+        self._spec_last_k = spec_k if use_spec else 0
         if use_spec:
             k_steps = spec_window  # block-growth window
         elif ec.steps_per_sync > 1 and active0:
@@ -1770,6 +1911,7 @@ class InferenceEngine:
                 # only fail on genuine exhaustion.
                 self.stats["hbm_growth_deferrals"] += 1
                 use_spec = False
+                self._spec_last_k = 0
                 k_steps = 1
             if not grow_tables(k_steps, use_spec):
                 raise RuntimeError(
@@ -1782,7 +1924,7 @@ class InferenceEngine:
         if not active:
             return None
         if use_spec:
-            return self._spec_dispatch(active)
+            return self._spec_dispatch(active, spec_parts, spec_k)
 
         t_prep = time.perf_counter()
         ids = np.zeros((ec.max_seqs, 1), np.int32)
@@ -1886,31 +2028,74 @@ class InferenceEngine:
                     break
         return finished
 
-    def _spec_gate_open(self) -> bool:
-        """Adaptive acceptance gate (``spec_min_acceptance``): pause
-        proposing for ``spec_cooldown`` rounds after a probe window of
-        mostly-rejected drafts, then probe again."""
-        if self.cfg.spec_min_acceptance <= 0.0:
-            return True
-        if self._spec_pause > 0:
-            self._spec_pause -= 1
-            self.stats["spec_paused_rounds"] += 1
-            return False
-        return True
+    def _spec_round_gate(self, active: List["_Slot"]) -> List["_Slot"]:
+        """Per-slot adaptive acceptance gate (``spec_min_acceptance``):
+        tick each paused greedy slot's cooldown and return the greedy
+        slots allowed to propose this round. A slot in cooldown rides the
+        spec program masked to single-step (or the plain path, when every
+        greedy slot is paused at once) — its batchmates keep speculating
+        either way. ``spec_paused_rounds`` counts paused SLOT-rounds."""
+        gate_on = self.cfg.spec_min_acceptance > 0.0
+        out = []
+        for s in active:
+            if s.request.params.temperature != 0.0:
+                continue
+            sid = s.slot_id
+            if gate_on and self._spec_slot_pause[sid] > 0:
+                self._spec_slot_pause[sid] -= 1
+                self.stats["spec_paused_rounds"] += 1
+            else:
+                out.append(s)
+        return out
 
-    def _spec_note_acceptance(self, slot_rounds: int, extra: int) -> None:
-        self._spec_win_prop += slot_rounds
-        self._spec_win_acc += extra
+    def _spec_pick_k(self, parts: List["_Slot"]) -> int:
+        """Draft length for this round, from the halving ladder
+        (num_draft_tokens, /2, ..., 1): the smallest ladder member with
+        one token of probe slack over the most optimistic participant's
+        smoothed acceptance estimate. The slack is what lets the estimate
+        climb back up — at the saturating k the estimate caps at k, and
+        wanting k+1 selects the next rung. spec_adaptive=False pins the
+        pre-ladder behavior (always the full draft)."""
+        kmax = self.cfg.num_draft_tokens
+        if not self.cfg.spec_adaptive:
+            return kmax
+        est = max(self._spec_slot_ewma[s.slot_id] for s in parts)
+        want = min(kmax, int(np.ceil(est)) + 1)
+        ladder = []
+        kk = kmax
+        while kk >= 1:
+            ladder.append(kk)
+            kk //= 2
+        for kk in reversed(ladder):
+            if kk >= want:
+                return kk
+        return kmax
+
+    def _spec_note_slot(self, sid: int) -> None:
+        """Close a slot's probe window when full: a window of mostly-
+        rejected drafts pauses THAT slot for ``spec_cooldown`` rounds."""
         if (self.cfg.spec_min_acceptance > 0.0
-                and self._spec_win_prop >= self.cfg.spec_probe_window):
-            rate = self._spec_win_acc / self._spec_win_prop
+                and self._spec_slot_prop[sid] >= self.cfg.spec_probe_window):
+            rate = self._spec_slot_acc[sid] / self._spec_slot_prop[sid]
             if rate < self.cfg.spec_min_acceptance:
-                self._spec_pause = self.cfg.spec_cooldown
-            self._spec_win_prop = 0
-            self._spec_win_acc = 0
+                self._spec_slot_pause[sid] = self.cfg.spec_cooldown
+            self._spec_slot_prop[sid] = 0
+            self._spec_slot_acc[sid] = 0
 
-    def _spec_dispatch(self, active: List[_Slot]):
-        """Dispatch the fused propose→verify→accept program (no sync)."""
+    def _spec_reset_slot(self, sid: int) -> None:
+        self._spec_slot_prop[sid] = 0
+        self._spec_slot_acc[sid] = 0
+        self._spec_slot_pause[sid] = 0
+        self._spec_slot_ewma[sid] = float(self.cfg.num_draft_tokens)
+
+    def _spec_dispatch(self, active: List[_Slot], parts: List[_Slot],
+                       k: int):
+        """Dispatch the fused propose→verify→accept program (no sync).
+
+        ``parts`` are the greedy slots allowed to propose this round
+        (per-slot gate output); everyone else — sampling slots and greedy
+        slots in cooldown — is masked to single-step inside the program.
+        ``k`` is the ladder draft length picked for this round."""
         ec = self.cfg
         if self._state_cache is not None:
             # The spec path ships the mirrors directly (it uploads the
@@ -1918,12 +2103,15 @@ class InferenceEngine:
             # tokens per slot — the resident copies are stale wholesale
             # after this round.
             self._state_cache.mark_all_dirty()
-        k, R = ec.num_draft_tokens, self._spec_rounds
+        R = self._spec_rounds
         t_in = np.zeros((ec.max_seqs,), np.int32)
         seq_len = np.zeros((ec.max_seqs,), np.int32)
+        spec_mask = np.zeros((ec.max_seqs,), np.bool_)
         for s in active:
             t_in[s.slot_id] = s.last_token
             seq_len[s.slot_id] = s.seq_len
+        for s in parts:
+            spec_mask[s.slot_id] = True
         # Multi-query attention takes the gather path (the Pallas paged
         # kernel is single-token); bound its window to the blocks the
         # whole spec window can touch, quantized pow2 so jit
@@ -1938,15 +2126,15 @@ class InferenceEngine:
         if self.adapter_pool is not None:
             lora_args = (jnp.asarray(self._adapter_ids),
                          self.adapter_pool.tree)
-        self.cache, toks, lps, emit, prop, acc = self._spec_fn(
+        self.cache, toks, lps, emit, prop, acc = self._spec_fn_for(k)(
             self.params, self.cache, jnp.asarray(self._spec_hist), jnp.asarray(t_in),
-            jnp.asarray(seq_len),
+            jnp.asarray(seq_len), jnp.asarray(spec_mask),
             jnp.asarray(self._decode_block_tables()[:, :width]),
             jnp.asarray(self._slot_keys), jnp.asarray(self._gen_counts),
             jnp.asarray(self._temperature), jnp.asarray(self._top_k),
             jnp.asarray(self._top_p), *lora_args,
         )
-        return ("spec", active, toks, lps, emit, prop, acc)
+        return ("spec", active, spec_mask, toks, lps, emit, prop, acc)
 
     def _spec_complete(self, pending) -> List[Request]:
         """Sync a dispatched spec round and walk its emissions. Per slot
@@ -1954,7 +2142,7 @@ class InferenceEngine:
         accepted prefix + bonus; sampling: exactly one); the host consumes
         them in order, stopping a slot at EOS/limit and discarding the
         rest of its window (same contract as multi-step decode)."""
-        _, active, toks, lps, emit, prop, acc = pending
+        _, active, spec_mask, toks, lps, emit, prop, acc = pending
         R = self._spec_rounds
         toks = np.asarray(jax.device_get(toks))   # (S, R, k+1)
         lps = np.asarray(jax.device_get(lps))
@@ -1978,19 +2166,25 @@ class InferenceEngine:
                     f"{bad}: the model is producing NaN/inf logits")
 
         finished = []
-        gate_rounds = 0
-        gate_extra = 0
         for s in active:
             sid = s.slot_id
-            greedy = s.request.params.temperature == 0.0
+            # Only unmasked greedy slots actually proposed this round —
+            # masked slots (sampling, or greedy in cooldown) ran single-
+            # step and must not feed the acceptance windows.
+            proposing = bool(spec_mask[sid])
             done = False
             for r in range(R):
                 # Per-round occupancy (see _decode_complete): rounds after
                 # a slot finishes mid-window don't count as occupied.
                 self.stats["decode_slot_steps"] += 1
-                if greedy:
-                    gate_rounds += 1
-                    gate_extra += int(emit[sid, r]) - 1
+                if proposing:
+                    self._spec_slot_prop[sid] += 1
+                    self._spec_slot_acc[sid] += int(emit[sid, r]) - 1
+                    # Smoothed accepted-drafts-per-round estimate for the
+                    # draft-length ladder (rounds with no lookup hit pull
+                    # it toward 0, as they should).
+                    self._spec_slot_ewma[sid] += 0.2 * (
+                        int(acc[sid, r]) - self._spec_slot_ewma[sid])
                     self.stats["spec_proposed"] += int(prop[sid, r])
                     self.stats["spec_accepted"] += int(acc[sid, r])
                 for j in range(int(emit[sid, r])):
@@ -2002,7 +2196,8 @@ class InferenceEngine:
                         break
                 if done:
                     break
-        self._spec_note_acceptance(gate_rounds, gate_extra)
+            if proposing and not done:
+                self._spec_note_slot(sid)
         return finished
 
     def _append_token(self, slot: _Slot, token: int, logprob: float) -> bool:
@@ -2094,6 +2289,7 @@ class InferenceEngine:
         self._slot_keys[slot.slot_id] = 0
         self._gen_counts[slot.slot_id] = 0
         self._adapter_ids[slot.slot_id] = 0
+        self._spec_reset_slot(slot.slot_id)
         self._mark_state_dirty(slot.slot_id)
 
     # ------------------------------------------------------------------
@@ -2129,6 +2325,17 @@ class InferenceEngine:
             "last_token": slot.last_token,
             "slot_key": self._slot_keys[slot.slot_id].copy(),
             "gen_count": int(self._gen_counts[slot.slot_id]),
+            # Adaptive-spec controller state rides along so the adopting
+            # engine's gate resumes mid-window instead of re-probing from
+            # scratch (the token history itself is rebuilt from the
+            # request's tokens on adopt). Additive dict of plain scalars:
+            # serializes through the generic wire envelope unchanged.
+            "spec": {
+                "prop": int(self._spec_slot_prop[slot.slot_id]),
+                "acc": int(self._spec_slot_acc[slot.slot_id]),
+                "pause": int(self._spec_slot_pause[slot.slot_id]),
+                "ewma": float(self._spec_slot_ewma[slot.slot_id]),
+            },
         }
         self._release(slot)
         return snap
@@ -2188,6 +2395,16 @@ class InferenceEngine:
         if self._spec_hist is not None:
             ctx = req.prompt_token_ids + req.output_token_ids
             self._spec_hist[slot.slot_id, : len(ctx)] = ctx
+        spec = snap.get("spec")
+        if spec:
+            # Resume the per-slot adaptive gate where the origin left it
+            # (.get: snapshots from engines predating the controller —
+            # or with speculation off — restore to the fresh-slot state).
+            self._spec_slot_prop[slot.slot_id] = int(spec.get("prop", 0))
+            self._spec_slot_acc[slot.slot_id] = int(spec.get("acc", 0))
+            self._spec_slot_pause[slot.slot_id] = int(spec.get("pause", 0))
+            self._spec_slot_ewma[slot.slot_id] = float(
+                spec.get("ewma", self.cfg.num_draft_tokens))
         for b, payload in zip(blocks, snap["payloads"]):
             self.executor.restore_block(b, payload)
         return True

@@ -133,6 +133,31 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
     registry.add_scalar_source(_prefix_hit_rate,
                                gauge_keys=("prefix_cache_hit_rate",),
                                prefix="dlti_")
+
+    def _spec_scalars() -> dict:
+        # Speculative-decode scrape surface (SPEC_METRIC_NAMES contract):
+        # explicit *_total counters for the raw draft economics plus two
+        # derived gauges — cumulative acceptance ratio and the draft
+        # length the adaptive ladder picked for the last decode round.
+        # Derivations read the stats dict (aggregated by every engine
+        # facade); draft_len is engine-local state, so facades without it
+        # (replicated/disagg/fleet fronts, test fakes) expose 0.
+        eng = async_engine.engine
+        s = eng.stats
+        p = s.get("spec_proposed", 0)
+        return {
+            "spec_proposed_total": p,
+            "spec_accepted_total": s.get("spec_accepted", 0),
+            "spec_paused_rounds_total": s.get("spec_paused_rounds", 0),
+            "spec_acceptance_rate":
+                s.get("spec_accepted", 0) / p if p else 0.0,
+            "spec_draft_len": getattr(eng, "spec_draft_len", 0),
+        }
+
+    registry.add_scalar_source(
+        _spec_scalars,
+        gauge_keys=("spec_acceptance_rate", "spec_draft_len"),
+        prefix="dlti_")
     # Goodput ledger + critical-path attribution (telemetry.ledger):
     # module-level like the watchdog/flight counters — the per-request
     # phase totals back the TTFT decomposition on /metrics, and an

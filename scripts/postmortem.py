@@ -69,6 +69,11 @@ _KEY_METRICS = (
     "dlti_replica_lifecycle_migration_fallbacks_total",
     # Multi-process fleet (dlti_tpu.serving.fleet).
     "fleet_workers", "fleet_workers_live", "fleet_respawns",
+    # Speculative decode (dlti_tpu.serving.engine): draft economics at
+    # the moment of the incident — a collapsed acceptance rate or a
+    # pause storm reads very differently from a throughput stall.
+    "spec_proposed", "spec_accepted", "spec_paused_rounds",
+    "dlti_spec_acceptance_rate", "dlti_spec_draft_len",
 )
 
 # Sentinel dump reasons / context keys surfaced as their own report

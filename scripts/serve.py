@@ -269,7 +269,19 @@ def parse_args():
                    help="greedy slot-rounds measured before each gate "
                         "decision")
     p.add_argument("--spec-cooldown", type=int, default=32,
-                   help="engine rounds the gate pauses proposing for")
+                   help="engine rounds the gate pauses a slot's proposing "
+                        "for after a failed probe window")
+    p.add_argument("--no-spec-adaptive", action="store_true",
+                   help="pin the draft length at --num-draft-tokens "
+                        "instead of picking it per round from live "
+                        "per-slot acceptance (the pow2 draft-length "
+                        "ladder; outputs are byte-identical either way)")
+    p.add_argument("--ragged-prefill", action="store_true",
+                   help="pack prefill chunks from many admissions into "
+                        "shared ragged program calls (group width = "
+                        "widest member, padding bounded) instead of one "
+                        "call per length bucket — fewer dispatches under "
+                        "multi-admission waves, byte-identical outputs")
     p.add_argument("--trace-dir", default="",
                    help="enable the host-side span tracer (per-request "
                         "lifecycle + engine step phases) and export a "
@@ -406,7 +418,9 @@ def main() -> None:
         spec_min_acceptance=args.spec_min_acceptance,
         spec_probe_window=args.spec_probe_window,
         spec_cooldown=args.spec_cooldown,
+        spec_adaptive=not args.no_spec_adaptive,
         max_prefill_tokens_per_step=args.max_prefill_tokens,
+        ragged_prefill=args.ragged_prefill,
         decode_state_cache=not args.no_decode_state_cache,
         guard_nonfinite=not args.no_numeric_guard,
         guard_token_storm=args.guard_token_storm,

@@ -317,6 +317,23 @@ def test_fleet_metric_names_are_schema_stable():
     )
 
 
+def test_spec_metric_names_are_schema_stable():
+    """Speculative-decode telemetry names are a scrape contract: raw
+    draft-economics counters (proposed/accepted draft tokens, paused
+    slot-rounds) plus the derived acceptance-rate and adaptive
+    draft-length gauges, registered by build_registry's spec scalar
+    source and scraped into LoadReport.spec by loadgen."""
+    from dlti_tpu.serving.engine import SPEC_METRIC_NAMES
+
+    assert SPEC_METRIC_NAMES == (
+        "dlti_spec_proposed_total",
+        "dlti_spec_accepted_total",
+        "dlti_spec_paused_rounds_total",
+        "dlti_spec_acceptance_rate",
+        "dlti_spec_draft_len",
+    )
+
+
 def test_sentinel_metric_names_are_schema_stable():
     """Numeric-fault-sentinel telemetry names are a scrape contract like
     the watchdog/ckpt sets: anomaly/skip/rollback/quarantine counters and
@@ -586,6 +603,10 @@ def test_load_report_schema_includes_gateway_fields():
         # SLO era: the /debug/slo scrape cross-checked against the
         # client's own records (server/client/agreement sections).
         "slo",
+        # Adaptive-spec era: end-of-run speculative-decode economics
+        # (proposed/accepted/paused totals + acceptance-rate and
+        # draft-length gauges) from the /metrics scrape.
+        "spec",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

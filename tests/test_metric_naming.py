@@ -39,6 +39,7 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.serving.disagg import (
         KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
     )
+    from dlti_tpu.serving.engine import SPEC_METRIC_NAMES
     from dlti_tpu.serving.fleet import FLEET_METRIC_NAMES
     from dlti_tpu.serving.gateway import GATEWAY_METRIC_NAMES
     from dlti_tpu.serving.lifecycle import LIFECYCLE_METRIC_NAMES
@@ -77,7 +78,8 @@ def test_pinned_name_tuples_follow_convention():
                        (ADAPTER_METRIC_NAMES, "adapters"),
                        (LIFECYCLE_METRIC_NAMES, "lifecycle"),
                        (WIRE_METRIC_NAMES, "wire"),
-                       (FLEET_METRIC_NAMES, "fleet")):
+                       (FLEET_METRIC_NAMES, "fleet"),
+                       (SPEC_METRIC_NAMES, "spec-decode")):
         _assert_convention(tup, where)
 
 
@@ -195,6 +197,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_disk_degraded",
                      "dlti_replica_lifecycle_quarantines_total",
                      "dlti_replica_state",
+                     "dlti_spec_proposed_total",
+                     "dlti_spec_acceptance_rate",
+                     "dlti_spec_draft_len",
                      "dlti_heartbeat_lag_steps"):
         assert expected in names, f"walk missed {expected}: {names}"
     _assert_convention(names, "assembled serving registry")

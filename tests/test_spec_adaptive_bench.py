@@ -1,0 +1,69 @@
+"""CI contract for the adaptive-speculation A/B bench (satellite of the
+adaptive-spec PR), mirroring tests/test_multilora_bench.py: the artifact
+generator behind ``results/spec_adaptive_cpu.json`` must stay runnable
+with its compile-warmup methodology intact, and its equivalence claims
+must hold on a cold run — every arm byte-identical to plain greedy
+before a number is written. Throughput margins are properties of the
+committed artifact (quiet machine), not of this noisy smoke, so the
+smoke pins shape + equivalence; the artifact test pins the bars."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks_dev", "spec_win.py")
+
+
+@pytest.mark.slow
+def test_spec_adaptive_bench_smoke(tmp_path):
+    out = tmp_path / "spec_adaptive_cpu.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--cpu", "--runs", "1", "--max-tokens",
+         "48", "--wave", "8", "--json-out", str(out)],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    report = json.loads(out.read_text())
+
+    # The bench asserts per-arm output equality before writing; the
+    # report must record it for every arm.
+    assert report["outputs_equal"] is True
+    for trace in ("favorable", "adversarial"):
+        assert report[trace]["outputs_equal"] is True
+        assert len(report[trace]["plain_tok_s_all"]) == 1
+        assert len(report[trace]["spec_tok_s_all"]) == 1
+    # The favorable trace genuinely speculated on this cold run.
+    assert report["favorable"]["draft_acceptance"] > 0.5
+    assert report["ragged_prefill"]["outputs_equal"] is True
+    for key in ("what", "platform", "steps_per_sync", "num_draft_tokens",
+                "favorable", "adversarial", "ragged_prefill", "date"):
+        assert key in report, key
+
+
+def test_committed_artifact_meets_the_bar():
+    """The checked-in results/spec_adaptive_cpu.json is the PR's
+    evidence; pin the acceptance bars (≥20% favorable win, ≤5%
+    adversarial regression with the gate on, outputs_equal every arm,
+    ragged TTFT p99 no worse than bucketed) so a regenerated artifact
+    that misses them fails CI instead of silently shipping — the r03
+    artifact this replaces recorded a 0.103 "speedup" measured across
+    in-window XLA compiles."""
+    path = os.path.join(REPO, "results", "spec_adaptive_cpu.json")
+    report = json.loads(open(path).read())
+    assert report["outputs_equal"] is True
+    fav, adv = report["favorable"], report["adversarial"]
+    assert fav["outputs_equal"] is True and adv["outputs_equal"] is True
+    assert len(fav["plain_tok_s_all"]) >= 3  # median-of-3 methodology
+    assert fav["speedup"] >= 1.2
+    assert fav["draft_acceptance"] >= 0.5
+    assert adv["speedup"] >= 0.95
+    # The adversarial trace exercised the gate, not an accidental win.
+    assert adv["spec_paused_rounds"] > 0
+    rag = report["ragged_prefill"]
+    assert rag["outputs_equal"] is True
+    assert rag["ttft_p99_s_on"] <= rag["ttft_p99_s_off"]
+    assert rag["prefill_batches_on"] < rag["prefill_batches_off"]
