@@ -24,13 +24,16 @@ from dlti_tpu.checkpoint.store import (  # noqa: F401
     list_checkpoint_steps,
     load_train_meta,
     quarantine_step,
+    manifest_digest,
     restore_latest_verified,
     restore_train_state,
     save_train_state,
     verify_checkpoint,
+    verify_pytree_dir,
     wait_for_saves,
 )
 from dlti_tpu.checkpoint.export import (  # noqa: F401
     export_merged_model,
+    export_params_host,
     load_exported_model,
 )

@@ -419,7 +419,32 @@ def verify_checkpoint(directory: str, step: int) -> Tuple[bool, str]:
     """Deep integrity check: commit marker present, manifest digest
     matches the marker, and every listed file exists with the recorded
     size and SHA-256. Returns (ok, reason)."""
-    root = os.path.join(os.path.abspath(directory), str(step))
+    return _verify_root(os.path.join(os.path.abspath(directory), str(step)))
+
+
+def verify_pytree_dir(directory: str) -> Tuple[bool, str]:
+    """The same deep integrity check for a :func:`save_pytree` artifact
+    (an export dir rather than a numbered step dir) — the re-verify the
+    rolling-reload path runs immediately before each per-replica swap, so
+    an export corrupted mid-roll aborts the roll instead of canary-failing
+    halfway through it. Returns (ok, reason)."""
+    return _verify_root(os.path.abspath(directory))
+
+
+def manifest_digest(directory: str) -> Optional[str]:
+    """The committed manifest's SHA-256 for a checkpoint step dir or a
+    :func:`save_pytree` export dir — the identity deploy/promote paths pin
+    ("which bytes is the fleet serving"). None when the dir has no commit
+    marker or it is unreadable."""
+    try:
+        with open(os.path.join(os.path.abspath(directory), _COMMIT),
+                  "rb") as f:
+            return json.loads(f.read()).get("manifest_sha256")
+    except (OSError, ValueError):
+        return None
+
+
+def _verify_root(root: str) -> Tuple[bool, str]:
     commit_path = os.path.join(root, _COMMIT)
     manifest_path = os.path.join(root, _MANIFEST)
     if not os.path.isfile(commit_path):

@@ -453,6 +453,11 @@ class WatchdogConfig:
     # burning through its error budget on a fast+slow window pair
     # (telemetry.slo). 0 = rule off even when a tracker is wired.
     slo_burn_limit: int = 1
+    # canary_regression: fires when the deployment controller rolls a
+    # candidate back (the dlti_deploy_rollbacks_total ring series grew
+    # across the watchdog window) — a training run is producing
+    # checkpoints the canary gates reject. 0 = rule off.
+    canary_regression_limit: int = 1
 
 
 @dataclass(frozen=True)
@@ -749,6 +754,61 @@ class SpeculativeConfig:
 
 
 @dataclass(frozen=True)
+class DeployConfig:
+    """Continuous delivery (``dlti_tpu.serving.deploy``): a deployment
+    controller that watches a training run's checkpoint directory for
+    newly committed verified steps, auto-exports candidate weights
+    through the digest-verified ``save_pytree`` path, canaries each
+    candidate on one shadow replica under mirrored live traffic, and
+    promotes fleet-wide (rolling reload) or rolls back — no human in the
+    loop. Off by default; an empty ``watch_dir`` also keeps it off."""
+
+    enabled: bool = False
+    # Training checkpoint directory to watch (the checkpoint-store layout
+    # scripts/train.py --output-dir writes). "" = controller off.
+    watch_dir: str = ""
+    # Where candidate exports land (save_pytree dirs named step-N;
+    # rejected ones quarantine under <export_dir>/_quarantine).
+    # "" = "<watch_dir>/_deploy_exports".
+    export_dir: str = ""
+    # Seconds between checkpoint-dir polls (injectable-clock ticks).
+    poll_interval_s: float = 5.0
+    # Fraction of live client submissions mirrored onto the canary as
+    # shadow requests (results never reach clients).
+    canary_shadow_frac: float = 0.25
+    # Shadow-pair samples required before the gates are judged, and the
+    # wall-clock bound a canary may wait for them (a quiet fleet judges
+    # on the pinned probe set alone after the wait).
+    canary_min_requests: int = 8
+    canary_max_wait_s: float = 120.0
+    # Gate 1 — greedy logprob drift: max |mean logprob delta| across the
+    # pinned probe set, candidate vs incumbent baseline.
+    promote_max_logprob_drift: float = 0.25
+    # Gate 2 — output-length distribution shift: relative mean-length
+    # delta between shadow (candidate) and paired live (incumbent)
+    # completions (0 = gate off).
+    max_length_shift_frac: float = 0.5
+    # Gate 3 — per-phase SLO compliance on shadow requests: thresholds in
+    # seconds (0 = that phase's gate off) and the compliant fraction
+    # required.
+    slo_ttft_threshold_s: float = 0.0
+    slo_tpot_threshold_s: float = 0.0
+    slo_min_compliance: float = 0.95
+    # Pinned probe set: deterministic greedy prompts replayed against
+    # every candidate and compared to the incumbent baseline.
+    probe_prompts: int = 4
+    probe_prompt_tokens: int = 8
+    probe_max_tokens: int = 4
+    # Promotion backoff for flapping candidates: after a rollback the
+    # next candidate is not considered for initial * factor**rollbacks
+    # seconds (capped), so a training run spewing bad checkpoints cannot
+    # thrash the fleet with canary churn.
+    promote_backoff_s: float = 30.0
+    promote_backoff_factor: float = 2.0
+    promote_backoff_max_s: float = 600.0
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Serving-side config block (engine sizing stays in
     ``serving.engine.EngineConfig``; this holds the layers above it)."""
@@ -760,6 +820,7 @@ class ServingConfig:
         default_factory=ReplicaLifecycleConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
+    deploy: DeployConfig = field(default_factory=DeployConfig)
 
 
 @dataclass(frozen=True)
@@ -812,6 +873,7 @@ class Config:
                     "checkpoint", "train", "telemetry", "serving", "gateway",
                     "watchdog", "flight_recorder", "prefix_tiers", "sentinel",
                     "disagg", "lifecycle", "slo", "fleet", "speculative",
+                    "deploy",
                 ):
                     sub_cls = {
                         "model": ModelConfig, "lora": LoRAConfig,
@@ -828,6 +890,7 @@ class Config:
                         "slo": SLOConfig,
                         "fleet": FleetConfig,
                         "speculative": SpeculativeConfig,
+                        "deploy": DeployConfig,
                     }.get(f.name)
                     if sub_cls is not None and isinstance(v, dict):
                         kwargs[k] = _build(sub_cls, v)

@@ -23,6 +23,9 @@ from scratch for TPU:
   digest-verified TCP protocol, engine worker processes, and a
   spawning/healing supervisor behind the ReplicatedEngine facade
 * :mod:`dlti_tpu.serving.server` — OpenAI-compatible HTTP server
+* :mod:`dlti_tpu.serving.deploy` — continuous delivery: checkpoint-watching
+  deploy controller with shadow-traffic canary and autonomous
+  promote/rollback
 """
 
 from dlti_tpu.serving.block_manager import BlockManager  # noqa: F401
@@ -40,6 +43,7 @@ from dlti_tpu.serving.fleet import (  # noqa: F401
     FleetSupervisor,
     make_subprocess_spawner,
 )
+from dlti_tpu.serving.deploy import DeploymentController  # noqa: F401
 from dlti_tpu.serving.gateway import (  # noqa: F401
     AdmissionError,
     AdmissionGateway,

@@ -1,0 +1,686 @@
+"""Continuous delivery: the deployment controller that lets the system
+train, canary, and ship itself — no human in the loop.
+
+Every piece it composes already exists: crash-consistent verified
+checkpoints (``checkpoint.store``), digest-verified pytree exports
+(``save_pytree``), per-replica canary-gated rolling reloads
+(``ReplicatedEngine.request_reload`` / ``FleetSupervisor``), numeric
+guards, and the SLO machinery. The :class:`DeploymentController` closes
+the loop:
+
+1. **Watch** — poll a training run's checkpoint directory (injectable
+   clock) for newly COMMITted steps that pass
+   :func:`~dlti_tpu.checkpoint.store.verify_checkpoint`
+   (via ``latest_verified_step``: anything newer that fails is
+   quarantined by the scan itself).
+2. **Export** — extract the candidate's ``.params`` subtree host-side
+   (:func:`~dlti_tpu.checkpoint.export.export_params_host`, no model
+   init) into a digest-verified ``save_pytree`` artifact under the
+   export root.
+3. **Canary** — build a canary engine from the export (one shadow
+   replica materialized BESIDE the serving fleet, so client capacity is
+   never reduced), mirror a sampled fraction of live traffic onto it as
+   shadow requests (the ``shadow_tap`` hook in
+   ``ReplicatedEngine``/``FleetSupervisor`` dispatch; shadow results
+   never reach clients and never book into client-facing SLIs), and
+   judge concrete gates against the incumbent:
+
+   * greedy logprob drift on a pinned probe set,
+   * output-length distribution shift (shadow vs paired live requests),
+   * per-phase TTFT/TPOT SLO compliance on the shadow requests,
+   * nonfinite logprobs / numeric faults / errored shadow requests.
+
+4. **Promote or roll back** — on pass, promote fleet-wide through the
+   rolling ``request_reload`` path (re-verified before every per-replica
+   swap) and pin the new manifest digest + step; on fail, discard the
+   canary (the fleet never changed — that IS the rollback), quarantine
+   the rejected export for forensics, refuse that step forever
+   (persisted, so a restart does not retry it), and back off the next
+   candidate exponentially so a flapping training run cannot thrash the
+   fleet.
+
+The controller is pure bookkeeping on an injectable clock plus two
+injectable capabilities — ``exporter(watch_dir, step, out_dir) ->
+digest`` and ``canary_factory(export_dir) -> engine`` — so the state
+machine is unit-testable with fakes on a fake clock; ``scripts/serve.py``
+wires the real checkpoint store and real engines underneath it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dlti_tpu.config import DeployConfig
+from dlti_tpu.telemetry.registry import Counter, Gauge
+from dlti_tpu.utils import durable_io
+from dlti_tpu.utils.logging import get_logger
+
+# Name-stability contract (pinned in tests/test_bench_contract.py).
+DEPLOY_METRIC_NAMES = (
+    "dlti_deploy_candidates_total",
+    "dlti_deploy_canaries_total",
+    "dlti_deploy_promotions_total",
+    "dlti_deploy_rollbacks_total",
+    "dlti_deploy_rejected_total",
+    "dlti_deploy_incumbent_step",
+)
+
+# Module-level metrics (the lifecycle/watchdog pattern): every controller
+# in the process shares them; build_registry registers them for /metrics.
+candidates_total = Counter(
+    DEPLOY_METRIC_NAMES[0],
+    help="new verified checkpoint steps noticed by the watch loop")
+canaries_total = Counter(
+    DEPLOY_METRIC_NAMES[1],
+    help="canary phases started (candidate exported and shadow engine up)")
+promotions_total = Counter(
+    DEPLOY_METRIC_NAMES[2],
+    help="candidates promoted fleet-wide via rolling reload")
+rollbacks_total = Counter(
+    DEPLOY_METRIC_NAMES[3],
+    help="canaried candidates rolled back to the incumbent "
+         "(gate failure or mid-roll abort)")
+rejected_total = Counter(
+    DEPLOY_METRIC_NAMES[4],
+    help="checkpoint steps refused forever (export failure or canary "
+         "rejection; the export is quarantined)")
+incumbent_step_gauge = Gauge(
+    DEPLOY_METRIC_NAMES[5],
+    help="training step of the checkpoint the fleet currently serves "
+         "(-1 until the controller promotes one)")
+
+_REFUSED_FILE = "refused_steps.jsonl"
+
+
+class _ShadowPair:
+    """One mirrored request: the live (incumbent) request the client got,
+    and its shadow twin running on the candidate engine."""
+
+    __slots__ = ("live", "shadow")
+
+    def __init__(self, live, shadow):
+        self.live = live
+        self.shadow = shadow
+
+
+class DeploymentController:
+    """Checkpoint-watching deploy controller with shadow-traffic canary
+    and autonomous promote/rollback.
+
+    ``engine`` is the serving fleet facade (``ReplicatedEngine``,
+    ``FleetSupervisor``, or anything with ``request_reload`` and a
+    ``shadow_tap`` attribute). Heavy work (export, canary engine build,
+    probe generation) runs on the controller's own thread — never the
+    fleet stepper's — so a slow export cannot stall client decode.
+    """
+
+    def __init__(self, engine, cfg: DeployConfig, *,
+                 exporter: Optional[Callable] = None,
+                 canary_factory: Optional[Callable] = None,
+                 incumbent_dir: str = "",
+                 incumbent_step: int = -1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.cfg = cfg
+        self.clock = clock
+        self.logger = get_logger()
+        self.enabled = bool(cfg.enabled)
+        self.watch_dir = os.path.abspath(cfg.watch_dir) if cfg.watch_dir \
+            else ""
+        self.export_root = os.path.abspath(
+            cfg.export_dir or os.path.join(self.watch_dir or ".",
+                                           "_deploy_exports"))
+        if exporter is None:
+            from dlti_tpu.checkpoint.export import export_params_host
+
+            exporter = export_params_host
+        self.exporter = exporter
+        self.canary_factory = canary_factory
+        # Incumbent identity: which export dir / training step / manifest
+        # digest the fleet is serving. The boot export (--model-dir or
+        # --reload-checkpoint) seeds it; every promotion replaces it.
+        self.incumbent_dir = os.path.abspath(incumbent_dir) \
+            if incumbent_dir else ""
+        self.incumbent_step = incumbent_step
+        self.incumbent_digest: Optional[str] = None
+        if self.incumbent_dir:
+            from dlti_tpu.checkpoint.store import manifest_digest
+
+            self.incumbent_digest = manifest_digest(self.incumbent_dir)
+        # State machine: idle -> canary -> promoting -> idle.
+        self.state = "idle"
+        self._last_poll = -math.inf
+        self._backoff_until = -math.inf
+        self._consecutive_rollbacks = 0
+        self._refused: dict = {}  # step -> reason
+        self._load_refused()
+        # Candidate under canary (all None when idle/promoting done).
+        self._candidate: Optional[dict] = None
+        self._canary_engine = None
+        self._pairs: List[_ShadowPair] = []
+        self._tap_queue: List[tuple] = []
+        self._tap_lock = threading.Lock()
+        self._tap_acc = 0.0
+        self._tap_seen = 0
+        self._tap_mirrored = 0
+        # Pinned probe baseline: [(tokens, logprobs)] per probe prompt,
+        # measured on the incumbent weights. Re-pinned at every promote
+        # (the candidate's own probe results become the next baseline).
+        self._baseline: Optional[list] = None
+        self._last_result: Optional[dict] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # Install the tap (cheap no-op outside a canary phase).
+        engine.shadow_tap = self._tap
+
+    # -- persistence of refusals ----------------------------------------
+    def _refused_path(self) -> str:
+        return os.path.join(self.export_root, _REFUSED_FILE)
+
+    def _load_refused(self) -> None:
+        path = self._refused_path()
+        if not os.path.isfile(path):
+            return
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    self._refused[int(rec["step"])] = rec.get("reason", "")
+        except (OSError, ValueError) as e:
+            self.logger.warning("deploy: unreadable refused-steps log "
+                                "%s: %s", path, e)
+
+    def _refuse(self, step: int, reason: str) -> None:
+        """Refuse ``step`` forever: in memory now, durably on disk so a
+        controller restart does not re-canary a known-bad checkpoint."""
+        if step in self._refused:
+            return
+        self._refused[step] = reason
+        rejected_total.inc()
+        try:
+            os.makedirs(self.export_root, exist_ok=True)
+            durable_io.append_line(
+                self._refused_path(),
+                json.dumps({"step": step, "reason": reason}),
+                path_class="checkpoint")
+        except Exception as e:  # noqa: BLE001 — refusal still holds in-mem
+            self.logger.error("deploy: could not persist refusal of step "
+                              "%d: %s", step, e)
+
+    # -- shadow tap ------------------------------------------------------
+    def _tap(self, prompt_token_ids, params, live_req) -> None:
+        """Called from the fleet's submit path (any thread) for every
+        client request. Samples ``canary_shadow_frac`` of them into the
+        mirror queue; the canary loop drains it. Outside a canary phase
+        this is two attribute reads."""
+        if self.state != "canary":
+            return
+        with self._tap_lock:
+            self._tap_seen += 1
+            self._tap_acc += self.cfg.canary_shadow_frac
+            if self._tap_acc < 1.0:
+                return
+            self._tap_acc -= 1.0
+            if len(self._tap_queue) >= 4 * max(1, self.cfg.canary_min_requests):
+                return  # bounded mirror backlog; drop, never block
+            self._tap_mirrored += 1
+            self._tap_queue.append((list(prompt_token_ids), params,
+                                    live_req))
+
+    # -- probe set -------------------------------------------------------
+    def _probe_prompts(self) -> List[List[int]]:
+        """Deterministic pinned probe prompts (small token ids, safe for
+        any vocab the fleet serves)."""
+        n = max(1, self.cfg.probe_prompts)
+        k = max(1, self.cfg.probe_prompt_tokens)
+        return [[((7 * i + j) % 96) + 1 for j in range(k)]
+                for i in range(n)]
+
+    def _run_probes(self, eng) -> Optional[list]:
+        """Greedy probe generations on ``eng``: [(tokens, logprobs)] per
+        prompt, or None when generation fails (numeric guard trip, engine
+        fault) — a verdict, not an error."""
+        from dlti_tpu.serving.engine import SamplingParams
+
+        out = []
+        try:
+            for i, prompt in enumerate(self._probe_prompts()):
+                sp = SamplingParams(
+                    temperature=0.0,
+                    max_tokens=max(1, self.cfg.probe_max_tokens))
+                req = eng.submit(prompt, sp, f"deploy-probe-{i}")
+                req.shadow = True
+                for _ in range(2000):
+                    if req.done:
+                        break
+                    eng.step()
+                if not req.done or req.finish_reason == "error":
+                    return None
+                out.append((list(req.output_token_ids),
+                            list(req.output_logprobs)))
+        except Exception as e:  # noqa: BLE001 — a failed probe is a verdict
+            self.logger.warning("deploy: probe generation failed: %s", e)
+            return None
+        return out
+
+    # -- tick ------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        """One state-machine advance. Called from the controller thread
+        in production and directly (with a fake clock) in tests."""
+        now = self.clock() if now is None else now
+        if not self.enabled:
+            return
+        if self.state == "idle":
+            self._tick_idle(now)
+        elif self.state == "canary":
+            self._tick_canary(now)
+        elif self.state == "promoting":
+            self._tick_promoting(now)
+
+    def _tick_idle(self, now: float) -> None:
+        if now < self._backoff_until:
+            return
+        if now - self._last_poll < self.cfg.poll_interval_s:
+            return
+        self._last_poll = now
+        if not self.watch_dir:
+            return
+        from dlti_tpu.checkpoint.store import latest_verified_step
+
+        step = latest_verified_step(self.watch_dir)
+        if step is None or step == self.incumbent_step \
+                or step in self._refused:
+            return
+        candidates_total.inc()
+        self.logger.info("deploy: new verified candidate step %d", step)
+        out_dir = os.path.join(self.export_root, f"step-{step}")
+        try:
+            digest = self.exporter(self.watch_dir, step, out_dir)
+        except Exception as e:  # noqa: BLE001 — a bad step must not loop
+            self.logger.error("deploy: export of step %d failed: %s",
+                              step, e)
+            self._refuse(step, f"export-failed:{type(e).__name__}")
+            self._last_result = {"step": step, "verdict": "rejected",
+                                 "reasons": ["export-failed"]}
+            return
+        if self.canary_factory is None:
+            self.logger.error("deploy: no canary factory wired; cannot "
+                              "canary step %d", step)
+            return
+        try:
+            self._canary_engine = self.canary_factory(out_dir)
+        except Exception as e:  # noqa: BLE001 — unloadable export = reject
+            self.logger.error("deploy: canary engine build for step %d "
+                              "failed: %s", step, e)
+            self._reject(step, out_dir, ["canary-build-failed"], {})
+            return
+        # Pin the incumbent baseline lazily: built once from the
+        # incumbent export, then refreshed from each promoted candidate's
+        # own probe results (free — same prompts, same weights).
+        if self._baseline is None and self.incumbent_dir \
+                and self.canary_factory is not None:
+            try:
+                ref = self.canary_factory(self.incumbent_dir)
+                self._baseline = self._run_probes(ref)
+                self._close_engine(ref)
+            except Exception as e:  # noqa: BLE001 — drift gate degrades off
+                self.logger.warning("deploy: incumbent baseline probe "
+                                    "failed (drift gate off): %s", e)
+        probes = self._run_probes(self._canary_engine)
+        self._candidate = {"step": step, "dir": out_dir, "digest": digest,
+                           "probes": probes, "started": now}
+        self._pairs = []
+        with self._tap_lock:
+            self._tap_queue.clear()
+            self._tap_acc = 0.0
+            self._tap_seen = 0
+            self._tap_mirrored = 0
+        canaries_total.inc()
+        self.state = "canary"
+        self.logger.info("deploy: canarying step %d (digest %s) under "
+                         "shadow traffic", step, (digest or "")[:12])
+
+    def _tick_canary(self, now: float) -> None:
+        cand = self._candidate
+        eng = self._canary_engine
+        # Numeric gate part 1: nonfinite/failed probes reject immediately
+        # — no point mirroring traffic onto a numerically-dead candidate.
+        if cand["probes"] is None or any(
+                not all(map(math.isfinite, lps)) for _, lps in
+                cand["probes"]):
+            self._reject(cand["step"], cand["dir"],
+                         ["numeric:probe-nonfinite-or-failed"], {})
+            return
+        # Drain the mirror queue onto the candidate engine.
+        with self._tap_lock:
+            batch, self._tap_queue = self._tap_queue, []
+        for prompt, params, live_req in batch:
+            try:
+                shadow = eng.submit(prompt, params,
+                                    f"shadow-{len(self._pairs)}")
+                shadow.shadow = True
+                self._pairs.append(_ShadowPair(live_req, shadow))
+            except Exception as e:  # noqa: BLE001 — submit fault = reject
+                self._reject(cand["step"], cand["dir"],
+                             [f"numeric:shadow-submit-fault:{e}"], {})
+                return
+        # Step the candidate (bounded work per tick).
+        try:
+            for _ in range(64):
+                if not getattr(eng, "has_work", False):
+                    break
+                eng.step()
+        except Exception as e:  # noqa: BLE001 — step fault = numeric reject
+            self._reject(cand["step"], cand["dir"],
+                         [f"numeric:canary-step-fault:{type(e).__name__}"],
+                         {})
+            return
+        done_pairs = [p for p in self._pairs
+                      if p.shadow.done and p.live.done]
+        waited = now - cand["started"]
+        if len(done_pairs) < max(0, self.cfg.canary_min_requests) \
+                and waited < self.cfg.canary_max_wait_s:
+            return
+        verdict, reasons, gates = self._judge(cand, done_pairs)
+        if verdict:
+            self._begin_promote(cand, gates)
+        else:
+            self._reject(cand["step"], cand["dir"], reasons, gates)
+
+    def _judge(self, cand: dict, pairs: list):
+        """Evaluate the four gates. Returns (ok, reasons, gates-detail)."""
+        cfg = self.cfg
+        reasons: List[str] = []
+        gates: dict = {"pairs": len(pairs)}
+        # Gate: numeric faults on shadow requests.
+        errored = [p for p in pairs if p.shadow.finish_reason == "error"]
+        nonfinite = [p for p in pairs
+                     if not all(map(math.isfinite,
+                                    p.shadow.output_logprobs))]
+        gates["shadow_errors"] = len(errored)
+        gates["shadow_nonfinite"] = len(nonfinite)
+        if errored or nonfinite:
+            reasons.append(
+                f"numeric:{len(errored)}-errored,"
+                f"{len(nonfinite)}-nonfinite")
+        # Gate: greedy logprob drift on the pinned probe set.
+        drift = None
+        if self._baseline is not None and cand["probes"] is not None:
+            deltas = []
+            for (_, base_lp), (_, cand_lp) in zip(self._baseline,
+                                                  cand["probes"]):
+                if not base_lp or not cand_lp:
+                    continue
+                base_mean = sum(base_lp) / len(base_lp)
+                cand_mean = sum(cand_lp) / len(cand_lp)
+                deltas.append(abs(cand_mean - base_mean))
+            drift = max(deltas) if deltas else 0.0
+            gates["logprob_drift"] = drift
+            gates["logprob_drift_limit"] = cfg.promote_max_logprob_drift
+            if drift > cfg.promote_max_logprob_drift:
+                reasons.append(f"drift:{drift:.6g}>"
+                               f"{cfg.promote_max_logprob_drift:.6g}")
+        # Gate: output-length distribution shift (shadow vs paired live).
+        if pairs and cfg.max_length_shift_frac > 0:
+            live_mean = sum(len(p.live.output_token_ids)
+                            for p in pairs) / len(pairs)
+            shadow_mean = sum(len(p.shadow.output_token_ids)
+                              for p in pairs) / len(pairs)
+            shift = abs(shadow_mean - live_mean) / max(1.0, live_mean)
+            gates["length_shift"] = shift
+            gates["length_shift_limit"] = cfg.max_length_shift_frac
+            if shift > cfg.max_length_shift_frac:
+                reasons.append(f"length-shift:{shift:.4g}>"
+                               f"{cfg.max_length_shift_frac:.4g}")
+        # Gate: per-phase SLO compliance on the shadow requests.
+        for name, thr in (("ttft", cfg.slo_ttft_threshold_s),
+                          ("tpot", cfg.slo_tpot_threshold_s)):
+            if thr <= 0 or not pairs:
+                continue
+            vals = []
+            for p in pairs:
+                s = p.shadow
+                if s.first_token_time is None:
+                    continue
+                if name == "ttft":
+                    vals.append(s.first_token_time - s.arrival_time)
+                else:
+                    n_out = len(s.output_token_ids)
+                    if n_out > 1 and s.finish_time is not None:
+                        vals.append((s.finish_time - s.first_token_time)
+                                    / (n_out - 1))
+            if not vals:
+                continue
+            compliance = sum(1 for v in vals if v <= thr) / len(vals)
+            gates[f"{name}_compliance"] = compliance
+            if compliance < cfg.slo_min_compliance:
+                reasons.append(f"slo-{name}:{compliance:.3f}<"
+                               f"{cfg.slo_min_compliance:.3f}")
+        return (not reasons), reasons, gates
+
+    # -- promote / rollback ---------------------------------------------
+    def _begin_promote(self, cand: dict, gates: dict) -> None:
+        from dlti_tpu.checkpoint.store import (
+            load_pytree, manifest_digest, verify_pytree_dir,
+        )
+
+        export_dir = cand["dir"]
+        expect = cand["digest"]
+
+        def _provider():
+            return load_pytree(export_dir, verify=True)
+
+        def _verify() -> bool:
+            if manifest_digest(export_dir) != expect:
+                return False
+            return verify_pytree_dir(export_dir)[0]
+
+        try:
+            queued = self.engine.request_reload(_provider, verify=_verify)
+        except TypeError:
+            # Facade predating the verify kwarg (custom engines in tests).
+            queued = self.engine.request_reload(_provider)
+        if not queued:
+            # A roll is already in progress (operator-kicked /v1/reload);
+            # stay in canary and retry next tick.
+            self.logger.info("deploy: promote of step %d deferred (a "
+                             "reload is already rolling)", cand["step"])
+            return
+        self.logger.info("deploy: step %d passed canary gates; rolling "
+                         "out fleet-wide", cand["step"])
+        cand["gates"] = gates
+        self.state = "promoting"
+
+    def _tick_promoting(self, now: float) -> None:
+        if getattr(self.engine, "_reload", None) is not None:
+            return  # roll still in flight
+        cand = self._candidate
+        ok = getattr(self.engine, "last_reload_ok", None)
+        if ok is False:
+            # Mid-roll abort (in-roll canary failure or the per-swap
+            # re-verification): the candidate never finished shipping.
+            rollbacks_total.inc()
+            self._refuse(cand["step"], "reload-aborted")
+            self._quarantine_export(cand["dir"], "reload-aborted")
+            self._note_rollback(now)
+            self._last_result = {"step": cand["step"],
+                                 "verdict": "rolled-back",
+                                 "reasons": ["reload-aborted"],
+                                 "gates": cand.get("gates", {})}
+            self.logger.error("deploy: promotion of step %d aborted "
+                              "mid-roll; incumbent remains step %d",
+                              cand["step"], self.incumbent_step)
+        else:
+            promotions_total.inc()
+            self.incumbent_step = cand["step"]
+            self.incumbent_digest = cand["digest"]
+            self.incumbent_dir = cand["dir"]
+            incumbent_step_gauge.set(cand["step"])
+            # The candidate's probe results ARE the new incumbent
+            # baseline (same prompts, the now-serving weights).
+            if cand["probes"] is not None:
+                self._baseline = cand["probes"]
+            self._consecutive_rollbacks = 0
+            self._last_result = {"step": cand["step"],
+                                 "verdict": "promoted",
+                                 "reasons": [],
+                                 "gates": cand.get("gates", {})}
+            self.logger.info("deploy: step %d promoted fleet-wide "
+                             "(digest %s)", cand["step"],
+                             (cand["digest"] or "")[:12])
+        self._teardown_candidate()
+        self.state = "idle"
+
+    def _reject(self, step: int, export_dir: str, reasons: list,
+                gates: dict) -> None:
+        """Canary verdict: fail. The fleet never saw the candidate, so
+        rolling back = discarding the canary replica; the export is
+        quarantined for forensics and the step refused forever."""
+        now = self.clock()
+        rollbacks_total.inc()
+        self._refuse(step, ";".join(reasons) or "canary-reject")
+        self._quarantine_export(export_dir, "canary-reject")
+        self._note_rollback(now)
+        self._last_result = {"step": step, "verdict": "rolled-back",
+                             "reasons": reasons, "gates": gates}
+        self.logger.error(
+            "deploy: step %d REJECTED by canary gates (%s); canary rolled "
+            "back to incumbent step %d, export quarantined",
+            step, ";".join(reasons), self.incumbent_step)
+        self._teardown_candidate()
+        self.state = "idle"
+
+    def _note_rollback(self, now: float) -> None:
+        self._consecutive_rollbacks += 1
+        cfg = self.cfg
+        delay = min(cfg.promote_backoff_max_s,
+                    cfg.promote_backoff_s *
+                    cfg.promote_backoff_factor
+                    ** (self._consecutive_rollbacks - 1))
+        self._backoff_until = now + delay
+        self.logger.warning("deploy: promotion backoff %.1fs after %d "
+                            "consecutive rollback(s)", delay,
+                            self._consecutive_rollbacks)
+
+    def _quarantine_export(self, export_dir: str, reason: str) -> None:
+        from dlti_tpu.checkpoint.store import quarantine_step
+
+        try:
+            quarantine_step(os.path.dirname(export_dir),
+                            os.path.basename(export_dir), reason)
+        except Exception as e:  # noqa: BLE001 — forensics, never fatal
+            self.logger.error("deploy: could not quarantine export %s: "
+                              "%s", export_dir, e)
+
+    def _close_engine(self, eng) -> None:
+        close = getattr(eng, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _teardown_candidate(self) -> None:
+        if self._canary_engine is not None:
+            self._close_engine(self._canary_engine)
+        self._canary_engine = None
+        self._candidate = None
+        self._pairs = []
+        with self._tap_lock:
+            self._tap_queue.clear()
+
+    # -- operator surface (/v1/deploy) -----------------------------------
+    def set_enabled(self, enabled: bool) -> None:
+        """Enable/disable the controller (POST /v1/deploy). Disabling
+        cancels an in-flight canary WITHOUT refusing its step — the
+        operator paused the pipeline; the candidate was not judged."""
+        if not enabled and self.state == "canary":
+            cand = self._candidate
+            self._last_result = {"step": cand["step"],
+                                 "verdict": "cancelled",
+                                 "reasons": ["disabled"], "gates": {}}
+            self._teardown_candidate()
+            self.state = "idle"
+            self.logger.info("deploy: canary of step %d cancelled "
+                             "(controller disabled)", cand["step"])
+        self.enabled = bool(enabled)
+
+    def status(self) -> dict:
+        cand = self._candidate
+        with self._tap_lock:
+            tap = {"seen": self._tap_seen, "mirrored": self._tap_mirrored,
+                   "queued": len(self._tap_queue)}
+        return {
+            "enabled": self.enabled,
+            "state": self.state,
+            "watch_dir": self.watch_dir,
+            "export_dir": self.export_root,
+            "incumbent": {"step": self.incumbent_step,
+                          "digest": self.incumbent_digest,
+                          "dir": self.incumbent_dir},
+            "candidate": (None if cand is None else
+                          {"step": cand["step"],
+                           "digest": cand["digest"],
+                           "pairs_done": sum(
+                               1 for p in self._pairs
+                               if p.shadow.done and p.live.done)}),
+            "refused_steps": {str(k): v
+                              for k, v in sorted(self._refused.items())},
+            "consecutive_rollbacks": self._consecutive_rollbacks,
+            "backoff_until": (None if self._backoff_until == -math.inf
+                              else self._backoff_until),
+            "shadow": tap,
+            "last_result": self._last_result,
+            "counters": {
+                "candidates": candidates_total.value,
+                "canaries": canaries_total.value,
+                "promotions": promotions_total.value,
+                "rollbacks": rollbacks_total.value,
+                "rejected": rejected_total.value,
+            },
+        }
+
+    # Flight-recorder source (deploy.json in every dump).
+    def to_dict(self) -> dict:
+        return self.status()
+
+    # -- thread ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="deploy-controller")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        self._thread = None
+        # == not `is`: bound methods are materialized per-access, so an
+        # identity check would never match the instance installed in
+        # __init__ and the tap would leak past stop().
+        if getattr(self.engine, "shadow_tap", None) == self._tap:
+            self.engine.shadow_tap = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                self.logger.exception("deploy: tick raised")
+            # Canary/promote phases poll fast (shadow stepping latency);
+            # idle watches at a gentle cadence independent of
+            # poll_interval_s (the clock gates the actual dir scan).
+            self._stop.wait(0.02 if self.state != "idle" else
+                            min(0.5, max(0.05, self.cfg.poll_interval_s / 4)))

@@ -295,6 +295,9 @@ class Request:
     # re-acquire — the row may have been evicted meanwhile.
     adapter: str = ""
     _adapter_slot: int = -1
+    # Deployment-controller shadow mirror (serving.deploy): results never
+    # reach a client, and telemetry/SLO/gateway accounting skips these.
+    shadow: bool = False
 
     @property
     def done(self) -> bool:

@@ -230,6 +230,11 @@ class FleetSupervisor:
     real one, tests inject fakes.
     """
 
+    # Class-level defaults so `__new__`-built test skeletons (which skip
+    # __init__) still have the deploy-controller surface.
+    shadow_tap = None
+    last_reload_ok: Optional[bool] = None
+
     def __init__(
         self,
         engine_cfg: EngineConfig,
@@ -278,6 +283,13 @@ class FleetSupervisor:
         self._reload: Optional[dict] = None
         self._reload_tree = None  # post-reload weights for respawned workers
         self._canary_digest: Optional[str] = None
+        # Outcome of the most recent rolling reload (None until one ran);
+        # polled by the deployment controller (serving.deploy).
+        self.last_reload_ok: Optional[bool] = None
+        # Shadow-traffic tap (serving.deploy): same contract as
+        # ReplicatedEngine.shadow_tap — called (prompt, params, mirror
+        # request) on every client submit, exception-isolated.
+        self.shadow_tap = None
         self._respawns = 0
         self._closed = False
 
@@ -433,6 +445,12 @@ class FleetSupervisor:
         self.telemetry.on_submitted(req)
         self._mirror[request_id] = req
         self._pending_submits.append((req, affinity_key))
+        tap = self.shadow_tap
+        if tap is not None:
+            try:
+                tap(list(prompt_token_ids), params, req)
+            except Exception:  # noqa: BLE001 — shadow never hurts clients
+                self.logger.debug("shadow tap raised", exc_info=True)
         return req
 
     def _route(self, affinity_key: Optional[str],
@@ -888,16 +906,19 @@ class FleetSupervisor:
             w.idx, w.generation, w.pid)
 
     # -- rolling reload ----------------------------------------------------
-    def request_reload(self, weights_provider) -> bool:
+    def request_reload(self, weights_provider, *, verify=None) -> bool:
         """Enqueue a rolling weight reload (thread-safe: one GIL-atomic
         attribute write; the roll runs on the stepper thread). The
         provider must return a host param tree; it is converted to plain
         numpy dicts and shipped to each worker over FT_RELOAD after a
-        drain-via-migration. Returns False if a roll is in progress."""
+        drain-via-migration. ``verify()``, when given, re-runs before
+        every per-worker swap (the mid-roll corruption abort —
+        see :meth:`ReplicatedEngine.request_reload`). Returns False if a
+        roll is in progress."""
         if self._reload is not None:
             return False
         self._reload = {"provider": weights_provider, "tree": None,
-                        "queue": None, "digest": None}
+                        "queue": None, "digest": None, "verify": verify}
         return True
 
     @staticmethod
@@ -928,6 +949,7 @@ class FleetSupervisor:
                 self.logger.error(
                     "fleet rolling reload aborted: weights provider "
                     "failed: %s", e)
+                self.last_reload_ok = False
                 self._reload = None
                 return
             st["queue"] = [w.idx for w in self._workers
@@ -940,10 +962,26 @@ class FleetSupervisor:
             if st["digest"] is not None:
                 self._canary_digest = st["digest"]
             self._reload_tree = st["tree"]
+            self.last_reload_ok = True
             self._reload = None
             self.logger.info("fleet rolling reload complete")
             return
         idx = st["queue"][0]
+        if st.get("verify") is not None:
+            # Mid-roll re-verification (same contract as ReplicatedEngine:
+            # the export's bytes must still verify before EVERY swap).
+            ok_verify = False
+            try:
+                ok_verify = bool(st["verify"]())
+            except Exception as e:  # noqa: BLE001 — verify fault = fail
+                self.logger.error("fleet reload re-verify raised: %s", e)
+            if not ok_verify:
+                self.logger.error(
+                    "fleet rolling reload aborted: export failed "
+                    "re-verification before worker %d swap", idx)
+                self.last_reload_ok = False
+                self._reload = None
+                return
         w = self._workers[idx]
         others = [v for v in self._live_for_dispatch() if v.idx != idx]
         if others:
@@ -976,6 +1014,7 @@ class FleetSupervisor:
             self.logger.error("fleet worker %d reload rpc failed: %s",
                               idx, e)
             st["queue"].pop(0)
+            self.last_reload_ok = False
             self._reload = None
             self._dead.discard(idx)
             self._fail_worker(w, e)
@@ -988,6 +1027,7 @@ class FleetSupervisor:
             self.logger.error(
                 "fleet rolling reload aborted: worker %d failed canary on "
                 "new weights; fleet stays on previous weights", idx)
+            self.last_reload_ok = False
             self._reload = None
             # The inconsistent worker is torn down; it respawns onto the
             # boot/previous weights and canaries back in.

@@ -91,6 +91,11 @@ class ReplicatedEngine:
     ``DLTI_GATEWAY_FAULT_INJECT`` env var), format ``"REPLICA:STEP"``,
     kills a replica deterministically for tests and chaos runs."""
 
+    # Class-level defaults so `__new__`-built test skeletons (which skip
+    # __init__) still have the deploy-controller surface.
+    shadow_tap = None
+    last_reload_ok: Optional[bool] = None
+
     def __init__(
         self,
         model_cfg: ModelConfig,
@@ -211,6 +216,16 @@ class ReplicatedEngine:
         self._draining: set = set()
         self._warmed = False
         self._reload: Optional[dict] = None
+        # Outcome of the most recent rolling reload (None until one ran):
+        # the deployment controller polls this to learn whether its
+        # promotion completed or aborted mid-roll.
+        self.last_reload_ok: Optional[bool] = None
+        # Shadow-traffic tap (serving.deploy): when set, every client
+        # submit is offered to the callable as (prompt_token_ids, params,
+        # live_request) AFTER dispatch — the tap mirrors a sampled
+        # fraction onto a canary engine; its results never reach clients
+        # and a tap failure never breaks a client submit.
+        self.shadow_tap = None
         # Known-good weights for quarantine rebuilds: a host snapshot of
         # the boot tree (only paid when healing is on); a completed
         # rolling reload replaces it with the new tree.
@@ -289,6 +304,12 @@ class ReplicatedEngine:
         req = eng.submit(prompt_token_ids, params, request_id,
                          **({"adapter": adapter} if adapter else {}))
         req.replica = self.engines.index(eng)
+        tap = self.shadow_tap
+        if tap is not None:
+            try:
+                tap(list(prompt_token_ids), params, req)
+            except Exception:  # noqa: BLE001 — shadow never hurts clients
+                self.logger.debug("shadow tap raised", exc_info=True)
         return req
 
     @property
@@ -622,17 +643,21 @@ class ReplicatedEngine:
         if self.lifecycle.on_probe_result(idx, ok) == "live":
             self._dead.discard(idx)
 
-    def request_reload(self, weights_provider) -> bool:
+    def request_reload(self, weights_provider, *, verify=None) -> bool:
         """Enqueue a rolling weight reload (thread-safe: one GIL-atomic
         attribute write; the roll itself runs on the stepper thread).
         ``weights_provider()`` is called once there and must return a
         host param tree with the boot tree's structure — the server's
         /v1/reload handler wraps a verified checkpoint-store load.
-        Returns False if a roll is already in progress."""
+        ``verify()``, when given, is re-run immediately before EVERY
+        per-replica swap (not just at the initial load): an export whose
+        bytes rot mid-roll aborts the roll before the next replica
+        touches it, instead of canary-failing halfway through. Returns
+        False if a roll is already in progress."""
         if self._reload is not None:
             return False
         self._reload = {"provider": weights_provider, "host": None,
-                        "queue": None, "digest": None}
+                        "queue": None, "digest": None, "verify": verify}
         return True
 
     def _reload_tick(self) -> None:
@@ -649,6 +674,7 @@ class ReplicatedEngine:
             except Exception as e:  # noqa: BLE001 — bad checkpoint aborts roll
                 self.logger.error(
                     "rolling reload aborted: weights provider failed: %s", e)
+                self.last_reload_ok = False
                 self._reload = None
                 return
             st["queue"] = [i for i in range(len(self.engines))
@@ -659,10 +685,32 @@ class ReplicatedEngine:
             self._weights_host = st["host"]
             if st["digest"] is not None:
                 self._canary_digest = st["digest"]
+            self.last_reload_ok = True
             self._reload = None
             self.logger.info("rolling reload complete")
             return
         idx = st["queue"][0]
+        if st.get("verify") is not None:
+            # Re-verify the export bytes before EVERY swap, not just the
+            # initial provider load — a reload source corrupted mid-roll
+            # (disk fault, concurrent overwrite) aborts here, before the
+            # next replica is drained, instead of burning a drain +
+            # rebuild on weights the canary would reject anyway. The
+            # replicas already swapped keep the verified tree they loaded.
+            ok_verify = False
+            try:
+                ok_verify = bool(st["verify"]())
+            except Exception as e:  # noqa: BLE001 — verify fault = fail
+                self.logger.error("reload re-verify raised: %s", e)
+            if not ok_verify:
+                self.logger.error(
+                    "rolling reload aborted: export failed re-verification "
+                    "before replica %d swap; fleet keeps serving (%d "
+                    "replica(s) already on new weights stay)", idx,
+                    len(self.engines) - len(st["queue"]))
+                self.last_reload_ok = False
+                self._reload = None
+                return
         eng = self.engines[idx]
         others = [e for i, e in enumerate(self.engines)
                   if i != idx and i not in self._dead
@@ -703,6 +751,7 @@ class ReplicatedEngine:
             self.logger.error(
                 "rolling reload aborted: replica %d failed canary on new "
                 "weights; fleet stays on previous weights", idx)
+            self.last_reload_ok = False
             self._reload = None
 
     def _lifecycle_tick(self) -> None:

@@ -98,6 +98,15 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
                    _sentinel.sdc_probes_total,
                    _sentinel.sdc_mismatches_total):
         registry.register(metric)
+    # Continuous-delivery counters (serving.deploy): module-level like the
+    # watchdog/flight pair, so the sampler rings them for /dashboard and
+    # the watchdog's canary_regression rule watches rollbacks grow.
+    from dlti_tpu.serving import deploy as _deploy
+
+    for metric in (_deploy.candidates_total, _deploy.canaries_total,
+                   _deploy.promotions_total, _deploy.rollbacks_total,
+                   _deploy.rejected_total, _deploy.incumbent_step_gauge):
+        registry.register(metric)
     # Tiered prefix-cache telemetry (module-level like the watchdog /
     # flight counters, so replicas aggregate into one series): per-tier
     # hit/miss/eviction/promotion/demotion counters + block gauges.
@@ -454,6 +463,7 @@ class _Handler(BaseHTTPRequestHandler):
     gateway = None  # AdmissionGateway when ServerConfig.gateway enables it
     sampler = None  # TimeSeriesSampler behind /debug/vars + /dashboard
     slo = None  # SLOTracker behind /debug/slo (telemetry.slo)
+    deploy = None  # DeploymentController behind /v1/deploy (serving.deploy)
     profile_lock = None  # threading.Lock guarding POST /debug/profile
 
     def log_message(self, fmt, *args):  # route through our logger
@@ -689,6 +699,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(404, "tracing disabled (start the "
                                         "server with --trace-dir)")
             self._json(200, tracer.to_dict())
+        elif self.path == "/v1/deploy":
+            # Continuous-delivery state (serving.deploy): incumbent
+            # step/digest, canary in flight, refused steps, gate verdict
+            # of the last candidate — the JSON twin of the flight dump's
+            # deploy.json.
+            if self.deploy is None:
+                return self._error(404, "deploy controller disabled "
+                                        "(start the server with "
+                                        "--deploy-watch)")
+            self._json(200, self.deploy.status())
         elif self.path == "/v1/models":
             self._json(200, {"object": "list", "data": [{
                 "id": self.cfg.model_name, "object": "model",
@@ -713,6 +733,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._register_adapter()
         elif self.path == "/v1/reload":
             self._reload_weights()
+        elif self.path == "/v1/deploy":
+            self._deploy_control()
         elif self.path == "/debug/profile":
             self._profile()
         else:
@@ -746,19 +768,49 @@ class _Handler(BaseHTTPRequestHandler):
                 400, "engine has no replica lifecycle (rolling reload "
                      "needs a replicated fleet; restart single-engine "
                      "servers instead)")
-        from dlti_tpu.checkpoint.store import load_pytree
+        from dlti_tpu.checkpoint.store import (
+            load_pytree, manifest_digest, verify_pytree_dir,
+        )
 
         def _provider():
             # Runs once on the stepper thread: digest-verified load — a
             # corrupt artifact aborts the roll before any replica swaps.
             return load_pytree(directory, verify=True)
 
-        if not request_reload(_provider):
+        # Pin the digest NOW, then re-verify immediately before EVERY
+        # per-replica swap: an artifact corrupted mid-roll (bit rot, a
+        # re-export racing the roll) aborts the remaining swaps instead
+        # of shipping different bytes to different replicas.
+        expect_digest = manifest_digest(directory)
+
+        def _verify() -> bool:
+            if manifest_digest(directory) != expect_digest:
+                return False
+            return verify_pytree_dir(directory)[0]
+
+        if not request_reload(_provider, verify=_verify):
             return self._error(409, "a rolling reload is already in "
                                     "progress")
         with self.async_engine._work:
             self.async_engine._work.notify()  # wake an idle stepper
         self._json(200, {"status": "reloading", "directory": directory})
+
+    def _deploy_control(self) -> None:
+        """Operator switch for the continuous-delivery pipeline:
+        ``POST /v1/deploy {"enabled": bool}``. Disabling cancels any
+        in-flight canary without judging it (the step stays eligible);
+        enabling resumes the watch loop. 404 when no controller is
+        wired (start the server with ``--deploy-watch``)."""
+        if self.deploy is None:
+            return self._error(404, "deploy controller disabled (start "
+                                    "the server with --deploy-watch)")
+        body = self._read_body()
+        if body is None:
+            return
+        if "enabled" not in body:
+            return self._error(400, "enabled is required")
+        self.deploy.set_enabled(bool(body["enabled"]))
+        self._json(200, self.deploy.status())
 
     def _register_adapter(self) -> None:
         """Hot-register a trained adapter checkpoint with zero restart:
@@ -1252,7 +1304,8 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
-                cfg: Optional[ServerConfig] = None,
+                cfg: Optional[ServerConfig] = None, *,
+                deploy=None,
                 ) -> Tuple[ThreadingHTTPServer, AsyncEngine]:
     """Build (but don't start) the HTTP server; caller runs serve_forever().
 
@@ -1260,6 +1313,12 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     :class:`~dlti_tpu.serving.gateway.AdmissionGateway` is built between
     the handlers and the engine (reachable as ``httpd.gateway``); left
     unset, admission is the legacy direct path.
+
+    ``deploy`` is an optional
+    :class:`~dlti_tpu.serving.deploy.DeploymentController` (built by
+    ``scripts/serve.py --deploy-watch``): it gains the ``/v1/deploy``
+    surface, a ``deploy.json`` section in flight dumps, and its thread is
+    started here / stopped by :func:`serve`'s shutdown path.
     """
     cfg = cfg or ServerConfig()
     async_engine = AsyncEngine(engine)
@@ -1326,6 +1385,8 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
             recorder.add_memory_source(engine.memledger.to_dict)
         if slo_tracker is not None:
             recorder.add_slo_source(slo_tracker.to_dict)
+        if deploy is not None:
+            recorder.add_deploy_source(deploy.to_dict)
         recorder.note(role="serving", model=cfg.model_name)
         install_recorder(recorder)
     watchdog = None
@@ -1336,10 +1397,14 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
                 lambda: {"watchdog_alerts": list(watchdog.alerts)})
         watchdog.start()
 
+    if deploy is not None:
+        deploy.start()
+
     handler = type("BoundHandler", (_Handler,), {
         "async_engine": async_engine, "tokenizer": tokenizer, "cfg": cfg,
         "registry": registry, "gateway": gateway, "sampler": sampler,
-        "slo": slo_tracker, "profile_lock": threading.Lock(),
+        "slo": slo_tracker, "deploy": deploy,
+        "profile_lock": threading.Lock(),
     })
     httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
     httpd.daemon_threads = True
@@ -1348,14 +1413,16 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     httpd.watchdog = watchdog
     httpd.flight_recorder = recorder
     httpd.slo = slo_tracker
+    httpd.deploy = deploy
     return httpd, async_engine
 
 
 def serve(engine: InferenceEngine, tokenizer: Tokenizer,
-          cfg: Optional[ServerConfig] = None) -> None:
+          cfg: Optional[ServerConfig] = None, *, deploy=None) -> None:
     """Blocking entry point (used by ``scripts/serve.py``)."""
     cfg = cfg or ServerConfig()
-    httpd, async_engine = make_server(engine, tokenizer, cfg)
+    httpd, async_engine = make_server(engine, tokenizer, cfg,
+                                      deploy=deploy)
     gateway = httpd.gateway
     get_logger().info("serving on http://%s:%d (model=%s)",
                       cfg.host, cfg.port, cfg.model_name)
@@ -1399,6 +1466,10 @@ def serve(engine: InferenceEngine, tokenizer: Tokenizer,
             # SIGTERM for the process lifetime.
             _signal.signal(_signal.SIGTERM,
                            prev_handler or _signal.SIG_DFL)
+        if httpd.deploy is not None:
+            # Stop the delivery pipeline FIRST: a promotion racing the
+            # drain would roll replicas while the stepper is parking.
+            httpd.deploy.stop()
         if gateway is not None:
             gateway.shutdown()
         if httpd.watchdog is not None:

@@ -69,7 +69,8 @@ _PREFIX = "flight-"
 _TMP = ".tmp-"
 MANIFEST = "MANIFEST.json"
 DUMP_FILES = ("context.json", "spans.json", "metrics.json",
-              "timeseries.json", "config.json", "memory.json", "slo.json")
+              "timeseries.json", "config.json", "memory.json", "slo.json",
+              "deploy.json")
 
 
 def config_fingerprint(config) -> Optional[str]:
@@ -108,6 +109,7 @@ class FlightRecorder:
         self._context_sources: List[Callable[[], dict]] = []
         self._memory_sources: List[Callable[[], dict]] = []
         self._slo_sources: List[Callable[[], dict]] = []
+        self._deploy_sources: List[Callable[[], dict]] = []
         self._last_dump_t = 0.0
         self.last_dump_path: Optional[str] = None
         self.dump_failures = 0
@@ -146,6 +148,12 @@ class FlightRecorder:
         (``SLOTracker.to_dict`` — compliance/budget/burn state at
         death)."""
         self._slo_sources.append(fn)
+
+    def add_deploy_source(self, fn: Callable[[], dict]) -> None:
+        """A callable snapshotted into ``deploy.json`` at dump time
+        (``DeploymentController.to_dict`` — incumbent/candidate/refused
+        state of the continuous-delivery pipeline at death)."""
+        self._deploy_sources.append(fn)
 
     # -- the dump -------------------------------------------------------
     def dump(self, reason: str, exc: Optional[BaseException] = None,
@@ -242,6 +250,14 @@ class FlightRecorder:
             except Exception:
                 slo.setdefault("slo_source_errors", 0)
                 slo["slo_source_errors"] += 1
+        # And for deploy.json: always written, {} when no controller.
+        deploy: dict = {}
+        for fn in self._deploy_sources:
+            try:
+                deploy.update(fn())
+            except Exception:
+                deploy.setdefault("deploy_source_errors", 0)
+                deploy["deploy_source_errors"] += 1
 
         label = (f"step{int(context['step']):08d}" if "step" in context
                  else time.strftime("%Y%m%dT%H%M%S"))
@@ -294,6 +310,7 @@ class FlightRecorder:
                             else (self.config or {})),
             "memory.json": memory,
             "slo.json": slo,
+            "deploy.json": deploy,
         }
         manifest: dict = {"format": 1, "reason": reason,
                           "created": time.time(), "files": {}}

@@ -81,7 +81,14 @@ class RequestTelemetry:
         return (self.ttft, self.tpot, self.queue_time, self.host_prep)
 
     # -- lifecycle hooks (called by the engine) -------------------------
+    # Requests flagged ``shadow`` (the deployment controller's mirrored
+    # canary traffic, serving.deploy) never book into the client-facing
+    # histograms or phase attribution: shadow results never reach a
+    # client, so counting them would dilute the SLIs the SLO objectives
+    # are computed from.
     def on_submitted(self, req) -> None:
+        if getattr(req, "shadow", False):
+            return
         self.tracer.instant("request/submitted", cat="request",
                             tid=_req_tid(req.request_id), id=req.request_id)
 
@@ -90,6 +97,8 @@ class RequestTelemetry:
         preemption keeps the original queue-time sample (the request
         queued once — recompute is decode-side churn) and only marks the
         trace."""
+        if getattr(req, "shadow", False):
+            return
         now = time.monotonic()
         # Close any open requeue mark (preemption / failover wait books
         # to its own phase in the request's critical-path breakdown).
@@ -107,6 +116,8 @@ class RequestTelemetry:
                                 preemptions=req.num_preemptions)
 
     def on_first_token(self, req) -> None:
+        if getattr(req, "shadow", False):
+            return
         self.ttft.observe(req.first_token_time - req.arrival_time)
         start = (req.admitted_time if req.admitted_time is not None
                  else req.arrival_time)
@@ -116,6 +127,8 @@ class RequestTelemetry:
             prompt_tokens=len(req.prompt_token_ids))
 
     def on_finished(self, req) -> None:
+        if getattr(req, "shadow", False):
+            return
         n_out = len(req.output_token_ids)
         first = req.first_token_time
         finish = req.finish_time if req.finish_time is not None \

@@ -102,7 +102,7 @@ RULES = ("hung_step", "throughput_collapse", "queue_buildup",
          "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
          "nonfinite_step", "loss_spike", "sdc_mismatch",
          "goodput_collapse", "hbm_pressure", "disk_pressure",
-         "replica_flap", "slo_burn")
+         "replica_flap", "slo_burn", "canary_regression")
 
 # Sentinel-counter rules (rule, ring keys summed): fire when the summed
 # counters grew since the previous check (edge: a sustained anomaly burst
@@ -477,6 +477,32 @@ class AnomalyWatchdog:
                         fired.append(a)
                 elif prev is not None:
                     self._active.discard("replica_flap")
+
+        # canary_regression: the deploy controller rolled a candidate
+        # back — a training run shipped a checkpoint that failed live
+        # canary gates, which a human should look at even though the
+        # fleet protected itself.
+        if getattr(self.cfg, "canary_regression_limit", 0) > 0:
+            rb_keys = [k for k in latest
+                       if k.startswith("dlti_deploy_rollbacks_total")]
+            if rb_keys:
+                rolls = sum(float(latest[k]) for k in rb_keys)
+                prev = self._watermarks.get("canary_regression")
+                self._watermarks["canary_regression"] = rolls
+                if prev is not None and rolls > prev:
+                    a = self._fire(
+                        "canary_regression", "canary_regression",
+                        f"canary_regression: deploy controller rolled "
+                        f"back a candidate checkpoint "
+                        f"({rolls - prev:.0f} new rollback(s), "
+                        f"{rolls:.0f} total) — the incumbent still "
+                        f"serves, but the training run is producing "
+                        f"checkpoints that fail canary gates",
+                        grew=rolls - prev, total=rolls)
+                    if a:
+                        fired.append(a)
+                elif prev is not None:
+                    self._active.discard("canary_regression")
 
         # slo_burn: an (objective, class) is burning its error budget --
         if self.slo is not None \

@@ -98,7 +98,15 @@ def main() -> None:
     state = restore_train_state(args.checkpoint_dir, step, template)
     out = export_merged_model(args.out, state.params, cfg,
                               merge_lora=not args.keep_lora)
+    # The export's content identity on stdout: the params manifest
+    # SHA-256 that /v1/reload re-verification and the deploy controller
+    # pin — so release tooling can record what it just produced and
+    # later assert the fleet is serving exactly those bytes.
+    from dlti_tpu.checkpoint import manifest_digest
+
+    digest = manifest_digest(os.path.join(out, "model"))
     print(f"export -> {out}")
+    print(f"manifest sha256: {digest}")
 
 
 if __name__ == "__main__":

@@ -204,6 +204,25 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
                 key=lambda kv: kv[1]["error_budget_remaining"]
                 if kv[1]["error_budget_remaining"] is not None else 1.0)),
         }
+    # Continuous delivery (serving.deploy): every dump carries
+    # deploy.json — {} unless a deploy controller was wired. "What was
+    # the fleet serving, what was being canaried, and had anything been
+    # rolled back" places a serving incident relative to the last
+    # deployment.
+    dep_file = data.get("deploy.json") or {}
+    deploy = None
+    if dep_file.get("incumbent") is not None:
+        deploy = {
+            "enabled": dep_file.get("enabled"),
+            "state": dep_file.get("state"),
+            "incumbent": dep_file.get("incumbent"),
+            "candidate": dep_file.get("candidate"),
+            "refused_steps": dep_file.get("refused_steps") or {},
+            "consecutive_rollbacks": dep_file.get(
+                "consecutive_rollbacks", 0),
+            "last_result": dep_file.get("last_result"),
+            "counters": dep_file.get("counters") or {},
+        }
     # Numeric-fault evidence: sentinel dumps carry their verdict in
     # context.json's top level (rollback streak / SDC alert), and any
     # dump may carry the last anomaly the trainer noted.
@@ -261,6 +280,7 @@ def summarize(dump_dir: str, span_tail: int = 15) -> dict:
         "goodput": goodput,
         "memory": memory,
         "slo": slo,
+        "deploy": deploy,
         "disagg": disagg,
         "watchdog_alerts": alerts,
         "dropped_span_events": spans.get("droppedEvents", 0),
@@ -483,6 +503,36 @@ def render(summary: dict) -> str:
               + f" (target {100 * (o.get('target') or 0):.2f}%)  budget "
               + (f"{100 * budget:6.1f}%" if budget is not None else "    ?")
               + f"  worst burn {o.get('worst_burn', 0):.1f}x{mark}")
+    if summary.get("deploy"):
+        d = summary["deploy"]
+        inc = d.get("incumbent") or {}
+        state = d.get("state")
+        w(f"continuous delivery:   (controller "
+          f"{'enabled' if d.get('enabled') else 'DISABLED'}, "
+          f"state {state})")
+        dig = inc.get("digest") or "?"
+        w(f"    incumbent: step {inc.get('step')} "
+          f"(digest {str(dig)[:12]})")
+        cand = d.get("candidate")
+        if cand:
+            w(f"    candidate under canary: step {cand.get('step')} "
+              f"({cand.get('pairs_done', 0)} shadow pair(s) done)")
+        last = d.get("last_result")
+        if last:
+            reasons = ", ".join(last.get("reasons") or []) or "-"
+            w(f"    last verdict: step {last.get('step')} "
+              f"{last.get('verdict')} ({reasons})")
+        refused = d.get("refused_steps") or {}
+        if refused:
+            w(f"    refused steps: "
+              + ", ".join(sorted(refused, key=int)))
+        c = d.get("counters") or {}
+        if c:
+            w(f"    counters: {c.get('promotions', 0)} promoted, "
+              f"{c.get('rollbacks', 0)} rolled back, "
+              f"{c.get('rejected', 0)} refused "
+              f"({d.get('consecutive_rollbacks', 0)} consecutive "
+              f"rollback(s) at death)")
     if summary.get("disagg"):
         d = summary["disagg"]
         alive = d.get("replicas_alive") or {}

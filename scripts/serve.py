@@ -353,6 +353,38 @@ def parse_args():
                    help="worst-latency requests retained with full "
                         "critical-path timelines (queue, prefill, tier "
                         "restore, failover, decode) for GET /debug/slow")
+    p.add_argument("--deploy-watch", default="", metavar="DIR",
+                   help="continuous delivery (serving.deploy): watch this "
+                        "training checkpoint dir for newly COMMITted "
+                        "verified steps, export each candidate, canary it "
+                        "on shadow traffic beside the fleet, and promote "
+                        "or roll back autonomously; needs a replicated "
+                        "fleet (--replicas/--self-heal/--fleet-workers)")
+    p.add_argument("--deploy-export-dir", default="",
+                   help="where candidate params exports land "
+                        "(default: <watch>/_deploy_exports)")
+    p.add_argument("--deploy-poll-interval", type=float, default=5.0,
+                   help="checkpoint-dir poll cadence, seconds")
+    p.add_argument("--canary-shadow-frac", type=float, default=0.25,
+                   help="fraction of live requests mirrored onto the "
+                        "canary engine as shadow traffic (shadow results "
+                        "never reach clients and never book into client "
+                        "SLIs)")
+    p.add_argument("--canary-min-requests", type=int, default=8,
+                   help="completed shadow/live request pairs required "
+                        "before the canary verdict")
+    p.add_argument("--canary-max-wait", type=float, default=120.0,
+                   help="max seconds to wait for --canary-min-requests "
+                        "before judging with whatever shadow traffic "
+                        "arrived")
+    p.add_argument("--promote-max-logprob-drift", type=float, default=0.25,
+                   help="max |mean greedy logprob delta| per pinned probe "
+                        "prompt vs the incumbent before the candidate is "
+                        "rejected")
+    p.add_argument("--promote-backoff", type=float, default=30.0,
+                   help="initial backoff after a rollback before the next "
+                        "candidate is canaried (doubles per consecutive "
+                        "rollback)")
     return p.parse_args()
 
 
@@ -612,12 +644,65 @@ def main() -> None:
         rdir = args.reload_checkpoint
         reload_fn(lambda: load_pytree(rdir, verify=True))
         print(f"rolling weight reload queued from {rdir}")
+    deploy = None
+    if args.deploy_watch:
+        # Continuous delivery: the controller watches the training run's
+        # checkpoint dir, exports each new verified step, canaries it on
+        # a shadow replica built BESIDE the fleet (client capacity never
+        # shrinks), and promotes through the same rolling-reload path as
+        # POST /v1/reload — or rolls back, quarantines, and refuses.
+        if getattr(engine, "request_reload", None) is None:
+            raise SystemExit("--deploy-watch needs a replicated fleet "
+                             "(--replicas > 1, --self-heal, or "
+                             "--fleet-workers)")
+        import dataclasses as _dc
+
+        from dlti_tpu.checkpoint.store import load_pytree as _load_pytree
+        from dlti_tpu.config import DeployConfig
+        from dlti_tpu.serving.deploy import DeploymentController
+
+        dcfg = DeployConfig(
+            enabled=True,
+            watch_dir=args.deploy_watch,
+            export_dir=args.deploy_export_dir,
+            poll_interval_s=args.deploy_poll_interval,
+            canary_shadow_frac=args.canary_shadow_frac,
+            canary_min_requests=args.canary_min_requests,
+            canary_max_wait_s=args.canary_max_wait,
+            promote_max_logprob_drift=args.promote_max_logprob_drift,
+            promote_backoff_s=args.promote_backoff,
+            slo_ttft_threshold_s=args.slo_ttft_s,
+            slo_tpot_threshold_s=args.slo_tpot_s)
+        # The canary engine is a deliberately small shadow replica: a few
+        # slots and a modest KV pool judge gates fine, and the tiered
+        # prefix cache / adapters / memory ledger stay off so the shadow
+        # can never contend with the fleet for those singletons.
+        canary_ec = _dc.replace(
+            ec, max_seqs=min(ec.max_seqs, 4),
+            num_blocks=min(ec.num_blocks, 512),
+            enable_prefix_caching=False, prefix_host_blocks=0,
+            prefix_disk_dir="", prefix_disk_blocks=0,
+            memory_ledger=False, adapter_slots=0)
+
+        def _canary_factory(export_dir):
+            cparams = _load_pytree(export_dir, verify=True)
+            return InferenceEngine(model_cfg, cparams, canary_ec, None,
+                                   donate_params=True)
+
+        incumbent = args.model_dir if (args.model_dir and os.path.isfile(
+            os.path.join(args.model_dir, "MANIFEST.json"))) else ""
+        deploy = DeploymentController(
+            engine, dcfg, canary_factory=_canary_factory,
+            incumbent_dir=incumbent)
+        print(f"deploy controller: watching {args.deploy_watch} "
+              f"(shadow frac {args.canary_shadow_frac}, min pairs "
+              f"{args.canary_min_requests}; control: /v1/deploy)")
     print(f"serving on http://{args.host}:{args.port}  "
           f"(pool: {args.num_blocks} blocks x {args.block_size} tokens)")
     print(f"live dashboard: http://{args.host}:{args.port}/dashboard  "
           f"(JSON: /debug/vars; profiler: POST /debug/profile)")
     try:
-        serve(engine, tok, sc)
+        serve(engine, tok, sc, deploy=deploy)
     finally:
         if args.fleet_workers > 0:
             engine.close()  # FT_SHUTDOWN + terminate/kill ladder

@@ -198,7 +198,7 @@ def test_watchdog_and_flight_metric_names_are_schema_stable():
         "shed_buildup", "heartbeat_stale", "ckpt_retry_storm",
         "nonfinite_step", "loss_spike", "sdc_mismatch",
         "goodput_collapse", "hbm_pressure", "disk_pressure",
-        "replica_flap", "slo_burn",
+        "replica_flap", "slo_burn", "canary_regression",
     )
 
 
@@ -282,6 +282,31 @@ def test_lifecycle_metric_names_are_schema_stable():
     assert lifecycle.STATES == (
         "live", "quarantined", "probing", "draining", "evicted",
     )
+
+
+def test_deploy_metric_names_are_schema_stable():
+    """Continuous-delivery telemetry names are a scrape contract like
+    the lifecycle/watchdog sets: the candidate/canary/promote/rollback/
+    refuse counters the canary_regression rule and release dashboards
+    key on, plus the incumbent-step gauge, all registered by the server
+    registry."""
+    from dlti_tpu.serving import deploy
+
+    assert deploy.DEPLOY_METRIC_NAMES == (
+        "dlti_deploy_candidates_total",
+        "dlti_deploy_canaries_total",
+        "dlti_deploy_promotions_total",
+        "dlti_deploy_rollbacks_total",
+        "dlti_deploy_rejected_total",
+        "dlti_deploy_incumbent_step",
+    )
+    assert deploy.candidates_total.name == deploy.DEPLOY_METRIC_NAMES[0]
+    assert deploy.canaries_total.name == deploy.DEPLOY_METRIC_NAMES[1]
+    assert deploy.promotions_total.name == deploy.DEPLOY_METRIC_NAMES[2]
+    assert deploy.rollbacks_total.name == deploy.DEPLOY_METRIC_NAMES[3]
+    assert deploy.rejected_total.name == deploy.DEPLOY_METRIC_NAMES[4]
+    assert deploy.incumbent_step_gauge.name == \
+        deploy.DEPLOY_METRIC_NAMES[5]
 
 
 def test_fleet_metric_names_are_schema_stable():
@@ -558,7 +583,7 @@ def test_debug_vars_and_dump_surface_contract():
             "source_errors", "latest", "samples"} <= set(snap)
     assert DUMP_FILES == ("context.json", "spans.json", "metrics.json",
                           "timeseries.json", "config.json", "memory.json",
-                          "slo.json")
+                          "slo.json", "deploy.json")
     assert MANIFEST == "MANIFEST.json"
 
 

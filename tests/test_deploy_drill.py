@@ -1,0 +1,241 @@
+"""Headline continuous-delivery drill (slow tier): a live train->serve
+loop where chaos poisons one checkpoint and the canary gates keep it off
+the fleet.
+
+Everything real except the wall clock: a drill trainer writes committed
+train-state checkpoints into the watch dir (``save_train_state``), the
+controller exports candidates host-side (``export_params_host``),
+canaries them on real ``InferenceEngine`` instances against real shadow
+traffic mirrored off a real two-replica ``ReplicatedEngine``, and
+promotes through the real per-swap-verified ``request_reload`` path.
+
+Two poison variants, per the sentinel chaos taxonomy:
+
+* **nan-grad**: one checkpoint is saved with a NaN param leaf (a
+  nonfinite update that slipped past training), then training rolls the
+  params back in memory and continues clean. The canary's *numeric*
+  gate rejects it at the probe stage.
+* **param-flip**: the pipeline is frozen (lr=0) so every clean
+  checkpoint is bit-identical, and one checkpoint gets a single
+  *exponent* bit flipped in one param element. The *drift* gate (pinned
+  greedy probes vs the incumbent) rejects it. The exponent bit — not
+  the injector's lowest-mantissa SDC bit — is deliberate: a canary
+  judges behavior, so the drill flips a bit that moves logits; the
+  bit-exact silent flips are the cross-rank digest probe's job
+  (``training.sentinel``), not the canary's.
+
+Both variants assert the full contract: at least one booked rollback,
+the rejected export quarantined, the fleet's incumbent digest unchanged
+until a later clean checkpoint promotes, and ZERO client-visible errors
+throughout.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training.train_state import TrainState
+
+from dlti_tpu.checkpoint.store import (
+    load_pytree, manifest_digest, save_pytree, save_train_state,
+)
+from dlti_tpu.config import MODEL_PRESETS, DeployConfig
+from dlti_tpu.models import LlamaForCausalLM
+from dlti_tpu.serving import (
+    EngineConfig, InferenceEngine, ReplicatedEngine, SamplingParams,
+)
+from dlti_tpu.serving import deploy as deploy_mod
+from dlti_tpu.serving.deploy import DeploymentController
+
+pytestmark = pytest.mark.slow
+
+CFG = MODEL_PRESETS["llama_tiny"]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    model = LlamaForCausalLM(CFG, None)
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ec():
+    return EngineConfig(max_seqs=4, block_size=8, num_blocks=64,
+                        max_model_len=128, cache_dtype="float32",
+                        eos_token_id=-1)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _first_leaf_path(params):
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return flat[0][0]
+
+
+def _with_leaf(params, poison):
+    """params with its first leaf replaced by poison(leaf)."""
+    target = _first_leaf_path(params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: poison(leaf) if path == target else leaf,
+        params)
+
+
+def _nan_leaf(leaf):
+    return jnp.full_like(leaf, jnp.nan)
+
+
+def _exponent_flip_leaf(leaf):
+    host = np.array(jax.device_get(leaf), dtype=np.float32).copy()
+    flat = host.reshape(-1)
+    bits = flat.view(np.uint32)
+    bits[0] ^= np.uint32(1) << np.uint32(28)
+    return jnp.asarray(host)
+
+
+class DrillTrainer:
+    """Frozen-pipeline drill trainer: every clean save is bit-identical;
+    a poisoned save corrupts the params, writes the committed
+    checkpoint, then rolls the corruption back in memory (the in-memory
+    state stays healthy — the *artifact* is what's bad)."""
+
+    def __init__(self, watch_dir, params):
+        self.watch_dir = watch_dir
+        self.state = TrainState.create(
+            apply_fn=lambda *a, **k: None, params=params,
+            tx=optax.sgd(0.0))
+
+    def save(self, step, poison=None):
+        params = self.state.params
+        if poison is not None:
+            params = _with_leaf(params, poison)
+        save_train_state(self.watch_dir, step,
+                         self.state.replace(params=params),
+                         keep=None, async_save=False)
+
+
+def _run_drill(tmp_path, tiny_params, *, poison, drift_limit,
+               reject_reason_prefix):
+    watch = str(tmp_path / "watch")
+    os.makedirs(watch)
+    incumbent = save_pytree(str(tmp_path / "incumbent"),
+                            jax.device_get(tiny_params))
+
+    rep = ReplicatedEngine(CFG, tiny_params, _ec(), replicas=2, tensor=1,
+                           devices=jax.devices()[:2])
+
+    def canary_factory(export_dir):
+        cparams = load_pytree(export_dir, verify=True)
+        return InferenceEngine(CFG, cparams, _ec())
+
+    clk = _Clock()
+    ctrl = DeploymentController(
+        rep,
+        DeployConfig(enabled=True, watch_dir=watch,
+                     export_dir=str(tmp_path / "exports"),
+                     poll_interval_s=1.0, canary_shadow_frac=1.0,
+                     canary_min_requests=2, canary_max_wait_s=300.0,
+                     promote_max_logprob_drift=drift_limit,
+                     probe_prompts=2, probe_prompt_tokens=4,
+                     probe_max_tokens=3, promote_backoff_s=0.0),
+        canary_factory=canary_factory, incumbent_dir=incumbent,
+        clock=clk)
+
+    trainer = DrillTrainer(watch, tiny_params)
+    live_reqs = []
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+
+    def pump_round():
+        """One beat of the live loop: client traffic lands (and gets
+        mirrored by the tap mid-canary), the fleet serves it to
+        completion, then the controller ticks."""
+        reqs = [rep.submit([1, 2, 3, 4, 5], sp) for _ in range(2)]
+        for _ in range(2000):
+            if all(r.done for r in reqs) and not rep.has_work:
+                break
+            rep.step()
+        assert all(r.done for r in reqs)
+        live_reqs.extend(reqs)
+        clk.t += 2.0
+        ctrl.tick()
+
+    def drive_until(pred, what, max_rounds=60):
+        for _ in range(max_rounds):
+            if pred():
+                return
+            pump_round()
+        raise AssertionError(
+            f"drill never reached {what}: state={ctrl.state} "
+            f"status={ctrl.status()}")
+
+    rollbacks0 = deploy_mod.rollbacks_total.value
+
+    # ---- clean checkpoint 1: watched, canaried, promoted ------------
+    trainer.save(1)
+    drive_until(lambda: ctrl.incumbent_step == 1, "promotion of step 1")
+    digest1 = ctrl.incumbent_digest
+    assert digest1 == manifest_digest(
+        os.path.join(str(tmp_path / "exports"), "step-1"))
+
+    # ---- poisoned checkpoint 2: caught, rolled back, quarantined ----
+    trainer.save(2, poison=poison)
+    drive_until(lambda: 2 in ctrl._refused, "rejection of step 2")
+    assert deploy_mod.rollbacks_total.value - rollbacks0 >= 1
+    res = ctrl.status()["last_result"]
+    assert res["verdict"] == "rolled-back" and res["step"] == 2
+    assert any(r.startswith(reject_reason_prefix) for r in res["reasons"]), res
+    # The incumbent never moved; the rejected export went to forensics.
+    assert ctrl.incumbent_step == 1
+    assert ctrl.incumbent_digest == digest1
+    assert not os.path.exists(
+        os.path.join(str(tmp_path / "exports"), "step-2"))
+    qdir = os.path.join(str(tmp_path / "exports"), "_quarantine")
+    assert any(e.startswith("step-2") for e in os.listdir(qdir))
+
+    # The poisoned step stays refused even though it is still the
+    # newest committed checkpoint in the watch dir.
+    for _ in range(3):
+        pump_round()
+    assert ctrl.state == "idle" and ctrl.incumbent_step == 1
+
+    # ---- clean checkpoint 3: the pipeline recovers ------------------
+    trainer.save(3)
+    drive_until(lambda: ctrl.incumbent_step == 3, "promotion of step 3")
+    assert ctrl.incumbent_digest == manifest_digest(
+        os.path.join(str(tmp_path / "exports"), "step-3"))
+    assert deploy_mod.incumbent_step_gauge.value == 3
+
+    # ---- the client saw NOTHING -------------------------------------
+    assert live_reqs, "drill produced no client traffic"
+    for req in live_reqs:
+        assert req.finish_reason not in (None, "error"), req.request_id
+        assert req.output_token_ids
+        assert all(np.isfinite(lp) for lp in req.output_logprobs), \
+            f"nonfinite logprob reached client request {req.request_id}"
+        assert not req.shadow
+
+    ctrl.stop()
+    return ctrl
+
+
+def test_drill_nan_grad_checkpoint_is_caught(tmp_path, tiny_params):
+    ctrl = _run_drill(tmp_path, tiny_params, poison=_nan_leaf,
+                      drift_limit=0.25, reject_reason_prefix="numeric:")
+    # The numeric gate fired at the probe stage: nonfinite outputs.
+    assert ctrl.status()["counters"]["promotions"] >= 2
+
+
+def test_drill_param_flip_checkpoint_is_caught(tmp_path, tiny_params):
+    # Frozen pipeline: clean checkpoints are bit-identical, so the
+    # tightest possible drift gate is sound — and the flipped exponent
+    # bit must register as nonzero greedy drift against the incumbent.
+    _run_drill(tmp_path, tiny_params, poison=_exponent_flip_leaf,
+               drift_limit=1e-6, reject_reason_prefix="drift:")

@@ -28,6 +28,7 @@ PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 COVERED_MODULES = (
     os.path.join("checkpoint", "store.py"),
     os.path.join("serving", "adapters.py"),
+    os.path.join("serving", "deploy.py"),
     os.path.join("serving", "fleet.py"),
     os.path.join("serving", "prefix_tiers.py"),
     os.path.join("telemetry", "flightrecorder.py"),

@@ -36,6 +36,7 @@ def test_pinned_name_tuples_follow_convention():
     from dlti_tpu.checkpoint import CKPT_METRIC_NAMES
     from dlti_tpu.data.prefetch import PREFETCH_METRIC_NAMES
     from dlti_tpu.serving.adapters import ADAPTER_METRIC_NAMES
+    from dlti_tpu.serving.deploy import DEPLOY_METRIC_NAMES
     from dlti_tpu.serving.disagg import (
         KV_HANDOFF_METRIC_NAMES, POOL_METRIC_NAMES,
     )
@@ -76,6 +77,7 @@ def test_pinned_name_tuples_follow_convention():
                        (POOL_METRIC_NAMES, "disagg-pools"),
                        (KV_HANDOFF_METRIC_NAMES, "kv-handoff"),
                        (ADAPTER_METRIC_NAMES, "adapters"),
+                       (DEPLOY_METRIC_NAMES, "deploy"),
                        (LIFECYCLE_METRIC_NAMES, "lifecycle"),
                        (WIRE_METRIC_NAMES, "wire"),
                        (FLEET_METRIC_NAMES, "fleet"),
@@ -85,7 +87,7 @@ def test_pinned_name_tuples_follow_convention():
 
 def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
-    from dlti_tpu.serving import adapters, fleet, lifecycle, wire
+    from dlti_tpu.serving import adapters, deploy, fleet, lifecycle, wire
     from dlti_tpu.telemetry import (
         flightrecorder, ledger, memledger, slo, watchdog,
     )
@@ -101,6 +103,9 @@ def test_module_level_metric_objects_follow_convention():
             adapters.loads_total, adapters.evictions_total,
             adapters.pool_hits_total, adapters.pool_misses_total,
             adapters.pool_slots_gauge, adapters.pool_bytes_gauge,
+            deploy.candidates_total, deploy.canaries_total,
+            deploy.promotions_total, deploy.rollbacks_total,
+            deploy.rejected_total, deploy.incumbent_step_gauge,
             store.save_seconds, store.restore_seconds, store.corrupt_skipped,
             store.save_retries, store.last_verified_step,
             watchdog.alerts_total, flightrecorder.dumps_total,
@@ -197,6 +202,8 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_disk_degraded",
                      "dlti_replica_lifecycle_quarantines_total",
                      "dlti_replica_state",
+                     "dlti_deploy_rollbacks_total",
+                     "dlti_deploy_incumbent_step",
                      "dlti_spec_proposed_total",
                      "dlti_spec_acceptance_rate",
                      "dlti_spec_draft_len",
