@@ -141,6 +141,12 @@ class RequestRecord:
     # the server predates the fields or the fleet stayed healthy.
     migrations: int = 0
     retries: int = 0
+    # Distributed-trace context (telemetry.distributed_trace): the id
+    # minted at admission and carried across every process this request
+    # touched; joins this client-side record to the server's merged
+    # /debug/trace?request_id= timeline. "" when the server predates it.
+    trace_id: str = ""
+    request_id: str = ""     # server-assigned id (the timeline's key)
 
     @property
     def shed(self) -> bool:
@@ -266,11 +272,25 @@ class LoadReport:
     # outside. Empty when the scrape is off, the route is absent, or the
     # server runs without --slo.
     slo: dict = field(default_factory=dict)
+    # Distributed-trace coverage (telemetry.distributed_trace): of a
+    # bounded sample of ok requests, the fraction whose server-side
+    # merged timeline (GET /debug/trace?request_id=) contains the
+    # gateway, prefill AND decode legs — span federation audited
+    # end-to-end from the client. 0.0 when the scrape is off, tracing is
+    # disabled server-side, or the server predates trace ids.
+    trace_coverage: float = 0.0
+    # The raw per-request records, for programmatic callers (the fleet
+    # trace drill samples a migrated request's id + client latency to
+    # cross-check the server's /debug/trace timeline against). Excluded
+    # from to_dict(): the JSON report stays a summary, not a request log.
+    records: List[RequestRecord] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         import dataclasses
 
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d.pop("records", None)
+        return d
 
 
 def _percentile(xs: List[float], p: float) -> float:
@@ -398,6 +418,10 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                         rec.migrations = int(obj.get("migrations") or 0)
                     if "retries" in obj:
                         rec.retries = int(obj.get("retries") or 0)
+                    if obj.get("trace_id"):
+                        rec.trace_id = str(obj["trace_id"])
+                    if obj.get("id"):
+                        rec.request_id = str(obj["id"])
             # Prefer the final chunk's usage (token-accurate; our server
             # always sends it — stream_options.include_usage semantics).
             # Fallback: SSE event count, the stream's visible progress
@@ -413,6 +437,8 @@ async def _http_post_sse(host: str, port: int, path: str, body: dict,
                 rec.phases = dict(obj["phases"])
             rec.migrations = int(obj.get("migrations") or 0)
             rec.retries = int(obj.get("retries") or 0)
+            rec.trace_id = str(obj.get("trace_id") or "")
+            rec.request_id = str(obj.get("id") or "")
             rec.ok = True
     except Exception as e:  # noqa: BLE001 — one request's failure is a
         # recorded data point, never a crash of the whole load test.
@@ -915,6 +941,30 @@ async def _scrape_spec(cfg: LoadGenConfig) -> dict:
     return out
 
 
+async def _scrape_trace_coverage(cfg: LoadGenConfig,
+                                 recs: List["RequestRecord"],
+                                 sample: int = 16) -> float:
+    """LoadReport.trace_coverage: fetch the merged server-side timeline
+    (GET /debug/trace?request_id=) for a bounded sample of ok requests
+    and count those whose span tree carries the gateway, prefill AND
+    decode legs. Best-effort like every scrape: 0.0 when tracing is
+    disabled, the route is absent, or no response carried an id."""
+    cand = [r for r in recs if r.ok and r.request_id]
+    if not cand:
+        return 0.0
+    # Newest first: the span ring evicts oldest, so sampling the tail
+    # measures federation, not ring capacity.
+    cand = cand[-sample:]
+    need = {"gateway/queued", "request/prefill", "request/decode"}
+    covered = 0
+    for r in cand:
+        tl = await _http_get_json(
+            cfg.host, cfg.port, f"/debug/trace?request_id={r.request_id}")
+        if tl and need <= set(tl.get("legs") or {}):
+            covered += 1
+    return round(covered / len(cand), 4)
+
+
 async def _scrape_fleet_federation(cfg: LoadGenConfig) -> dict:
     """GET /metrics and run the fleet federation cross-check.
     Best-effort like every scrape: {} on any failure or against a
@@ -1127,6 +1177,10 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
     # source) — same best-effort gate; all-zero values against a server
     # running without --speculative.
     spec = (await _scrape_spec(cfg) if cfg.scrape_debug_vars else {})
+    # End-of-run distributed-trace audit: same best-effort gate; 0.0
+    # against a server with tracing off or without trace ids.
+    trace_coverage = (await _scrape_trace_coverage(cfg, records)
+                      if cfg.scrape_debug_vars else 0.0)
     slo = (_slo_report(slo_snap, records)
            if slo_snap and slo_snap.get("objectives") else {})
     memory = {}
@@ -1218,6 +1272,8 @@ async def _run_async(cfg: LoadGenConfig) -> LoadReport:
         slo=slo,
         fleet_federation=fleet_federation,
         spec=spec,
+        trace_coverage=trace_coverage,
+        records=records,
     )
 
 

@@ -367,6 +367,12 @@ class DeploymentController:
                 shadow = eng.submit(prompt, params,
                                     f"shadow-{len(self._pairs)}")
                 shadow.shadow = True
+                # Shadow twin shares the live request's trace context —
+                # a federated timeline shows the mirrored leg beside the
+                # client-facing one (telemetry still skips shadow spans;
+                # this only links whatever the canary engine does emit).
+                shadow.trace_id = getattr(live_req, "trace_id", "") \
+                    or getattr(shadow, "trace_id", "")
                 self._pairs.append(_ShadowPair(live_req, shadow))
             except Exception as e:  # noqa: BLE001 — submit fault = reject
                 self._reject(cand["step"], cand["dir"],

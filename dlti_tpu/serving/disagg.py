@@ -236,7 +236,7 @@ class DisaggController:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                affinity_key: Optional[str] = None,
-               adapter: str = "") -> Request:
+               adapter: str = "", trace_id: str = "") -> Request:
         """Admit into the prefill pool (least-loaded / affinity routing is
         ReplicatedEngine's); with the prefill pool extinct, degrade to
         colocated admission on the decode pool rather than refusing.
@@ -249,7 +249,7 @@ class DisaggController:
         try:
             return self.prefill.submit(prompt_token_ids, params,
                                        request_id, affinity_key,
-                                       adapter=adapter)
+                                       adapter=adapter, trace_id=trace_id)
         except RuntimeError:
             if self.decode.num_live == 0:
                 raise
@@ -258,7 +258,7 @@ class DisaggController:
                 "on the decode pool")
             return self.decode.submit(prompt_token_ids, params,
                                       request_id, affinity_key,
-                                      adapter=adapter)
+                                      adapter=adapter, trace_id=trace_id)
 
     def _rescue_to_decode(self, req: Request) -> bool:
         live = self.decode.live_engines()
@@ -435,7 +435,7 @@ class DisaggController:
                 self._tracer.complete(
                     "engine/kv_handoff", staged.t0, staged.t0 + dt,
                     cat="engine", id=req.request_id,
-                    decode_replica=di)
+                    trace=req.trace_id, decode_replica=di)
                 req.replica = (len(self.prefill.engines) + di)
         return finished
 

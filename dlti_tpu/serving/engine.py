@@ -41,6 +41,7 @@ from dlti_tpu.serving.adapters import AdapterError
 from dlti_tpu.serving.block_manager import BlockManager
 from dlti_tpu.serving.sampling import SamplingParams, sample_tokens
 from dlti_tpu.telemetry import RequestTelemetry
+from dlti_tpu.telemetry.distributed_trace import mint_trace_id
 from dlti_tpu.telemetry.flightrecorder import get_recorder
 from dlti_tpu.telemetry.memledger import (
     MemoryLedger, is_oom_error, tree_nbytes,
@@ -298,6 +299,13 @@ class Request:
     # Deployment-controller shadow mirror (serving.deploy): results never
     # reach a client, and telemetry/SLO/gateway accounting skips these.
     shadow: bool = False
+    # Distributed-trace context (telemetry.distributed_trace): minted at
+    # the gateway (or at submit for direct clients) and PROPAGATED — it
+    # rides the FT_SUBMIT descriptor, handoff envelopes, drain
+    # migrations, failover resubmits, disagg staging, and shadow-tap
+    # replays, so spans emitted in any process for any leg of this
+    # request share one id. "" = untraced (wire canaries, old peers).
+    trace_id: str = ""
 
     @property
     def done(self) -> bool:
@@ -1267,8 +1275,12 @@ class InferenceEngine:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                affinity_key: Optional[str] = None,
-               adapter: str = "") -> Request:
+               adapter: str = "", trace_id: str = "") -> Request:
         """Enqueue a request. Returns immediately; tokens arrive via step().
+
+        ``trace_id`` adopts an upstream-minted distributed-trace context
+        (gateway admission, fleet supervisor descriptor); "" mints a
+        fresh one — direct clients get traced too.
 
         ``affinity_key`` is a replica-routing concern (session/prefix
         stickiness — :meth:`ReplicatedEngine.submit`); a single engine
@@ -1304,6 +1316,9 @@ class InferenceEngine:
             prompt_token_ids=list(prompt_token_ids),
             params=params or SamplingParams(),
             adapter=adapter,
+            # A local uuid when no upstream context arrived — no engine
+            # state touched, so the thread-safety contract below holds.
+            trace_id=trace_id or mint_trace_id(),
         )
         self.waiting.append(req)
         self.stats["requests"] += 1

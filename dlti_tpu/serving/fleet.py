@@ -66,6 +66,7 @@ from dlti_tpu.serving.engine import (
 )
 from dlti_tpu.serving.lifecycle import ReplicaLifecycle, canary_digest
 from dlti_tpu.telemetry import RequestTelemetry
+from dlti_tpu.telemetry.distributed_trace import TraceFederator, mint_trace_id
 from dlti_tpu.telemetry.registry import Counter, Gauge
 from dlti_tpu.utils import durable_io
 from dlti_tpu.utils.logging import get_logger
@@ -234,6 +235,7 @@ class FleetSupervisor:
     # __init__) still have the deploy-controller surface.
     shadow_tap = None
     last_reload_ok: Optional[bool] = None
+    trace: Optional[TraceFederator] = None
 
     def __init__(
         self,
@@ -292,6 +294,18 @@ class FleetSupervisor:
         self.shadow_tap = None
         self._respawns = 0
         self._closed = False
+        # Distributed tracing (telemetry.distributed_trace): per-worker
+        # clock-offset estimators fed from every RPC round trip, plus the
+        # merged ring the workers' shipped span tails land in (rebased
+        # onto this process's clock). /debug/trace reads it through the
+        # facade; flight dumps persist the offsets for postmortem --all.
+        self.trace = TraceFederator()
+        from dlti_tpu.telemetry import get_recorder
+
+        rec = get_recorder()
+        if rec is not None:
+            rec.add_context_source(
+                lambda: {"clock_offsets": self.trace.offsets()})
 
         self._workers = [_WorkerHandle(i, engine_cfg, self.fleet_cfg)
                          for i in range(workers)]
@@ -329,6 +343,35 @@ class FleetSupervisor:
                                   max_frame_bytes=self.fleet_cfg
                                   .max_frame_bytes)
 
+    def _clock_obj(self, w: _WorkerHandle) -> dict:
+        """Downlink payload: this supervisor's current offset estimate
+        for ``w``'s clock, which the worker notes into its flight-dump
+        context (postmortem --all rebases per-worker dump span tails
+        with exactly this value)."""
+        est = self.trace.estimator(w.idx)
+        if not est.samples:
+            return {}
+        return {"clock_offset": est.offset,
+                "clock_uncertainty": est.uncertainty}
+
+    def _rpc_timed(self, w: _WorkerHandle, ftype: int, obj) -> dict:
+        """RPC + trace federation: the send/receive timestamps around the
+        round trip feed the worker's NTP-style clock-offset estimator
+        (the reply's "time" is the worker's monotonic clock mid-serve),
+        and any shipped span tail is rebased and merged."""
+        t0 = time.monotonic()
+        reply = self._rpc(w, ftype, obj)
+        t1 = time.monotonic()
+        if isinstance(reply, dict) and "time" in reply:
+            self.trace.source(w.idx, pid=w.pid,
+                              label=f"worker{w.idx} gen{w.generation}")
+            self.trace.observe_rpc(w.idx, t0, t1, reply.get("time"))
+            if reply.get("spans") or reply.get("spans_dropped"):
+                self.trace.ingest(
+                    w.idx, reply.get("spans") or (),
+                    remote_dropped=int(reply.get("spans_dropped") or 0))
+        return reply
+
     def _connect(self, w: _WorkerHandle, port: int,
                  timeout_s: float) -> None:
         sock = wire.connect_with_retry(self.fleet_cfg.host, port,
@@ -355,7 +398,7 @@ class FleetSupervisor:
                     f"{self.fleet_cfg.startup_timeout_s}s")
             time.sleep(0.05)
         self._connect(w, port, max(1.0, deadline - time.monotonic()))
-        reply = self._rpc(w, wire.FT_HEALTH, {})
+        reply = self._rpc_timed(w, wire.FT_HEALTH, {})
         self._apply_health(w, reply)
         w.starting = False
         self.logger.info("fleet worker %d (gen %d, pid %s) ready on port %d",
@@ -427,10 +470,15 @@ class FleetSupervisor:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                affinity_key: Optional[str] = None,
-               adapter: str = "") -> Request:
+               adapter: str = "", trace_id: str = "") -> Request:
         """Create the client-facing mirror request and queue it for the
         stepper thread to dispatch (no socket I/O here — this runs
-        concurrently with step())."""
+        concurrently with step()).
+
+        ``trace_id`` carries an upstream-minted trace context (the
+        gateway's); "" mints one here, BEFORE the mirror is queued — the
+        stepper may serialize the FT_SUBMIT descriptor at any moment
+        after the append, so the id must already be final."""
         if not self._live_for_dispatch() and not self._reviving():
             raise RuntimeError("all fleet workers dead; "
                                "engine cannot accept requests")
@@ -440,7 +488,8 @@ class FleetSupervisor:
             request_id = f"fleet-req-{next(self._req_counter)}"
         req = Request(request_id=request_id,
                       prompt_token_ids=list(prompt_token_ids),
-                      params=params, arrival_time=time.monotonic())
+                      params=params, arrival_time=time.monotonic(),
+                      trace_id=trace_id or mint_trace_id())
         req.adapter = adapter
         self.telemetry.on_submitted(req)
         self._mirror[request_id] = req
@@ -534,12 +583,15 @@ class FleetSupervisor:
                                if rid in self._mirror
                                and self._mirror[rid].cancel_requested
                                and rid not in self._cancel_sent]
-                    reply = self._rpc(w, wire.FT_STEP, {"cancels": cancels})
+                    reply = self._rpc_timed(
+                        w, wire.FT_STEP,
+                        {"cancels": cancels, **self._clock_obj(w)})
                     self._cancel_sent.update(cancels)
                     w.last_health = now
                     finished.extend(self._apply_step_reply(w, reply))
                 elif now - w.last_health >= self.fleet_cfg.health_interval_s:
-                    self._apply_health(w, self._rpc(w, wire.FT_HEALTH, {}))
+                    self._apply_health(w, self._rpc_timed(
+                        w, wire.FT_HEALTH, self._clock_obj(w)))
             except (wire.WireError, OSError) as e:
                 finished.extend(self._fail_worker(w, e))
         self._lifecycle_tick()
@@ -612,7 +664,8 @@ class FleetSupervisor:
             rec.dump(reason="worker_fault", exc=exc, force=True,
                      extra={"worker": w.idx, "generation": w.generation,
                             "pid": w.pid, "in_flight": len(w.owned),
-                            "survivors": self.num_live})
+                            "survivors": self.num_live,
+                            "clock_offsets": self.trace.offsets()})
         self.logger.error(
             "fleet worker %d (gen %d, pid %s) failed (%s: %s); failing "
             "over %d request(s) to %d survivor(s)", w.idx, w.generation,
@@ -700,6 +753,7 @@ class FleetSupervisor:
         self.lifecycle.begin_drain(idx)
         self._dead.add(idx)
         self._draining.discard(idx)
+        drain_t0 = time.monotonic()
         try:
             reply = self._rpc(w, wire.FT_DRAIN, {})
         except (wire.WireError, OSError) as e:
@@ -732,6 +786,14 @@ class FleetSupervisor:
                     if req is not None:
                         req.num_migrations += 1
                         req.replica = target.idx
+                        # Same span name the disagg controller emits for
+                        # its staging window: export → cross-process
+                        # adopt, on the supervisor clock (exact — both
+                        # endpoints are local RPC returns).
+                        self.telemetry.tracer.complete(
+                            "engine/kv_handoff", drain_t0, time.monotonic(),
+                            cat="engine", id=rid, trace=req.trace_id,
+                            src=idx, dst=target.idx, kind=kind)
                     break
             if not adopted:
                 fallbacks += 1

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dlti_tpu.config import GatewayConfig
 from dlti_tpu.serving.sampling import SamplingParams
+from dlti_tpu.telemetry.distributed_trace import mint_trace_id
 from dlti_tpu.utils.logging import get_logger
 
 # Strict class order: every queued interactive request dequeues before any
@@ -98,6 +99,12 @@ class GatewayRequest:
         self.request_id = request_id
         self.prompt_token_ids = list(prompt_token_ids)
         self.params = params
+        # Distributed-trace context, minted HERE — at admission — so the
+        # gateway's own spans and every downstream process leg share one
+        # id. Dispatch passes it into the engine submit chain (never
+        # assigned after the fact: a fleet stepper may serialize the
+        # FT_SUBMIT descriptor the instant the mirror lands).
+        self.trace_id = mint_trace_id()
         self._req = None
         self._cancel = False
 
@@ -422,8 +429,8 @@ class AdmissionGateway:
             if self._m_admitted is not None:
                 self._m_admitted.labels(tenant=tenant, priority=priority).inc()
             self._tracer.instant("gateway/enqueued", cat="gateway",
-                                 id=request_id, tenant=tenant,
-                                 priority=priority)
+                                 id=request_id, trace=handle.trace_id,
+                                 tenant=tenant, priority=priority)
             self._cond.notify()
         return handle, entry.q
 
@@ -526,7 +533,8 @@ class AdmissionGateway:
                     kw["adapter"] = entry.adapter
                 req, _ = self.async_engine.submit(
                     entry.handle.prompt_token_ids, entry.handle.params,
-                    entry.handle.request_id, q=entry.q, **kw)
+                    entry.handle.request_id, q=entry.q,
+                    trace_id=entry.handle.trace_id, **kw)
             except Exception as e:  # engine parked / all replicas dead
                 self._reject("engine_unavailable", entry.priority)
                 entry.q.put(("reject", 503, f"{type(e).__name__}: {e}"))
@@ -542,6 +550,7 @@ class AdmissionGateway:
             now = time.monotonic()
             self._tracer.complete("gateway/queued", entry.enqueue_t, now,
                                   cat="gateway", id=entry.handle.request_id,
+                                  trace=entry.handle.trace_id,
                                   tenant=entry.tenant,
                                   priority=entry.priority)
             with self._cond:

@@ -270,7 +270,7 @@ class ReplicatedEngine:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                affinity_key: Optional[str] = None,
-               adapter: str = "") -> Request:
+               adapter: str = "", trace_id: str = "") -> Request:
         """Dispatch to the least-loaded live replica (round-robin
         tiebreak) — or, with an ``affinity_key``, to its sticky
         rendezvous-hash target unless that replica's backlog exceeds its
@@ -302,6 +302,7 @@ class ReplicatedEngine:
         if request_id is None:
             request_id = f"rep-req-{next(self._req_counter)}"
         req = eng.submit(prompt_token_ids, params, request_id,
+                         trace_id=trace_id,
                          **({"adapter": adapter} if adapter else {}))
         req.replica = self.engines.index(eng)
         tap = self.shadow_tap

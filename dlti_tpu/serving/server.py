@@ -85,6 +85,17 @@ def build_registry(async_engine: "AsyncEngine") -> MetricsRegistry:
 
     registry.register(alerts_total)
     registry.register(dumps_total)
+    # Distributed-tracing federation counters (module-level, like the
+    # watchdog/flight pair): spans adopted from fleet workers, spans
+    # that arrived without any request/trace parentage, and the per-
+    # worker clock-offset estimate the rebasing used. Registered even on
+    # single-process engines so the series exists (at zero) and the
+    # metric-naming contract can walk it.
+    from dlti_tpu.telemetry import distributed_trace as _dtrace
+
+    registry.register(_dtrace.federated_spans_total)
+    registry.register(_dtrace.unparented_spans_total)
+    registry.register(_dtrace.clock_offset_gauge)
     # Numeric-fault sentinel + SDC counters (dlti_tpu.training.sentinel):
     # module-level like the watchdog/flight pair, so an in-process
     # trainer's anomalies and the serving guard drills share one series
@@ -305,6 +316,7 @@ class AsyncEngine:
                q: Optional[queue.Queue] = None,
                affinity_key: Optional[str] = None,
                adapter: str = "",
+               trace_id: str = "",
                ) -> Tuple[Request, queue.Queue]:
         """Enqueue a request; returns (request, event queue).
 
@@ -325,7 +337,8 @@ class AsyncEngine:
             req = self.engine.submit(
                 prompt_ids, params, request_id,
                 **({"affinity_key": affinity_key} if affinity_key else {}),
-                **({"adapter": adapter} if adapter else {}))
+                **({"adapter": adapter} if adapter else {}),
+                **({"trace_id": trace_id} if trace_id else {}))
             self._queues[req.request_id] = q
             self._seen[req.request_id] = 0
             self._work.notify()
@@ -609,6 +622,49 @@ class _Handler(BaseHTTPRequestHandler):
                 "phases": list(_REQUEST_PHASES),
                 "worst": worst,
             })
+        if path == "/debug/trace":
+            # Chrome-trace snapshot — the process-global tracer merged
+            # with every fleet worker's federated span tail (already
+            # rebased onto this process's clock), one pid per source so
+            # Perfetto renders a multi-process timeline. With
+            # ?request_id= (optionally &latency_s=<client-observed>):
+            # the merged, clock-aligned span tree for ONE request across
+            # all processes, with per-leg durations and the residual.
+            tracer = get_tracer()
+            fed = getattr(self.async_engine.engine, "trace", None)
+            if not tracer.enabled and fed is None:
+                return self._error(404, "tracing disabled (start the "
+                                        "server with --trace-dir)")
+            qp = {}
+            for part in query.split("&"):
+                k, _, v = part.partition("=")
+                if k:
+                    qp[k] = v
+            rid = qp.get("request_id", "")
+            if not rid:
+                if fed is not None:
+                    return self._json(200, fed.merged_dict(
+                        tracer if tracer.enabled else None))
+                return self._json(200, tracer.to_dict())
+            from dlti_tpu.telemetry.distributed_trace import (
+                request_timeline,
+            )
+
+            latency = None
+            if qp.get("latency_s"):
+                try:
+                    latency = float(qp["latency_s"])
+                except ValueError:
+                    return self._error(400, "latency_s must be a float")
+            events = list(fed.events()) if fed is not None else []
+            if tracer.enabled:
+                events.extend(tracer.events())
+            tl = request_timeline(events, rid, client_latency_s=latency)
+            if not tl["spans"]:
+                return self._error(404, f"no spans retained for request "
+                                        f"{rid!r} (ring evicted, or id "
+                                        f"unknown)")
+            return self._json(200, tl)
         if path == "/debug/slo":
             # Declared objectives vs reality (telemetry.slo): per-
             # (objective, class) compliance, error budget remaining,
@@ -690,15 +746,6 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
-        elif self.path == "/debug/trace":
-            # Chrome-trace snapshot of the process-global span tracer
-            # (request lifecycle + engine step phases) — save the body
-            # and open it in Perfetto. 404 while tracing is disabled.
-            tracer = get_tracer()
-            if not tracer.enabled:
-                return self._error(404, "tracing disabled (start the "
-                                        "server with --trace-dir)")
-            self._json(200, tracer.to_dict())
         elif self.path == "/v1/deploy":
             # Continuous-delivery state (serving.deploy): incumbent
             # step/digest, canary in flight, refused steps, gate verdict
@@ -1130,6 +1177,9 @@ class _Handler(BaseHTTPRequestHandler):
         # actually happened".
         out["migrations"] = getattr(eng_req, "num_migrations", 0)
         out["retries"] = getattr(eng_req, "num_retries", 0)
+        # Trace context: lets the client (and the loadgen) fetch the
+        # merged cross-process timeline via /debug/trace?request_id=.
+        out["trace_id"] = getattr(eng_req, "trace_id", "")
         self._json(200, out)
 
     def _multi_response(self, subs: list, rid: str, chat: bool,
@@ -1291,6 +1341,7 @@ class _Handler(BaseHTTPRequestHandler):
                 eng_req = getattr(req, "_req", None) or req
                 final["migrations"] = getattr(eng_req, "num_migrations", 0)
                 final["retries"] = getattr(eng_req, "num_retries", 0)
+                final["trace_id"] = getattr(eng_req, "trace_id", "")
                 chunk(json.dumps(final))
             chunk("[DONE]")
             self.wfile.write(b"0\r\n\r\n")
@@ -1323,6 +1374,13 @@ def make_server(engine: InferenceEngine, tokenizer: Tokenizer,
     cfg = cfg or ServerConfig()
     async_engine = AsyncEngine(engine)
     registry = build_registry(async_engine)
+    # Name this process's row in merged Perfetto exports — fleet workers
+    # label themselves "worker<N>"; the front process is "supervisor"
+    # when it runs a fleet (it federates worker span tails) and plain
+    # "server" otherwise.
+    get_tracer().process_label = (
+        "supervisor" if getattr(engine, "trace", None) is not None
+        else "server")
     gateway = None
     if cfg.gateway is not None and cfg.gateway.enabled:
         gateway = AdmissionGateway(async_engine, cfg.gateway, registry)

@@ -358,6 +358,11 @@ def request_to_wire(req) -> dict:
         "priority": req.priority,
         "adapter": req.adapter,
         "cancel_requested": req.cancel_requested,
+        # Distributed-trace context: the SAME id in every process that
+        # touches any leg of this request (fresh submits, failover
+        # resubmits, and — via pack_handoff wrapping this descriptor —
+        # drain-migration KV envelopes).
+        "trace_id": getattr(req, "trace_id", ""),
     }
 
 
@@ -385,6 +390,10 @@ def request_from_wire(d: dict):
     req.priority = d.get("priority", req.priority)
     req.adapter = d.get("adapter", "")
     req.cancel_requested = bool(d.get("cancel_requested", False))
+    # Absent on frames from peers predating distributed tracing: such
+    # requests simply go untraced ("" — never re-minted here, which
+    # would fork the id between processes).
+    req.trace_id = d.get("trace_id", "") or ""
     return req
 
 

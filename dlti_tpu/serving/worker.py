@@ -37,11 +37,27 @@ class EngineWorker:
     def __init__(self, engine, *, host: str = "127.0.0.1", port: int = 0,
                  worker_id: int = 0, registry=None,
                  reload_fn: Optional[Callable[[Any], Any]] = None,
-                 max_frame_bytes: int = wire.DEFAULT_MAX_FRAME):
+                 max_frame_bytes: int = wire.DEFAULT_MAX_FRAME,
+                 tracer=None, span_ship_max: int = 512):
         self.engine = engine
         self.worker_id = worker_id
         self.registry = registry
         self.logger = get_logger()
+        # Span federation: this worker ships its span-ring tail
+        # incrementally in FT_STEP/FT_HEALTH replies (cursor = total
+        # appends, so ring eviction between ships is counted, not
+        # silent). None = the engine's tracer (the process-global one in
+        # a real worker process); tests pass private per-worker tracers
+        # so thread-fleet fakes get genuinely distinct rings.
+        self.tracer = tracer if tracer is not None \
+            else getattr(engine.telemetry, "tracer", None)
+        self.span_ship_max = span_ship_max
+        self._span_cursor = 0
+        # Last clock offset the supervisor estimated for this worker
+        # (supervisor_clock ≈ our_clock + offset) — echoed down in
+        # step/health requests and persisted into flight-dump context so
+        # postmortem --all can merge per-worker dumps onto one clock.
+        self._clock_offset: Optional[dict] = None
         # Rolling reload: rebuilds the engine from a host param tree
         # (shipped over the wire by the supervisor). None = unsupported.
         self._reload_fn = reload_fn
@@ -179,6 +195,37 @@ class EngineWorker:
                 "free_blocks": eng.num_free_blocks,
                 "has_work": bool(eng.has_work)}
 
+    def _span_tail(self) -> dict:
+        """Unshipped span-ring tail for step/health replies (empty dict
+        when tracing is off — replies stay byte-light and old supervisors
+        reading with .get() see nothing new)."""
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            return {}
+        evs, dropped, self._span_cursor = tracer.events_since(
+            self._span_cursor, self.span_ship_max)
+        if not evs and not dropped:
+            return {}
+        return {"spans": evs, "spans_dropped": dropped}
+
+    def _note_clock(self, obj: Any) -> None:
+        """Record the supervisor's offset estimate for this worker's
+        clock (rides down in step/health requests). Kept on the instance
+        and mirrored into the flight recorder context, so a dump from
+        this process carries enough to rebase its span tail."""
+        if not isinstance(obj, dict) or "clock_offset" not in obj:
+            return
+        off = {"clock_offset_s": obj.get("clock_offset"),
+               "clock_uncertainty_s": obj.get("clock_uncertainty")}
+        if off == self._clock_offset:
+            return
+        self._clock_offset = off
+        from dlti_tpu.telemetry import get_recorder
+
+        rec = get_recorder()
+        if rec is not None:
+            rec.note(**off)
+
     def _on_submit(self, obj: dict) -> dict:
         desc = obj["request"]
         if obj.get("resubmit"):
@@ -192,6 +239,10 @@ class EngineWorker:
             adapter = desc.get("adapter", "")
             req = self.engine.submit(
                 desc["prompt_token_ids"], params, desc["request_id"],
+                # Adopt the supervisor's trace context so every process's
+                # spans for this request join one timeline (absent from
+                # old supervisors: submit mints a local id instead).
+                trace_id=desc.get("trace_id", "") or "",
                 **({"adapter": adapter} if adapter else {}))
             req.tenant = desc.get("tenant", "")
             req.priority = desc.get("priority", "")
@@ -200,6 +251,7 @@ class EngineWorker:
         return {"ok": True, **self._gauges()}
 
     def _on_step(self, obj: dict) -> dict:
+        self._note_clock(obj)
         for rid in obj.get("cancels") or ():
             for req in list(self.engine.waiting):
                 if req.request_id == rid:
@@ -231,7 +283,11 @@ class EngineWorker:
                 self._reported.pop(rid, None)
             if ev["tokens"] or "finish_reason" in ev:
                 events.append(ev)
+        # "time" gives the supervisor a clock-offset sample on every step
+        # RPC (busy workers rarely see FT_HEALTH); the span tail
+        # piggybacks so federation lag is one step, not one heartbeat.
         return {"events": events, "stats": dict(self.engine.stats),
+                "time": time.monotonic(), **self._span_tail(),
                 **self._gauges()}
 
     def _on_drain(self, obj: dict) -> dict:
@@ -280,13 +336,14 @@ class EngineWorker:
         return {"adopted": adopted, **self._gauges()}
 
     def _on_health(self, obj: Any) -> dict:
+        self._note_clock(obj)
         metrics: Dict[str, float] = {}
         if self.registry is not None:
             metrics = _numeric_only(self.registry.stats_dict())
         return {"ok": True, "pid": os.getpid(),
                 "worker_id": self.worker_id, "time": time.monotonic(),
                 "stats": dict(self.engine.stats), "metrics": metrics,
-                **self._gauges()}
+                **self._span_tail(), **self._gauges()}
 
     def _on_abort(self, obj: dict) -> dict:
         reason = (obj or {}).get("reason", "abort")

@@ -278,7 +278,10 @@ class FlightRecorder:
                            f"{_TMP}{os.path.basename(final)}-{os.getpid()}")
         os.makedirs(tmp, exist_ok=True)
 
-        events = self.tracer.events()[-self.max_spans:]
+        # process_name metadata first so a dump's span tail self-labels
+        # its Perfetto row even before postmortem --all re-pids it.
+        events = (self.tracer.metadata_events()
+                  + self.tracer.events()[-self.max_spans:])
         payloads = {
             "context.json": {
                 "reason": reason,

@@ -498,6 +498,7 @@ class CriticalPathTracker:
                 phase_seconds_total.labels(phase=k).inc(v)
         self.slow.add({
             "id": req.request_id,
+            "trace_id": getattr(req, "trace_id", ""),
             "tenant": req.tenant,
             "priority": req.priority,
             "replica": req.replica,

@@ -90,7 +90,8 @@ class RequestTelemetry:
         if getattr(req, "shadow", False):
             return
         self.tracer.instant("request/submitted", cat="request",
-                            tid=_req_tid(req.request_id), id=req.request_id)
+                            tid=_req_tid(req.request_id), id=req.request_id,
+                            trace=getattr(req, "trace_id", ""))
 
     def on_admitted(self, req) -> None:
         """First admission observes queue time; a re-admission after
@@ -108,7 +109,8 @@ class RequestTelemetry:
             self.queue_time.observe(now - req.arrival_time)
             self.tracer.complete(
                 "request/queued", req.arrival_time, now, cat="request",
-                tid=_req_tid(req.request_id), id=req.request_id)
+                tid=_req_tid(req.request_id), id=req.request_id,
+                trace=getattr(req, "trace_id", ""))
         else:
             self.tracer.instant("request/readmitted", cat="request",
                                 tid=_req_tid(req.request_id),
@@ -124,6 +126,7 @@ class RequestTelemetry:
         self.tracer.complete(
             "request/prefill", start, req.first_token_time, cat="request",
             tid=_req_tid(req.request_id), id=req.request_id,
+            trace=getattr(req, "trace_id", ""),
             prompt_tokens=len(req.prompt_token_ids))
 
     def on_finished(self, req) -> None:
@@ -139,6 +142,7 @@ class RequestTelemetry:
             "request/decode",
             first if first is not None else req.arrival_time, finish,
             cat="request", tid=_req_tid(req.request_id), id=req.request_id,
+            trace=getattr(req, "trace_id", ""),
             output_tokens=n_out, finish_reason=req.finish_reason,
             preemptions=req.num_preemptions)
         # Phase attribution last: the breakdown reads the timestamps the

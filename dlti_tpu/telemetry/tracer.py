@@ -83,6 +83,16 @@ class SpanTracer:
         # the whole story" from "this is the most recent window of a longer
         # one", so truncation is counted, never silent.
         self._dropped = 0
+        # Total events ever appended — the cursor axis for events_since()
+        # (fleet workers ship ring tails incrementally in step/health
+        # replies; the cursor survives ring eviction because it counts
+        # appends, not positions).
+        self._total = 0
+        # Optional human label for this process's Perfetto row; when set,
+        # exports prepend a "ph":"M" process_name metadata event so a
+        # merged multi-process timeline renders one named row per source
+        # instead of collapsing everything into anonymous pids.
+        self.process_label: Optional[str] = None
 
     # -- recording ------------------------------------------------------
     def span(self, name: str, cat: str = "host", **args):
@@ -129,6 +139,7 @@ class SpanTracer:
                     and len(self._events) == self._events.maxlen):
                 self._dropped += 1
             self._events.append(ev)
+            self._total += 1
 
     # -- inspection / export --------------------------------------------
     @property
@@ -146,6 +157,39 @@ class SpanTracer:
         with self._lock:
             return list(self._events)
 
+    @property
+    def total_events(self) -> int:
+        """Events ever appended (cursor axis for :meth:`events_since`)."""
+        with self._lock:
+            return self._total
+
+    def events_since(self, cursor: int, limit: int = 512) -> tuple:
+        """Incremental tail read: everything appended after ``cursor``
+        (a previous return value; start at 0), oldest first, capped at
+        ``limit`` per call. Returns ``(events, dropped, new_cursor)``
+        where ``dropped`` counts events that were appended after the
+        cursor but already evicted by the ring — shipped as a count so
+        the consumer's truncation accounting stays honest."""
+        with self._lock:
+            unshipped = max(0, self._total - max(0, cursor))
+            avail = len(self._events)
+            dropped = max(0, unshipped - avail)
+            take = min(unshipped - dropped, max(0, limit))
+            start = avail - (unshipped - dropped)
+            evs = [self._events[i] for i in range(start, start + take)]
+            return evs, dropped, self._total - (unshipped - dropped - take)
+
+    def metadata_events(self) -> list:
+        """``"ph":"M"`` process_name metadata for this process's row
+        (empty unless :attr:`process_label` is set)."""
+        if not self.process_label:
+            return []
+        # ts is meaningless on metadata events but present so every
+        # exported event satisfies the {ph, ts, name} schema consumers pin.
+        return [{"ph": "M", "name": "process_name", "cat": "__meta",
+                 "ts": 0.0, "pid": self._pid, "tid": 0,
+                 "args": {"name": self.process_label}}]
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
@@ -154,7 +198,8 @@ class SpanTracer:
         # droppedEvents is an extra top-level key: Perfetto/chrome://tracing
         # ignore unknown keys, while forensics consumers (flight records,
         # /debug/trace readers) use it to see whether the window truncated.
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms",
+        return {"traceEvents": self.metadata_events() + self.events(),
+                "displayTimeUnit": "ms",
                 "droppedEvents": self.dropped_events}
 
     def export(self, path: str) -> str:

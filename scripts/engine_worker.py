@@ -113,6 +113,15 @@ def main() -> None:
 
         register_adapter(name, adir)
 
+    # Span federation: the worker keeps a local span ring and ships its
+    # tail to the supervisor in FT_STEP/FT_HEALTH replies; the label
+    # names this process's row in the merged Perfetto timeline.
+    if spec.get("trace", True):
+        from dlti_tpu.telemetry import configure_tracer
+
+        tracer = configure_tracer(enabled=True)
+        tracer.process_label = f"worker{args.worker_id} gen{args.generation}"
+
     engine, rebuild = build_engine(spec)
     if spec.get("slow_log_k"):
         engine.telemetry.critical_path.slow.k = max(

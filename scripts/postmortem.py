@@ -331,6 +331,48 @@ def load_stitched_ledger(path: Optional[str]) -> Optional[dict]:
         return None
 
 
+def merge_incident_trace(dump_dirs: list) -> Optional[dict]:
+    """Clock-aligned Perfetto merge of every dump's span tail.
+
+    Each fleet worker persisted the supervisor's clock-offset estimate
+    for it (``clock_offset_s`` / ``clock_uncertainty_s``, noted into the
+    recorder context on every FT_STEP/FT_HEALTH downlink), so the tails
+    can be rebased onto the supervisor's clock after the fact — the
+    post-hoc twin of the live ``/debug/trace`` federation. Dumps with no
+    offset (the supervisor's own, single-process runs, pre-tracing
+    dumps) merge at offset 0.
+    """
+    from dlti_tpu.telemetry.distributed_trace import merge_dump_tails
+
+    tails = []
+    for d in dump_dirs:
+        data = load_dump(d)
+        ctx_file = data.get("context.json", {})
+        context = ctx_file.get("context", {}) or {}
+        events = [e for e in (data.get("spans.json", {})
+                              .get("traceEvents", []) or [])
+                  if isinstance(e, dict) and e.get("ph") != "M"]
+        if not events:
+            continue
+        parent = os.path.basename(os.path.dirname(d))
+        who = parent if parent.startswith("worker") else "supervisor"
+        try:
+            off = float(context.get("clock_offset_s") or 0.0)
+        except (TypeError, ValueError):
+            off = 0.0
+        tails.append({
+            "label": f"{who} {os.path.basename(d)}",
+            "pid": ctx_file.get("pid"),
+            "offset_s": off,
+            "uncertainty_s": context.get("clock_uncertainty_s"),
+            "events": events,
+            "dropped": data.get("spans.json", {}).get("droppedEvents", 0),
+        })
+    if not tails:
+        return None
+    return merge_dump_tails(tails)
+
+
 def summarize_incident(dump_dirs: list, span_tail: int = 15,
                        stitched: Optional[dict] = None) -> dict:
     """One incident summary over a *directory of per-rank dumps* (an
@@ -394,6 +436,15 @@ def render_incident(incident: dict) -> str:
               f"{(r['reason'] or '?'):24s} last step "
               f"{r['last_completed_step']!s:>6}  "
               f"phase {(r['phase_at_death'] or '?')}{dmg}")
+    mt = incident.get("merged_trace")
+    if mt:
+        w("")
+        w(f"merged trace: {mt['events']} span(s) across "
+          f"{mt['processes']} process(es), clock-rebased "
+          f"(max offset {mt['max_offset_ms']:.2f}ms"
+          f"{', ' + str(mt['dropped']) + ' dropped' if mt['dropped'] else ''})"
+          + (f" -> {mt['path']}" if mt.get("path") else
+             "  [--trace-out FILE to save Perfetto JSON]"))
     st = incident.get("stitched_ledger")
     if st:
         w("")
@@ -597,6 +648,12 @@ def main() -> None:
                         "incident summary across all of them; also walks "
                         "one level of subdirs (a fleet's per-worker "
                         "dump namespaces)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="with --all: write the clock-aligned merge of "
+                        "every dump's span tail (one pid per process, "
+                        "worker tails rebased onto the supervisor clock "
+                        "via the offsets persisted in each dump's "
+                        "context.json) as Perfetto-loadable JSON")
     p.add_argument("--ledger", default=None, metavar="PATH",
                    help="stitched goodput ledger (the elastic "
                         "supervisor's ledger_stitched.json) for the "
@@ -611,6 +668,21 @@ def main() -> None:
             args.ledger or find_stitched_ledger(args.path))
         incident = summarize_incident(dumps, span_tail=args.spans,
                                       stitched=stitched)
+        merged = merge_incident_trace(dumps)
+        if merged is not None:
+            evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+            incident["merged_trace"] = {
+                "events": len(evs),
+                "processes": len({e.get("pid") for e in evs}),
+                "dropped": merged.get("droppedEvents", 0),
+                "max_offset_ms": max(
+                    (abs(float(t.get("offset_s") or 0.0)) * 1e3
+                     for t in merged.get("sources", [])), default=0.0),
+            }
+            if args.trace_out:
+                with open(args.trace_out, "w", encoding="utf-8") as f:
+                    json.dump(merged, f)
+                incident["merged_trace"]["path"] = args.trace_out
         if args.json:
             print(json.dumps(incident, indent=2, default=str))
         else:

@@ -342,6 +342,24 @@ def test_fleet_metric_names_are_schema_stable():
     )
 
 
+def test_trace_metric_names_are_schema_stable():
+    """Distributed-tracing federation names are a scrape contract:
+    spans adopted from fleet workers, spans arriving without request or
+    trace parentage, and the per-worker clock-offset gauge the rebasing
+    used — registered unconditionally by build_registry so the series
+    exist (at zero) even on single-process engines."""
+    from dlti_tpu.telemetry import distributed_trace as dt
+
+    assert dt.TRACE_METRIC_NAMES == (
+        "dlti_trace_federated_spans_total",
+        "dlti_trace_unparented_spans_total",
+        "dlti_trace_clock_offset_seconds",
+    )
+    assert dt.federated_spans_total.name == dt.TRACE_METRIC_NAMES[0]
+    assert dt.unparented_spans_total.name == dt.TRACE_METRIC_NAMES[1]
+    assert dt.clock_offset_gauge.name == dt.TRACE_METRIC_NAMES[2]
+
+
 def test_spec_metric_names_are_schema_stable():
     """Speculative-decode telemetry names are a scrape contract: raw
     draft-economics counters (proposed/accepted draft tokens, paused
@@ -632,6 +650,10 @@ def test_load_report_schema_includes_gateway_fields():
         # (proposed/accepted/paused totals + acceptance-rate and
         # draft-length gauges) from the /metrics scrape.
         "spec",
+        # Distributed-tracing era: fraction of sampled ok requests whose
+        # merged /debug/trace?request_id= timeline carries the
+        # gateway + prefill + decode legs.
+        "trace_coverage",
     }
     missing = required - fields
     assert not missing, f"LoadReport lost contract fields: {missing}"

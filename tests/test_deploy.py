@@ -265,6 +265,24 @@ def test_shadow_requests_are_flagged_and_probes_pinned(tmp_path):
     assert canary_reqs and all(r.shadow for r in canary_reqs)
 
 
+def test_shadow_twin_shares_live_trace_context(tmp_path):
+    """Distributed-trace survival across the shadow-tap replay: the
+    mirror twin on the canary engine carries the LIVE request's
+    trace_id, so a federated timeline can show the shadowed leg beside
+    the client-facing one."""
+    ctrl, fleet, clk, watch, engines = _controller(tmp_path)
+    _write_step(watch, 3)
+    ctrl.tick()
+    live = _Req("live-traced", out=(1, 2, 3), done=True)
+    live.trace_id = "a1b2c3d4e5f60718"
+    fleet.shadow_tap([1, 2, 3, 4], None, live)
+    ctrl.tick()
+    twins = [r for eng in engines.values() for r in eng.all_requests
+             if getattr(r, "trace_id", "") == live.trace_id]
+    assert twins, "shadow twin must inherit the live trace id"
+    assert all(r.shadow for r in twins)
+
+
 # ----------------------------------------------------------------------
 # canary gate failure -> rollback, quarantine, refused forever
 # ----------------------------------------------------------------------

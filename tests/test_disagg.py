@@ -289,6 +289,41 @@ def test_handoff_books_as_kv_handoff_phase(tiny_params, devices):
     assert ctl.handoff["completed"] == 1
 
 
+def test_handoff_span_carries_trace_context(tiny_params, devices):
+    """Distributed-trace survival across the disagg staging path: the
+    request's trace_id (minted at submit) rides into the
+    engine/kv_handoff span, and the per-request timeline shows the
+    staging leg between prefill and decode."""
+    from dlti_tpu.telemetry import get_tracer
+    from dlti_tpu.telemetry.distributed_trace import request_timeline
+
+    tracer = get_tracer()
+    prev = tracer.enabled
+    tracer.enabled = True
+    try:
+        ctl = DisaggController(CFG, tiny_params, _ec(), prefill_replicas=1,
+                               decode_replicas=1, devices=devices[:2])
+        req = ctl.submit([1, 2, 3, 4, 5], SamplingParams(max_tokens=8))
+        assert len(req.trace_id) == 16
+        while ctl.has_work:
+            ctl.step()
+        assert req.finish_reason in ("stop", "length")
+        spans = [ev for ev in tracer.events()
+                 if ev.get("name") == "engine/kv_handoff"
+                 and (ev.get("args") or {}).get("id") == req.request_id]
+        assert spans, "staging must emit the kv_handoff span"
+        assert all(s["args"].get("trace") == req.trace_id for s in spans)
+        tl = request_timeline(tracer.events(), req.request_id)
+        assert tl["trace_id"] == req.trace_id
+        assert {"engine/kv_handoff", "request/prefill",
+                "request/decode"} <= set(tl["legs"]), sorted(tl["legs"])
+        # The staging window overlaps the lifecycle legs: reported but
+        # never counted toward the sequential coverage.
+        assert "engine/kv_handoff" not in tl["sequential_legs"]
+    finally:
+        tracer.enabled = prev
+
+
 def test_note_requeue_folds_open_mark_instead_of_dropping_it():
     """The mid-chunked-prefill double-requeue bug: a slot preempted
     mid-prompt has an open "preempt" mark; its replica then dies and

@@ -25,8 +25,11 @@ Layers:
   metrics.
 """
 
+import contextlib
 import dataclasses
+import http.client
 import itertools
+import json
 import os
 import signal
 import socket
@@ -38,7 +41,7 @@ import jax.numpy as jnp
 import pytest
 
 from dlti_tpu.config import (
-    FleetConfig, MODEL_PRESETS, ReplicaLifecycleConfig,
+    FleetConfig, GatewayConfig, MODEL_PRESETS, ReplicaLifecycleConfig,
 )
 from dlti_tpu.models import LlamaForCausalLM
 from dlti_tpu.serving import (
@@ -551,6 +554,246 @@ def test_fleet_sticky_affinity_and_cancel(tiny_params):
 
 
 # ----------------------------------------------------------------------
+# Distributed tracing: span federation + per-request timelines
+# ----------------------------------------------------------------------
+
+def _traced_thread_spawner(params, **engine_over):
+    """Thread spawner whose workers carry PRIVATE per-worker tracers.
+
+    In-process fake workers would otherwise share the process-global
+    tracer with the supervisor — every span would be both local AND
+    "shipped", hiding federation bugs. A private ring per incarnation
+    mirrors what a real worker process has."""
+    from dlti_tpu.telemetry import RequestTelemetry, SpanTracer
+
+    def spawn(idx: int, generation: int) -> _ThreadHandle:
+        wtracer = SpanTracer(capacity=4096, enabled=True)
+        wtracer.process_label = f"worker{idx} gen{generation}"
+        telemetry = RequestTelemetry(tracer=wtracer)
+
+        def build(tree):
+            return InferenceEngine(CFG, tree, _ec(**engine_over),
+                                   telemetry=telemetry)
+
+        return _ThreadHandle(EngineWorker(build(params), port=0,
+                                          worker_id=idx, tracer=wtracer,
+                                          reload_fn=build))
+
+    return spawn
+
+
+@contextlib.contextmanager
+def _global_tracer(label="supervisor"):
+    """Enable the process-global tracer (supervisor/gateway spans) for
+    one test, restoring its prior state after."""
+    from dlti_tpu.telemetry import get_tracer
+
+    t = get_tracer()
+    prev = (t.enabled, t.process_label)
+    t.enabled = True
+    t.process_label = label
+    try:
+        yield t
+    finally:
+        t.enabled, t.process_label = prev
+
+
+def test_fleet_trace_context_survives_migration_and_failover(tiny_params):
+    """The trace_id minted at submit rides the FT_SUBMIT, the drain
+    migration envelope, AND the kill-failover resubmit unchanged; worker
+    span tails federate back with clock offsets and join the supervisor's
+    local spans into one multi-process timeline."""
+    from dlti_tpu.telemetry import get_tracer
+    from dlti_tpu.telemetry.distributed_trace import (
+        TraceFederator, request_timeline,
+    )
+
+    # 3 workers: after the drain takes the victim out of rotation, the
+    # kill still leaves a live survivor for the failover resubmits.
+    sup = FleetSupervisor(
+        _ec(), workers=3, spawner=_traced_thread_spawner(tiny_params),
+        fleet_cfg=_fleet_cfg(workers=3),
+        lifecycle_cfg=ReplicaLifecycleConfig(enabled=False),
+        canary_vocab=CFG.vocab_size)
+    with _global_tracer():
+        try:
+            sp = SamplingParams(max_tokens=12, temperature=0.0)
+            reqs = [sup.submit(p, sp) for p in PROMPTS]
+            ids = {r.request_id: r.trace_id for r in reqs}
+            assert all(len(t) == 16 for t in ids.values())
+            assert len(set(ids.values())) == len(ids), "trace ids collide"
+            for _ in range(60):
+                sup.step()
+                if all(len(r.output_token_ids) >= 2 for r in reqs):
+                    break
+            assert all(not r.done for r in reqs)
+            # Leg 1: drain -> cross-process KV migration.
+            victim = next(w for w in sup._workers if w.owned)
+            assert sup.drain_replica(victim.idx, kind="preempt",
+                                     quarantine=False) == []
+            assert {r.request_id: r.trace_id for r in reqs} == ids
+            # Leg 2: SIGKILL-analog on one new owner -> failover resubmit.
+            next(w for w in sup._workers if w.owned).handle.kill()
+            deadline = time.monotonic() + 60
+            while sup.has_work and time.monotonic() < deadline:
+                sup.step()
+            assert [r.finish_reason for r in reqs] == ["length"] * len(reqs)
+            assert {r.request_id: r.trace_id for r in reqs} == ids
+            # Federation: multiple workers shipped spans; every worker's
+            # clock got an offset estimate with a real uncertainty bound.
+            fed = sup.trace
+            assert len(fed) > 0
+            pids = {ev["pid"] for ev in fed.events()}
+            assert len(pids) >= 2, pids
+            assert all(p >= TraceFederator.SYNTHETIC_PID_BASE
+                       for p in pids), pids
+            offs = fed.offsets()
+            assert set(offs) == {"0", "1", "2"}
+            for o in offs.values():
+                assert o["uncertainty_s"] is not None
+                assert o["uncertainty_s"] >= 0.0
+            # A migrated request reconstructs as ONE timeline spanning
+            # the supervisor + >=2 worker processes, with the handoff leg.
+            migrated = next(r for r in reqs if r.num_migrations > 0)
+            events = fed.events() + get_tracer().events()
+            tl = request_timeline(events, migrated.request_id)
+            assert tl["trace_id"] == migrated.trace_id
+            assert len(tl["processes"]) >= 2, tl["processes"]
+            assert "engine/kv_handoff" in tl["legs"]
+            assert {"request/prefill", "request/decode"} <= set(tl["legs"])
+            ts = [ev["ts"] for ev in tl["spans"]]
+            assert ts == sorted(ts), "spans must be causally ordered"
+            # The handoff overlaps the lifecycle legs: reported, but the
+            # sequential union never double-counts it.
+            assert "engine/kv_handoff" not in tl["sequential_legs"]
+        finally:
+            sup.close()
+
+
+def _trace_drill(sup, params):
+    """The cross-process acceptance drill body, shared by the fast
+    thread-fleet tier and the slow real-subprocess tier: serve the fleet
+    behind a gateway'd HTTP server, run loadgen while a chaos thread
+    triggers one rolling reload mid-run (drain-via-migration on the
+    stepper thread), then reconstruct a migrated request's timeline via
+    GET /debug/trace?request_id=. Returns (report, record, timeline,
+    merged_trace_dict)."""
+    from dlti_tpu.benchmarks import LoadGenConfig, run_load_test
+    from dlti_tpu.data.tokenizer import IdTokenizer
+    from dlti_tpu.serving.server import ServerConfig, make_server
+
+    httpd = None
+    try:
+        httpd, async_engine = make_server(
+            sup, IdTokenizer(vocab_size=CFG.vocab_size),
+            ServerConfig(host="127.0.0.1", port=0,
+                         default_params=SamplingParams(max_tokens=8),
+                         gateway=GatewayConfig(enabled=True)))
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+
+        reloaded = threading.Event()
+
+        def chaos():
+            # As soon as a worker holds live work, queue a rolling
+            # reload (same weights): the stepper thread drains each
+            # worker via KV migration — the chaos-triggered
+            # cross-process handoff, byte-identical outputs.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if any(w.owned for w in sup._workers):
+                    sup.request_reload(lambda: params)
+                    reloaded.set()
+                    return
+                time.sleep(0.01)
+
+        chaos_t = threading.Thread(target=chaos, daemon=True)
+        chaos_t.start()
+        report = run_load_test(LoadGenConfig(
+            host="127.0.0.1", port=port, num_requests=24, concurrency=4,
+            max_tokens=8, stream=True, prompt="trace", timeout_s=300,
+            scrape_debug_vars=True))
+        chaos_t.join(timeout=60)
+        assert reloaded.is_set(), "no worker was ever holding work"
+        deadline = time.monotonic() + 120
+        while sup._reload is not None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup._reload is None, "rolling reload never completed"
+
+        assert report.num_ok == report.num_requests, report.errors
+        assert report.errors == []
+        migrated = [r for r in report.records
+                    if r.ok and r.migrations > 0 and r.request_id]
+        assert migrated, "chaos reload must migrate >=1 live request"
+        rec = max(migrated, key=lambda r: r.latency)
+        assert rec.trace_id, "stream must surface the trace id"
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", f"/debug/trace?request_id={rec.request_id}"
+                                f"&latency_s={rec.latency}")
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            tl = json.loads(resp.read())
+            conn.request("GET", "/debug/trace")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            merged = json.loads(resp.read())
+        finally:
+            conn.close()
+        return report, rec, tl, merged
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            async_engine.shutdown()
+            httpd.server_close()
+        sup.close()
+
+
+def _assert_drill_timeline(report, rec, tl, merged):
+    """The ISSUE acceptance assertions over the drill artifacts."""
+    # Coverage: nearly every sampled ok request reconstructs with its
+    # gateway + prefill + decode legs present end-of-run.
+    assert report.trace_coverage > 0.9, report.trace_coverage
+    # One clock-aligned timeline with spans from >= 2 processes and
+    # every acceptance leg, causally ordered.
+    assert tl["trace_id"] == rec.trace_id
+    assert len(tl["processes"]) >= 2, tl["processes"]
+    assert {"gateway/queued", "request/prefill", "request/decode",
+            "engine/kv_handoff"} <= set(tl["legs"]), sorted(tl["legs"])
+    ts = [ev["ts"] for ev in tl["spans"]]
+    assert ts == sorted(ts), "spans must be causally ordered"
+    # Per-leg coverage within 5% of the client-observed latency (tiny
+    # absolute floor: sub-100ms requests bottom out at HTTP overhead).
+    assert tl["client_latency_s"] == pytest.approx(rec.latency)
+    assert abs(tl["residual_s"]) <= max(0.05 * rec.latency, 0.005), tl
+    # The merged snapshot is a multi-process Perfetto timeline: one
+    # process_name row per source (supervisor + both workers) and a
+    # clock-offset table covering both workers.
+    metas = [ev for ev in merged["traceEvents"] if ev.get("ph") == "M"]
+    assert len(metas) >= 3, metas
+    assert {"0", "1"} <= set(merged["clockOffsets"])
+
+
+def test_fleet_distributed_trace_cross_process_drill(tiny_params):
+    """Fast tier of the acceptance drill: thread-spawner fleet with
+    private per-worker tracers, gateway'd server, live loadgen, one
+    chaos-triggered migration, zero client errors, and a single
+    clock-aligned per-request timeline via /debug/trace."""
+    sup = FleetSupervisor(
+        _ec(), workers=2, spawner=_traced_thread_spawner(tiny_params),
+        fleet_cfg=_fleet_cfg(),
+        lifecycle_cfg=ReplicaLifecycleConfig(
+            enabled=True, probation_initial_s=0.05, probation_max_s=0.5),
+        canary_vocab=CFG.vocab_size)
+    with _global_tracer():
+        report, rec, tl, merged = _trace_drill(sup, tiny_params)
+    _assert_drill_timeline(report, rec, tl, merged)
+    assert report.migrations_total >= 1
+
+
+# ----------------------------------------------------------------------
 # Subprocess drills (slow tier): the real engine_worker.py processes
 # ----------------------------------------------------------------------
 
@@ -634,6 +877,11 @@ def test_subprocess_fleet_chaos_sigkill_under_load(tmp_path):
     prev_recorder = install(FlightRecorder(flight_dir))
     sup = _mk_subprocess_fleet(tmp_path, workers=2, flight_dir=flight_dir)
     httpd = None
+    # The supervisor-side dump carries only its own span tail (a
+    # SIGKILL'd worker never gets to dump), so the merge below needs the
+    # process-global tracer recording.
+    stack = contextlib.ExitStack()
+    stack.enter_context(_global_tracer())
     try:
         httpd, async_engine = make_server(
             sup, IdTokenizer(vocab_size=CFG.vocab_size),
@@ -682,7 +930,17 @@ def test_subprocess_fleet_chaos_sigkill_under_load(tmp_path):
         assert fed, "fleet federation block missing from LoadReport"
         assert sorted(fed["workers"]) == [0, 1]
         assert fed["consistent"], fed["checks"]
-        assert fed["respawns_total"] >= 1
+        # respawns_total increments at REINSTATE time (boot + canary),
+        # which can land after the load finishes — the report's scrape
+        # may legitimately predate it. Re-scrape now that the respawn
+        # wait above has completed.
+        from dlti_tpu.benchmarks.loadgen import _fleet_federation_report
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        raw = conn.getresponse().read()
+        conn.close()
+        fed_now = _fleet_federation_report(raw.decode(errors="replace"))
+        assert fed_now["respawns_total"] >= 1
 
         # Satellite: postmortem --all merges the per-worker dump tree
         # (the SIGKILL'd worker's supervisor-side dump is at the root).
@@ -692,13 +950,47 @@ def test_subprocess_fleet_chaos_sigkill_under_load(tmp_path):
         try:
             import postmortem
             dumps = postmortem.discover_dumps(flight_dir)
+            merged = postmortem.merge_incident_trace(dumps)
         finally:
             sys.path.pop(0)
         assert dumps, "worker fault must leave a flight dump"
+        # The dumps' span tails merge onto one clock (offsets persisted
+        # in each dump's context.json; supervisor dumps rebase at 0).
+        assert merged is not None, "dump span tails must merge"
+        assert merged["traceEvents"]
+        assert merged["sources"]
     finally:
+        stack.close()
         install(prev_recorder)
         if httpd is not None:
             httpd.shutdown()
             async_engine.shutdown()
             httpd.server_close()
         sup.close()
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_distributed_trace_drill(tmp_path, tiny_params):
+    """The real acceptance drill: 2 engine_worker.py PROCESSES (each with
+    its own monotonic clock and process-global tracer), gateway'd server,
+    loadgen with one chaos-triggered rolling-reload migration, zero
+    client errors — and a sampled migrated request whose /debug/trace
+    timeline is clock-aligned across genuinely distinct processes with
+    the per-leg sum within 5% of the client-observed latency."""
+    sup = _mk_subprocess_fleet(tmp_path, workers=2)
+    with _global_tracer():
+        report, rec, tl, merged = _trace_drill(sup, tiny_params)
+    _assert_drill_timeline(report, rec, tl, merged)
+    assert report.migrations_total >= 1
+    # Real processes: the worker span pids are the federator's synthetic
+    # render pids (stable rows), while the process_name metadata carries
+    # the real pids the supervisor observed at health time.
+    from dlti_tpu.telemetry.distributed_trace import TraceFederator
+
+    worker_pids = [p for p in tl["processes"]
+                   if p >= TraceFederator.SYNTHETIC_PID_BASE]
+    assert worker_pids, tl["processes"]
+    metas = [ev for ev in merged["traceEvents"] if ev.get("ph") == "M"
+             and ev.get("pid", 0) >= TraceFederator.SYNTHETIC_PID_BASE]
+    assert any("pid" in (ev.get("args") or {}).get("name", "")
+               for ev in metas), metas

@@ -56,10 +56,12 @@ class _FakeAsyncEngine:
     def set_room(self, n: int) -> None:
         self.engine.cfg.max_seqs = n
 
-    def submit(self, prompt_ids, params, request_id=None, q=None):
+    def submit(self, prompt_ids, params, request_id=None, q=None,
+               trace_id=""):
         req = Request(request_id=request_id,
                       prompt_token_ids=list(prompt_ids),
-                      params=params or SamplingParams())
+                      params=params or SamplingParams(),
+                      trace_id=trace_id)
         self.submitted.append(req)
         return req, q
 
@@ -291,8 +293,9 @@ def _fake_replicated(n: int, max_seqs: int = 4, spill_threshold: int = 4):
         eng = types.SimpleNamespace(
             idx=i, waiting=[], num_active=0,
             cfg=types.SimpleNamespace(max_seqs=max_seqs))
-        eng.submit = lambda ids, params, rid, _e=eng: types.SimpleNamespace(
-            request_id=rid, engine=_e)
+        eng.submit = lambda ids, params, rid, trace_id="", _e=eng: (
+            types.SimpleNamespace(request_id=rid, engine=_e,
+                                  trace_id=trace_id))
         return eng
 
     import itertools

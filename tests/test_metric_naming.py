@@ -51,6 +51,7 @@ def test_pinned_name_tuples_follow_convention():
         REQUEST_PHASE_METRIC_NAMES, SLO_METRIC_NAMES,
         WATCHDOG_METRIC_NAMES,
     )
+    from dlti_tpu.telemetry.distributed_trace import TRACE_METRIC_NAMES
     from dlti_tpu.telemetry.heartbeat import HEARTBEAT_METRIC_NAMES
     from dlti_tpu.telemetry.memledger import MEMLEDGER_METRIC_NAMES
     from dlti_tpu.training.elastic import ELASTIC_METRIC_NAMES
@@ -81,7 +82,8 @@ def test_pinned_name_tuples_follow_convention():
                        (LIFECYCLE_METRIC_NAMES, "lifecycle"),
                        (WIRE_METRIC_NAMES, "wire"),
                        (FLEET_METRIC_NAMES, "fleet"),
-                       (SPEC_METRIC_NAMES, "spec-decode")):
+                       (SPEC_METRIC_NAMES, "spec-decode"),
+                       (TRACE_METRIC_NAMES, "distributed-trace")):
         _assert_convention(tup, where)
 
 
@@ -89,7 +91,7 @@ def test_module_level_metric_objects_follow_convention():
     from dlti_tpu.checkpoint import store
     from dlti_tpu.serving import adapters, deploy, fleet, lifecycle, wire
     from dlti_tpu.telemetry import (
-        flightrecorder, ledger, memledger, slo, watchdog,
+        distributed_trace, flightrecorder, ledger, memledger, slo, watchdog,
     )
     from dlti_tpu.training import elastic, sentinel
     from dlti_tpu.utils import durable_io
@@ -109,6 +111,9 @@ def test_module_level_metric_objects_follow_convention():
             store.save_seconds, store.restore_seconds, store.corrupt_skipped,
             store.save_retries, store.last_verified_step,
             watchdog.alerts_total, flightrecorder.dumps_total,
+            distributed_trace.federated_spans_total,
+            distributed_trace.unparented_spans_total,
+            distributed_trace.clock_offset_gauge,
             elastic.restarts_total, elastic.generation_gauge,
             elastic.world_size_gauge,
             sentinel.anomalies_total, sentinel.skipped_updates_total,
@@ -180,6 +185,9 @@ def test_every_registered_metric_follows_convention(full_registry):
                      "dlti_watchdog_alerts_total",
                      "dlti_flight_dumps_total",
                      "dlti_trace_dropped_events",
+                     "dlti_trace_federated_spans_total",
+                     "dlti_trace_unparented_spans_total",
+                     "dlti_trace_clock_offset_seconds",
                      "dlti_train_prefetch_queue_depth",
                      "dlti_prefix_cache_hits_total",
                      "dlti_prefix_cache_blocks",

@@ -158,8 +158,19 @@ def test_walk_actually_sees_known_sites():
     literals, _ = _collected()
     for expected in ("train/step_dispatch", "engine/admit",
                      "request/queued", "gateway/enqueued",
-                     "watchdog/alert", "engine/tier_restore"):
+                     "watchdog/alert", "engine/tier_restore",
+                     "engine/kv_handoff"):
         assert expected in literals, f"walk missed {expected}"
+    # The kv-handoff span is emitted from BOTH handoff paths — the
+    # disagg prefill->decode staging injection and the fleet drain
+    # migration (the distributed trace's cross-process leg); losing
+    # either call site breaks per-request timeline reconstruction.
+    handoff_files = {rel for rel, _ in literals["engine/kv_handoff"]}
+    for rel in (os.path.join("serving", "disagg.py"),
+                os.path.join("serving", "fleet.py")):
+        assert rel in handoff_files, (
+            f"engine/kv_handoff call site missing from {rel}: "
+            f"{handoff_files}")
 
 
 if __name__ == "__main__":
